@@ -1,0 +1,59 @@
+"""rio-tpu: a TPU-native framework for distributed stateful services.
+
+Orleans-style virtual actors (feature parity with the reference rio-rs —
+see ``SURVEY.md``): typed message handlers on addressable ``ServiceObject``s,
+gossip cluster membership over pluggable storage, an object-placement
+directory, per-object persisted state with lifecycle hooks, request/response
++ pub/sub over framed TCP, and a cluster-transparent client.
+
+The TPU-native part: object placement is a *batched assignment problem*
+solved on-device (Sinkhorn/optimal-transport over the object × node cost
+matrix; ``rio_tpu.ops`` / ``rio_tpu.parallel``) instead of row-by-row SQL.
+
+This module re-exports the prelude (reference ``rio-rs/src/lib.rs:220-239``).
+"""
+
+from .app_data import AppData
+from .client import Client, ClientBuilder
+from .cluster.membership_protocol import ClusterProvider, LocalClusterProvider
+from .cluster.storage import LocalStorage, Member, MembershipStorage
+from .commands import AdminCommand, AdminSender, InternalClientSender, ServerInfo
+from .errors import RioError
+from .message_router import MessageRouter
+from .object_placement import LocalObjectPlacement, ObjectPlacement, ObjectPlacementItem
+from .registry import ObjectId, Registry, handler, message, type_id, type_name, wire_error
+from .server import Server
+from .service_object import LifecycleKind, LifecycleMessage, ServiceObject
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "AppData",
+    "AdminCommand",
+    "AdminSender",
+    "Client",
+    "ClientBuilder",
+    "ClusterProvider",
+    "InternalClientSender",
+    "LifecycleKind",
+    "LifecycleMessage",
+    "LocalClusterProvider",
+    "LocalObjectPlacement",
+    "LocalStorage",
+    "Member",
+    "MembershipStorage",
+    "MessageRouter",
+    "ObjectId",
+    "ObjectPlacement",
+    "ObjectPlacementItem",
+    "Registry",
+    "RioError",
+    "Server",
+    "ServerInfo",
+    "ServiceObject",
+    "handler",
+    "message",
+    "type_id",
+    "type_name",
+    "wire_error",
+]

@@ -1,0 +1,335 @@
+"""Cluster-transparent client.
+
+Reference: ``rio-rs/src/client/mod.rs`` — holds a membership view, a
+per-address connection cache, and a bounded LRU placement cache
+(``:48-65,137-147``); requests flow through a retry/redirect middleware
+(``client/tower_services.rs``) that follows ``Redirect`` responses, backs
+off on transport errors (1 µs → 2 s, ×20), and invalidates caches.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import random
+from typing import Any, AsyncIterator
+
+from .. import codec
+from ..cluster.storage import MembershipStorage
+from ..errors import (
+    ClientBuilderError,
+    ClientError,
+    Disconnect,
+    RetryExhausted,
+    ServerNotAvailable,
+)
+from ..protocol import (
+    ErrorKind,
+    RequestEnvelope,
+    ResponseEnvelope,
+    SubscriptionRequest,
+    SubscriptionResponse,
+    encode_request_frame,
+    encode_subscribe_frame,
+)
+from ..registry import MESSAGE_TYPES, decode_error, type_id
+from ..utils import ExponentialBackoff, LruCache
+
+log = logging.getLogger("rio_tpu.client")
+
+DEFAULT_PING_TIMEOUT = 0.5  # reference client/mod.rs:42
+DEFAULT_PLACEMENT_LRU = 1000  # reference client/mod.rs:137
+DEFAULT_POOL_PER_SERVER = 8
+
+
+class _Conn:
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        self.reader = reader
+        self.writer = writer
+
+    def close(self) -> None:
+        with contextlib.suppress(Exception):
+            self.writer.close()
+
+    async def roundtrip(self, frame_bytes: bytes) -> bytes:
+        self.writer.write(frame_bytes)
+        await self.writer.drain()
+        payload = await codec.read_frame(self.reader)
+        if payload is None:
+            raise Disconnect("connection closed mid-request")
+        return payload
+
+
+class _ServerConns:
+    """Bounded pool of framed connections to one server address."""
+
+    def __init__(self, address: str, limit: int, timeout: float) -> None:
+        self.address = address
+        self.limit = limit
+        self.timeout = timeout
+        self.idle: list[_Conn] = []
+        self.sem = asyncio.Semaphore(limit)
+
+    async def _connect(self) -> _Conn:
+        host, _, port = self.address.rpartition(":")
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, int(port)), self.timeout
+            )
+        except (OSError, asyncio.TimeoutError) as e:
+            raise ServerNotAvailable(f"{self.address}: {e}") from e
+        return _Conn(reader, writer)
+
+    @contextlib.asynccontextmanager
+    async def acquire(self):
+        async with self.sem:
+            conn = self.idle.pop() if self.idle else await self._connect()
+            ok = False
+            try:
+                yield conn
+                ok = True
+            finally:
+                if ok:
+                    self.idle.append(conn)
+                else:
+                    conn.close()
+
+    def close(self) -> None:
+        for c in self.idle:
+            c.close()
+        self.idle.clear()
+
+
+class Client:
+    """Send requests to any object in the cluster, from anywhere.
+
+    Usually built via :class:`ClientBuilder` or ``Client(members_storage)``.
+    """
+
+    def __init__(
+        self,
+        members_storage: MembershipStorage,
+        *,
+        placement_cache_size: int = DEFAULT_PLACEMENT_LRU,
+        pool_per_server: int = DEFAULT_POOL_PER_SERVER,
+        connect_timeout: float = DEFAULT_PING_TIMEOUT,
+        backoff: ExponentialBackoff | None = None,
+    ) -> None:
+        self.members_storage = members_storage
+        self._placement: LruCache[tuple[str, str], str] = LruCache(placement_cache_size)
+        self._conns: dict[str, _ServerConns] = {}
+        self._active_servers: list[str] = []
+        self._pool_per_server = pool_per_server
+        self._connect_timeout = connect_timeout
+        self._backoff = backoff or ExponentialBackoff()
+
+    # -- server/membership view (reference client/mod.rs:153-220) -----------
+
+    async def fetch_active_servers(self, refresh: bool = False) -> list[str]:
+        if refresh or not self._active_servers:
+            members = await self.members_storage.active_members()
+            self._active_servers = [m.address for m in members]
+        return self._active_servers
+
+    def _pool(self, address: str) -> _ServerConns:
+        pool = self._conns.get(address)
+        if pool is None:
+            pool = _ServerConns(address, self._pool_per_server, self._connect_timeout)
+            self._conns[address] = pool
+        return pool
+
+    def _invalidate(self, address: str | None = None) -> None:
+        self._active_servers = []
+        if address is not None:
+            pool = self._conns.pop(address, None)
+            if pool:
+                pool.close()
+
+    async def _pick_address(self, handler_type: str, handler_id: str) -> str:
+        cached = self._placement.get((handler_type, handler_id))
+        if cached is not None:
+            return cached
+        servers = await self.fetch_active_servers()
+        if not servers:
+            servers = await self.fetch_active_servers(refresh=True)
+        if not servers:
+            raise ServerNotAvailable("no active servers in membership view")
+        # Random pick on cache miss (reference client/mod.rs:255-262); the
+        # receiving server self-assigns or redirects us to the owner.
+        return random.choice(servers)
+
+    # -- request path (reference tower_services.rs:96-226) -------------------
+
+    async def send_raw(
+        self, handler_type: str, handler_id: str, message_type: str, payload: bytes
+    ) -> bytes:
+        env = RequestEnvelope(handler_type, handler_id, message_type, payload)
+        frame_bytes = encode_request_frame(env)
+        key = (handler_type, handler_id)
+        last: BaseException | None = None
+        attempts = 0
+        for delay in self._backoff.delays():
+            attempts += 1
+            try:
+                address = await self._pick_address(handler_type, handler_id)
+                async with self._pool(address).acquire() as conn:
+                    raw = await conn.roundtrip(frame_bytes)
+            except (ServerNotAvailable, Disconnect, OSError) as e:
+                last = e
+                self._placement.pop(key)
+                self._invalidate(None)
+                await asyncio.sleep(delay)
+                continue
+            resp = ResponseEnvelope.from_bytes(raw)
+            if resp.is_ok:
+                self._placement.put(key, address)
+                return resp.body or b""
+            err = resp.error
+            assert err is not None
+            if err.kind == ErrorKind.REDIRECT:
+                # Authoritative owner elsewhere: note it and retry there
+                # immediately (no backoff — reference tower_services.rs:158-167).
+                self._placement.put(key, err.detail)
+                continue
+            if err.kind in (ErrorKind.DEALLOCATE, ErrorKind.ALLOCATE):
+                last = ClientError(f"{err.kind.name}: {err.detail}")
+                self._placement.pop(key)
+                self._invalidate(address)
+                await asyncio.sleep(delay)
+                continue
+            if err.kind == ErrorKind.APPLICATION:
+                raise decode_error(err.payload, err.detail)
+            raise ClientError(f"{err.kind.name}: {err.detail}")
+        raise RetryExhausted(attempts, last)
+
+    async def send(
+        self,
+        handler_type: str | type,
+        handler_id: str,
+        msg: Any,
+        returns: Any = Any,
+    ) -> Any:
+        """Typed request: serialize ``msg``, await and decode the response."""
+        tname = handler_type if isinstance(handler_type, str) else type_id(handler_type)
+        raw = await self.send_raw(tname, handler_id, type_id(type(msg)), codec.serialize(msg))
+        return codec.deserialize(raw, returns)
+
+    # -- pub/sub (reference client/mod.rs:341-401) ---------------------------
+
+    async def subscribe(
+        self, handler_type: str | type, handler_id: str, decode: bool = True
+    ) -> AsyncIterator[Any]:
+        """Async-iterate an object's published messages.
+
+        Follows redirects by reconnecting to the owner; transport drops
+        trigger a resubscribe with backoff.
+        """
+        tname = handler_type if isinstance(handler_type, str) else type_id(handler_type)
+        frame_bytes = encode_subscribe_frame(SubscriptionRequest(tname, handler_id))
+
+        async def iterate() -> AsyncIterator[Any]:
+            attempt = 0
+            while True:
+                try:
+                    address = await self._pick_address(tname, handler_id)
+                    host, _, port = address.rpartition(":")
+                    reader, writer = await asyncio.wait_for(
+                        asyncio.open_connection(host, int(port)), self._connect_timeout
+                    )
+                except (OSError, asyncio.TimeoutError, ServerNotAvailable) as e:
+                    attempt += 1
+                    if attempt > self._backoff.max_retries:
+                        raise RetryExhausted(attempt, e)
+                    self._placement.pop((tname, handler_id))
+                    self._invalidate(None)
+                    await self._backoff.sleep(attempt)
+                    continue
+                try:
+                    writer.write(frame_bytes)
+                    await writer.drain()
+                    while True:
+                        payload = await codec.read_frame(reader)
+                        if payload is None:
+                            break  # server went away: resubscribe
+                        resp = SubscriptionResponse.from_bytes(payload)
+                        if resp.error is not None:
+                            if resp.error.kind == ErrorKind.REDIRECT:
+                                self._placement.put((tname, handler_id), resp.error.detail)
+                                break
+                            raise ClientError(
+                                f"{resp.error.kind.name}: {resp.error.detail}"
+                            )
+                        attempt = 0
+                        self._placement.put((tname, handler_id), address)
+                        if decode:
+                            cls = MESSAGE_TYPES.get(resp.message_type)
+                            yield codec.deserialize(resp.body, cls or Any)
+                        else:
+                            yield resp
+                finally:
+                    with contextlib.suppress(Exception):
+                        writer.close()
+                attempt += 1
+                if attempt > self._backoff.max_retries:
+                    raise RetryExhausted(attempt, Disconnect("subscription dropped"))
+                await self._backoff.sleep(min(attempt, 10))
+
+        return iterate()
+
+    # -- health probe (reference client/mod.rs:407-431) ----------------------
+
+    async def ping(self, address: str) -> bool:
+        """TCP reachability probe with the gossip timeout (500 ms default)."""
+        host, _, port = address.rpartition(":")
+        try:
+            _, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, int(port)), self._connect_timeout
+            )
+        except (OSError, asyncio.TimeoutError, ValueError):
+            return False
+        writer.close()
+        with contextlib.suppress(Exception):
+            await writer.wait_closed()
+        return True
+
+    def close(self) -> None:
+        for pool in self._conns.values():
+            pool.close()
+        self._conns.clear()
+
+
+class ClientBuilder:
+    """Fluent builder (reference ``client/builder.rs:15-68``)."""
+
+    def __init__(self) -> None:
+        self._storage: MembershipStorage | None = None
+        self._lru = DEFAULT_PLACEMENT_LRU
+        self._pool = DEFAULT_POOL_PER_SERVER
+        self._timeout = DEFAULT_PING_TIMEOUT
+
+    def members_storage(self, storage: MembershipStorage) -> "ClientBuilder":
+        self._storage = storage
+        return self
+
+    def placement_cache_size(self, n: int) -> "ClientBuilder":
+        self._lru = n
+        return self
+
+    def pool_per_server(self, n: int) -> "ClientBuilder":
+        self._pool = n
+        return self
+
+    def connect_timeout(self, seconds: float) -> "ClientBuilder":
+        self._timeout = seconds
+        return self
+
+    def build(self) -> Client:
+        if self._storage is None:
+            raise ClientBuilderError("members_storage is required")
+        return Client(
+            self._storage,
+            placement_cache_size=self._lru,
+            pool_per_server=self._pool,
+            connect_timeout=self._timeout,
+        )

@@ -1,0 +1,1 @@
+"""Cluster control plane: membership storage + membership protocols."""

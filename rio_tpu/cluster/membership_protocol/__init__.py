@@ -1,0 +1,40 @@
+"""Cluster providers: who is alive, and how we find out.
+
+Reference: ``rio-rs/src/cluster/membership_protocol/mod.rs:15-31`` — a
+``ClusterProvider`` owns a membership-storage view and runs a long-lived
+``serve(address)`` loop next to the server (registration, health checking).
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+
+from ..storage import Member, MembershipStorage
+
+__all__ = ["ClusterProvider", "LocalClusterProvider"]
+
+
+class ClusterProvider(abc.ABC):
+    @abc.abstractmethod
+    def members_storage(self) -> MembershipStorage: ...
+
+    @abc.abstractmethod
+    async def serve(self, address: str) -> None:
+        """Run until cancelled; must register ``address`` as an active member."""
+
+
+class LocalClusterProvider(ClusterProvider):
+    """Test no-op provider (reference ``local.rs:13-32``): registers self,
+    then idles — liveness is whatever the shared storage says."""
+
+    def __init__(self, members_storage: MembershipStorage) -> None:
+        self._storage = members_storage
+
+    def members_storage(self) -> MembershipStorage:
+        return self._storage
+
+    async def serve(self, address: str) -> None:
+        await self._storage.push(Member.from_address(address, active=True))
+        while True:
+            await asyncio.sleep(3600)

@@ -1,0 +1,113 @@
+"""Gossip-style failure detector.
+
+Reference: ``rio-rs/src/cluster/membership_protocol/peer_to_peer.rs`` — an
+Orleans-like peer-to-peer health protocol: every node registers itself
+active, then each tick TCP-pings a (bounded, ring-ordered) subset of peers,
+records failures in the shared membership storage's failure ledger, marks
+peers inactive once failures-in-window cross the threshold (``:101-112``),
+drops long-inactive members (``:175-185``), and re-activates reachable ones
+(``:188-192``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+
+from ...client import Client
+from ..storage import Member, MembershipStorage
+from . import ClusterProvider
+
+log = logging.getLogger("rio_tpu.gossip")
+
+
+@dataclasses.dataclass
+class PeerToPeerClusterConfig:
+    """Tunables, with the reference's defaults (``peer_to_peer.rs:23-44``)."""
+
+    interval_secs: float = 10.0
+    num_failures_threshold: int = 3
+    interval_secs_threshold: float = 60.0
+    limit_monitored_members: int | None = None
+    drop_inactive_after_secs: float | None = None
+    ping_timeout: float = 0.5
+
+
+class PeerToPeerClusterProvider(ClusterProvider):
+    def __init__(
+        self,
+        members_storage: MembershipStorage,
+        config: PeerToPeerClusterConfig | None = None,
+    ) -> None:
+        self._storage = members_storage
+        self.config = config or PeerToPeerClusterConfig()
+
+    def members_storage(self) -> MembershipStorage:
+        return self._storage
+
+    # -- monitored-subset selection (reference peer_to_peer.rs:50-78) -------
+
+    def _members_to_monitor(self, members: list[Member], self_address: str) -> list[Member]:
+        others = sorted(
+            (m for m in members if m.address != self_address), key=lambda m: m.address
+        )
+        limit = self.config.limit_monitored_members
+        if limit is None or limit >= len(others):
+            return others
+        # Ring order starting just past self, so monitoring load spreads
+        # across the cluster instead of everyone pinging the same prefix.
+        idx = sum(1 for m in others if m.address < self_address)
+        return [others[(idx + i) % len(others)] for i in range(limit)]
+
+    # -- per-member probe + verdict (reference peer_to_peer.rs:81-112) -------
+
+    async def _test_member(self, client: Client, member: Member) -> None:
+        reachable = await client.ping(member.address)
+        if reachable:
+            if not member.active:
+                await self._storage.set_active(member.ip, member.port)
+            return
+        await self._storage.notify_failure(member.ip, member.port)
+        failures = await self._storage.member_failures(member.ip, member.port)
+        window_start = time.time() - self.config.interval_secs_threshold
+        recent = [f for f in failures if f >= window_start]
+        if len(recent) >= self.config.num_failures_threshold and member.active:
+            log.info("gossip: marking %s inactive (%d recent failures)",
+                     member.address, len(recent))
+            await self._storage.set_inactive(member.ip, member.port)
+
+    async def _drop_stale(self, members: list[Member]) -> None:
+        drop_after = self.config.drop_inactive_after_secs
+        if drop_after is None:
+            return
+        cutoff = time.time() - drop_after
+        for m in members:
+            if not m.active and m.last_seen and m.last_seen < cutoff:
+                log.info("gossip: dropping long-inactive member %s", m.address)
+                await self._storage.remove(m.ip, m.port)
+
+    # -- main loop (reference peer_to_peer.rs:144-209) ------------------------
+
+    async def serve(self, address: str) -> None:
+        await self._storage.push(Member.from_address(address, active=True))
+        client = Client(self._storage, connect_timeout=self.config.ping_timeout)
+        try:
+            while True:
+                tick_start = time.monotonic()
+                members = await self._storage.members()
+                monitored = self._members_to_monitor(members, address)
+                await asyncio.gather(
+                    *(self._test_member(client, m) for m in monitored),
+                    return_exceptions=True,
+                )
+                await self._drop_stale(members)
+                # Keep our own registration fresh — re-push (not just
+                # set_active) so a node whose row was dropped while it was
+                # partitioned can rejoin once reachable again.
+                await self._storage.push(Member.from_address(address, active=True))
+                elapsed = time.monotonic() - tick_start
+                await asyncio.sleep(max(0.0, self.config.interval_secs - elapsed))
+        finally:
+            client.close()

@@ -1,0 +1,184 @@
+"""Wire serialization for rio-tpu.
+
+The reference frames TCP traffic with a 4-byte length prefix and encodes
+payloads with bincode (``rio-rs/src/service.rs:370-378``,
+``client/mod.rs:199-203``). rio-tpu keeps the same wire shape — length
+delimited frames carrying a compact binary payload — but the payload codec is
+msgpack-based and schema'd by Python dataclasses instead of serde derives.
+
+Two layers:
+
+* **Value codec** — ``serialize``/``deserialize``: dataclass-aware msgpack.
+  Dataclasses are encoded *positionally* (a msgpack array of field values, in
+  declaration order), which is bincode-like: compact, no field names on the
+  wire, schema evolution by appending optional fields.
+* **Framing** — ``FrameReader``/``frame``: 4-byte big-endian length prefix,
+  matching tokio's ``LengthDelimitedCodec`` defaults.
+
+A C++ fast path for framing + envelope packing lives in
+:mod:`rio_tpu.native`; this module is the always-available reference
+implementation and the two are wire-compatible.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+import types
+import typing
+from enum import Enum
+from typing import Any, get_args, get_origin, get_type_hints
+
+import msgpack
+
+from .errors import SerializationError
+
+MAX_FRAME = 8 * 1024 * 1024  # tokio LengthDelimitedCodec default max frame
+
+
+# ---------------------------------------------------------------------------
+# Value codec
+# ---------------------------------------------------------------------------
+
+
+def _to_wire(value: Any) -> Any:
+    """Lower a Python value to msgpack-encodable primitives."""
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        return [_to_wire(getattr(value, f.name)) for f in dataclasses.fields(value)]
+    if isinstance(value, Enum):
+        return value.value
+    if isinstance(value, (list, tuple)):
+        return [_to_wire(v) for v in value]
+    if isinstance(value, set):
+        return [_to_wire(v) for v in sorted(value)]
+    if isinstance(value, dict):
+        return {_to_wire(k): _to_wire(v) for k, v in value.items()}
+    if isinstance(value, (str, bytes, bool, int, float)) or value is None:
+        return value
+    raise SerializationError(f"cannot serialize value of type {type(value)!r}")
+
+
+def serialize(value: Any) -> bytes:
+    """Encode ``value`` (dataclass, primitive, or container) to bytes."""
+    try:
+        return msgpack.packb(_to_wire(value), use_bin_type=True)
+    except (TypeError, ValueError, msgpack.exceptions.PackException) as e:
+        raise SerializationError(str(e)) from e
+
+
+_NONE_TYPE = type(None)
+
+
+def _from_wire(wire: Any, ty: Any) -> Any:
+    """Raise ``wire`` back into the typed value described by ``ty``."""
+    if ty is Any or ty is None or ty is _NONE_TYPE:
+        return wire
+    origin = get_origin(ty)
+    if origin is typing.Union or isinstance(ty, types.UnionType):
+        args = get_args(ty)
+        if wire is None and _NONE_TYPE in args:
+            return None
+        non_none = [a for a in args if a is not _NONE_TYPE]
+        for a in non_none:
+            try:
+                return _from_wire(wire, a)
+            except (SerializationError, TypeError, ValueError):
+                continue
+        raise SerializationError(f"no Union arm of {ty} matched wire value")
+    if origin in (list, tuple, set, frozenset):
+        args = get_args(ty)
+        if origin is tuple and args and args[-1] is not Ellipsis:
+            return tuple(_from_wire(v, a) for v, a in zip(wire, args))
+        elem = args[0] if args else Any
+        return origin(_from_wire(v, elem) for v in wire)
+    if origin is dict:
+        args = get_args(ty) or (Any, Any)
+        return {_from_wire(k, args[0]): _from_wire(v, args[1]) for k, v in wire.items()}
+    if isinstance(ty, type) and issubclass(ty, Enum):
+        return ty(wire)
+    if dataclasses.is_dataclass(ty):
+        if not isinstance(wire, (list, tuple)):
+            raise SerializationError(f"expected array for dataclass {ty.__name__}")
+        hints = get_type_hints(ty)
+        fields = dataclasses.fields(ty)
+        if len(wire) > len(fields):
+            raise SerializationError(
+                f"{ty.__name__}: wire has {len(wire)} fields, schema has {len(fields)}"
+            )
+        kwargs = {
+            f.name: _from_wire(v, hints.get(f.name, Any))
+            for f, v in zip(fields, wire)
+        }
+        return ty(**kwargs)
+    if ty is float and isinstance(wire, int):
+        return float(wire)
+    if ty is bytes and isinstance(wire, str):
+        return wire.encode()
+    if isinstance(ty, type) and not isinstance(wire, ty):
+        raise SerializationError(f"expected {ty.__name__}, got {type(wire).__name__}")
+    return wire
+
+
+def deserialize(data: bytes, ty: Any) -> Any:
+    """Decode bytes produced by :func:`serialize` into an instance of ``ty``."""
+    try:
+        wire = msgpack.unpackb(data, raw=False, strict_map_key=False)
+    except (ValueError, msgpack.exceptions.UnpackException) as e:
+        raise SerializationError(str(e)) from e
+    return _from_wire(wire, ty)
+
+
+# ---------------------------------------------------------------------------
+# Framing
+# ---------------------------------------------------------------------------
+
+_LEN = struct.Struct(">I")
+
+
+def frame(payload: bytes) -> bytes:
+    """Wrap ``payload`` in a 4-byte big-endian length-prefixed frame."""
+    if len(payload) > MAX_FRAME:
+        raise SerializationError(f"frame too large: {len(payload)} > {MAX_FRAME}")
+    return _LEN.pack(len(payload)) + payload
+
+
+class FrameReader:
+    """Incremental length-delimited frame decoder (sans-io).
+
+    Feed raw bytes with :meth:`feed`; completed frames come back as a list.
+    Usable both from asyncio protocols and the test harness.
+    """
+
+    def __init__(self) -> None:
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> list[bytes]:
+        self._buf.extend(data)
+        out: list[bytes] = []
+        while True:
+            if len(self._buf) < 4:
+                return out
+            (n,) = _LEN.unpack_from(self._buf)
+            if n > MAX_FRAME:
+                raise SerializationError(f"incoming frame too large: {n}")
+            if len(self._buf) < 4 + n:
+                return out
+            out.append(bytes(self._buf[4 : 4 + n]))
+            del self._buf[: 4 + n]
+
+
+async def read_frame(reader) -> bytes | None:
+    """Read one frame from an ``asyncio.StreamReader``; ``None`` on EOF."""
+    import asyncio
+
+    try:
+        header = await reader.readexactly(4)
+    except (asyncio.IncompleteReadError, ConnectionError):
+        return None
+    (n,) = _LEN.unpack(header)
+    if n > MAX_FRAME:
+        raise SerializationError(f"incoming frame too large: {n}")
+    try:
+        return await reader.readexactly(n)
+    except (asyncio.IncompleteReadError, ConnectionError):
+        return None

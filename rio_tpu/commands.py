@@ -1,0 +1,83 @@
+"""In-process command channels between handlers and the server loop.
+
+Reference: ``rio-rs/src/server.rs:30-73`` — ``AdminCommands`` (server exit /
+object shutdown) and the internal-client ``SendCommand`` oneshot bridge that
+lets a handler message other objects through its own server (consumed at
+``server.rs:309-363``). Handlers reach these through :class:`~rio_tpu.app_data.AppData`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+from enum import Enum
+from typing import Any
+
+
+class AdminCommandKind(Enum):
+    SERVER_EXIT = "server_exit"
+    SHUTDOWN_OBJECT = "shutdown_object"
+
+
+@dataclasses.dataclass
+class AdminCommand:
+    kind: AdminCommandKind
+    type_name: str = ""
+    object_id: str = ""
+
+    @classmethod
+    def server_exit(cls) -> "AdminCommand":
+        return cls(AdminCommandKind.SERVER_EXIT)
+
+    @classmethod
+    def shutdown(cls, type_name: str, object_id: str) -> "AdminCommand":
+        return cls(AdminCommandKind.SHUTDOWN_OBJECT, type_name, object_id)
+
+
+class AdminSender:
+    """AppData-injectable handle for queueing :class:`AdminCommand`s."""
+
+    def __init__(self) -> None:
+        self.queue: asyncio.Queue[AdminCommand] = asyncio.Queue()
+
+    def send(self, cmd: AdminCommand) -> None:
+        self.queue.put_nowait(cmd)
+
+
+@dataclasses.dataclass
+class SendCommand:
+    """One internal actor→actor request plus its response future."""
+
+    handler_type: str
+    handler_id: str
+    message_type: str
+    payload: bytes
+    response: asyncio.Future
+
+
+class InternalClientSender:
+    """AppData-injectable handle for the server's internal request queue.
+
+    Reference ``server.rs:48-73``: requests enqueued here are replayed
+    through the full Service dispatch path by the server's consumer task —
+    never inline — so a handler awaiting a send can't deadlock on its own
+    object lock chain (see the reference's ``test_proxy_deadlock``).
+    """
+
+    def __init__(self) -> None:
+        self.queue: asyncio.Queue[SendCommand] = asyncio.Queue()
+
+    async def send(
+        self, handler_type: str, handler_id: str, message_type: str, payload: bytes
+    ) -> bytes:
+        """Enqueue a request and await the (serialized) response."""
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self.queue.put_nowait(SendCommand(handler_type, handler_id, message_type, payload, fut))
+        return await fut
+
+
+@dataclasses.dataclass
+class ServerInfo:
+    """The hosting server's identity, injected into AppData."""
+
+    address: str

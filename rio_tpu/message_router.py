@@ -1,0 +1,76 @@
+"""Pub/sub fan-out inside one server.
+
+Reference: ``rio-rs/src/message_router.rs:24-43`` — a map of
+``(type, id) -> broadcast channel`` (capacity 1000). Handlers publish via
+AppData; the per-connection Service bridges a subscription receiver onto TCP
+frames (``service.rs:116-148,431-456``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+from . import codec
+from .protocol import SubscriptionResponse
+from .registry import type_id
+
+DEFAULT_CAPACITY = 1000
+
+
+class _Broadcast:
+    """Single-producer multi-consumer ring: each subscriber gets its own
+    bounded queue; slow subscribers drop oldest (broadcast-lag semantics)."""
+
+    def __init__(self, capacity: int) -> None:
+        self.capacity = capacity
+        self.queues: set[asyncio.Queue[SubscriptionResponse]] = set()
+
+    def subscribe(self) -> asyncio.Queue[SubscriptionResponse]:
+        q: asyncio.Queue[SubscriptionResponse] = asyncio.Queue(self.capacity)
+        self.queues.add(q)
+        return q
+
+    def unsubscribe(self, q: asyncio.Queue) -> None:
+        self.queues.discard(q)
+
+    def publish(self, item: SubscriptionResponse) -> int:
+        for q in list(self.queues):
+            if q.full():
+                try:
+                    q.get_nowait()  # lagging subscriber loses oldest message
+                except asyncio.QueueEmpty:
+                    pass
+            q.put_nowait(item)
+        return len(self.queues)
+
+
+class MessageRouter:
+    """Keyed broadcast registry; injected into AppData by the server."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY) -> None:
+        self._channels: dict[tuple[str, str], _Broadcast] = {}
+        self._capacity = capacity
+
+    def _channel(self, type_name: str, object_id: str) -> _Broadcast:
+        return self._channels.setdefault((type_name, object_id), _Broadcast(self._capacity))
+
+    def create_subscription(self, type_name: str, object_id: str) -> asyncio.Queue:
+        """Reference ``message_router.rs:25-35``."""
+        return self._channel(type_name, object_id).subscribe()
+
+    def drop_subscription(self, type_name: str, object_id: str, q: asyncio.Queue) -> None:
+        ch = self._channels.get((type_name, object_id))
+        if ch is not None:
+            ch.unsubscribe(q)
+
+    def publish(self, type_name: str, object_id: str, msg: Any) -> int:
+        """Serialize and fan out ``msg`` to subscribers; returns receiver count.
+
+        Reference ``message_router.rs:37-43`` (handlers call this through
+        AppData, e.g. black-jack ``table.rs:72-86``).
+        """
+        resp = SubscriptionResponse(
+            body=codec.serialize(msg), message_type=type_id(type(msg))
+        )
+        return self._channel(type_name, object_id).publish(resp)

@@ -1,0 +1,89 @@
+"""Object placement: the cluster-wide actor directory.
+
+Reference: ``rio-rs/src/object_placement/mod.rs:20-56`` — a CRUD mapping
+``ObjectId -> server_address`` consulted on every request
+(``service.rs:193-254``). The reference's *policy* is trivial (random client
+pick + receiving-server self-assign, no load balancing); rio-tpu keeps this
+trait boundary and adds :class:`~rio_tpu.object_placement.jax_placement.JaxObjectPlacement`,
+which treats placement as a batched assignment problem solved on TPU
+(see ``rio_tpu/ops/sinkhorn.py``).
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+
+from ..registry import ObjectId
+
+__all__ = ["ObjectId", "ObjectPlacementItem", "ObjectPlacement", "LocalObjectPlacement"]
+
+
+@dataclasses.dataclass
+class ObjectPlacementItem:
+    """One directory row (reference ``object_placement/mod.rs:20-37``)."""
+
+    object_id: ObjectId
+    server_address: str | None = None
+
+
+class ObjectPlacement(abc.ABC):
+    """CRUD directory trait (reference ``object_placement/mod.rs:39-56``)."""
+
+    async def prepare(self) -> None:
+        return None
+
+    @abc.abstractmethod
+    async def update(self, item: ObjectPlacementItem) -> None:
+        """Upsert an object's address (atomic per key)."""
+
+    @abc.abstractmethod
+    async def lookup(self, object_id: ObjectId) -> str | None: ...
+
+    @abc.abstractmethod
+    async def clean_server(self, address: str) -> None:
+        """Bulk-unassign every object placed on ``address`` (dead node)."""
+
+    @abc.abstractmethod
+    async def remove(self, object_id: ObjectId) -> None: ...
+
+    # Batch hooks — default to per-item loops; accelerated providers
+    # (JaxObjectPlacement) override with a single device solve.
+    async def lookup_batch(self, object_ids: list[ObjectId]) -> list[str | None]:
+        return [await self.lookup(oid) for oid in object_ids]
+
+    async def update_batch(self, items: list[ObjectPlacementItem]) -> None:
+        for item in items:
+            await self.update(item)
+
+
+class LocalObjectPlacement(ObjectPlacement):
+    """In-memory directory; clones alias the same dict.
+
+    Reference ``object_placement/local.rs:12-68`` (keying scheme
+    ``"{type}.{id}"`` preserved for parity).
+    """
+
+    def __init__(self) -> None:
+        self._placements: dict[str, str] = {}
+
+    async def update(self, item: ObjectPlacementItem) -> None:
+        key = str(item.object_id)
+        if item.server_address is None:
+            self._placements.pop(key, None)
+        else:
+            self._placements[key] = item.server_address
+
+    async def lookup(self, object_id: ObjectId) -> str | None:
+        return self._placements.get(str(object_id))
+
+    async def clean_server(self, address: str) -> None:
+        stale = [k for k, v in self._placements.items() if v == address]
+        for k in stale:
+            del self._placements[k]
+
+    async def remove(self, object_id: ObjectId) -> None:
+        self._placements.pop(str(object_id), None)
+
+    def count(self) -> int:
+        return len(self._placements)

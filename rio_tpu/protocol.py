@@ -1,0 +1,210 @@
+"""Wire protocol: request/response/subscription envelopes.
+
+Mirrors the reference protocol surface (``rio-rs/src/protocol.rs``):
+
+* ``RequestEnvelope{handler_type, handler_id, message_type, payload}``
+  (reference ``protocol.rs:9-14``)
+* ``ResponseEnvelope{body: Result<bytes, ResponseError>}`` (``:47-49``)
+* ``ResponseError`` control-flow variants — ``Redirect``,
+  ``DeallocateServiceObject``, ``Allocate``, ``NotSupported``,
+  ``ApplicationError(bytes)``, ``Unknown`` (``:78-105``)
+* pub/sub ``SubscriptionRequest``/``SubscriptionResponse`` (``:237-258``)
+
+Encoding: each envelope is a positional msgpack array (see
+:mod:`rio_tpu.codec`); a ``ResponseEnvelope`` body is a 2-element tagged
+array ``[ok: bool, value]`` where the error arm is ``[tag, detail]``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Any
+
+from . import codec
+from .errors import SerializationError
+
+
+@dataclass
+class RequestEnvelope:
+    """One actor-addressed request crossing the wire."""
+
+    handler_type: str
+    handler_id: str
+    message_type: str
+    payload: bytes
+
+    def to_bytes(self) -> bytes:
+        return codec.serialize(self)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "RequestEnvelope":
+        return codec.deserialize(data, cls)
+
+
+class ErrorKind(IntEnum):
+    """Wire tags for ``ResponseError`` variants."""
+
+    UNKNOWN = 0
+    REDIRECT = 1
+    DEALLOCATE = 2
+    ALLOCATE = 3
+    NOT_SUPPORTED = 4
+    APPLICATION = 5
+    HANDLER_NOT_FOUND = 6
+    SERIALIZATION = 7
+
+
+@dataclass
+class ResponseError:
+    """Structured server→client error; drives client routing decisions.
+
+    ``REDIRECT`` carries the authoritative address in ``detail`` (str);
+    ``APPLICATION`` carries the serialized user error in ``payload`` plus the
+    user error's type name in ``detail`` for typed re-raising.
+    """
+
+    kind: ErrorKind
+    detail: str = ""
+    payload: bytes = b""
+
+    @classmethod
+    def redirect(cls, address: str) -> "ResponseError":
+        return cls(ErrorKind.REDIRECT, detail=address)
+
+    @classmethod
+    def deallocate(cls) -> "ResponseError":
+        return cls(ErrorKind.DEALLOCATE)
+
+    @classmethod
+    def allocate(cls, detail: str = "") -> "ResponseError":
+        return cls(ErrorKind.ALLOCATE, detail=detail)
+
+    @classmethod
+    def not_supported(cls, detail: str = "") -> "ResponseError":
+        return cls(ErrorKind.NOT_SUPPORTED, detail=detail)
+
+    @classmethod
+    def application(cls, payload: bytes, type_name: str = "") -> "ResponseError":
+        return cls(ErrorKind.APPLICATION, detail=type_name, payload=payload)
+
+    @classmethod
+    def unknown(cls, detail: str) -> "ResponseError":
+        return cls(ErrorKind.UNKNOWN, detail=detail)
+
+
+@dataclass
+class ResponseEnvelope:
+    """Result of one request: ``ok`` payload bytes xor a ``ResponseError``."""
+
+    body: bytes | None = None
+    error: ResponseError | None = None
+
+    @property
+    def is_ok(self) -> bool:
+        return self.error is None
+
+    @classmethod
+    def ok(cls, body: bytes) -> "ResponseEnvelope":
+        return cls(body=body)
+
+    @classmethod
+    def err(cls, error: ResponseError) -> "ResponseEnvelope":
+        return cls(error=error)
+
+    def to_bytes(self) -> bytes:
+        if self.error is None:
+            return codec.serialize([True, self.body])
+        return codec.serialize(
+            [False, [int(self.error.kind), self.error.detail, self.error.payload]]
+        )
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "ResponseEnvelope":
+        wire = codec.deserialize(data, Any)
+        if not isinstance(wire, (list, tuple)) or len(wire) != 2:
+            raise SerializationError("malformed ResponseEnvelope")
+        ok, value = wire
+        if ok:
+            return cls.ok(value if value is not None else b"")
+        kind, detail, payload = value
+        return cls.err(ResponseError(ErrorKind(kind), detail, payload))
+
+
+# ---------------------------------------------------------------------------
+# Pub/sub (reference protocol.rs:237-258)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SubscriptionRequest:
+    """Ask the hosting server to stream an object's published messages."""
+
+    handler_type: str
+    handler_id: str
+
+    def to_bytes(self) -> bytes:
+        return codec.serialize(self)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "SubscriptionRequest":
+        return codec.deserialize(data, cls)
+
+
+@dataclass
+class SubscriptionResponse:
+    """One published message (or terminal error) on a subscription stream."""
+
+    body: bytes = b""
+    message_type: str = ""
+    error: ResponseError | None = None
+
+    def to_bytes(self) -> bytes:
+        if self.error is None:
+            return codec.serialize([True, self.message_type, self.body])
+        return codec.serialize(
+            [False, [int(self.error.kind), self.error.detail, self.error.payload]]
+        )
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "SubscriptionResponse":
+        wire = codec.deserialize(data, Any)
+        if not isinstance(wire, (list, tuple)) or len(wire) < 2:
+            raise SerializationError("malformed SubscriptionResponse")
+        if wire[0]:
+            if len(wire) != 3:
+                raise SerializationError("malformed SubscriptionResponse ok arm")
+            return cls(message_type=wire[1], body=wire[2])
+        kind, detail, payload = wire[1]
+        return cls(error=ResponseError(ErrorKind(kind), detail, payload))
+
+
+# ---------------------------------------------------------------------------
+# Frame kinds — a connection can carry requests and subscription requests
+# (the reference tries bincode-decoding each frame as Request then
+# Subscription, service.rs:370-459; we use an explicit 1-byte kind prefix,
+# which is cheaper and unambiguous).
+# ---------------------------------------------------------------------------
+
+KIND_REQUEST = b"\x00"
+KIND_SUBSCRIBE = b"\x01"
+
+
+def encode_request_frame(env: RequestEnvelope) -> bytes:
+    return codec.frame(KIND_REQUEST + env.to_bytes())
+
+
+def encode_subscribe_frame(req: SubscriptionRequest) -> bytes:
+    return codec.frame(KIND_SUBSCRIBE + req.to_bytes())
+
+
+def decode_inbound(payload: bytes) -> RequestEnvelope | SubscriptionRequest:
+    """Decode one inbound frame payload on the server side."""
+    if not payload:
+        raise SerializationError("empty frame")
+    kind, body = payload[:1], payload[1:]
+    if kind == KIND_REQUEST:
+        return RequestEnvelope.from_bytes(body)
+    if kind == KIND_SUBSCRIBE:
+        return SubscriptionRequest.from_bytes(body)
+    raise SerializationError(f"unknown frame kind {kind!r}")

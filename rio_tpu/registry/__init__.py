@@ -1,0 +1,206 @@
+"""In-process actor table + dynamic dispatch.
+
+Reference: ``rio-rs/src/registry/mod.rs`` — the registry maps
+``(type_name, object_id) -> live object`` and
+``(type_name, message_type) -> handler callback`` (``:82-203``). The Rust
+implementation needs dashmap/papaya lock-free maps and per-object ``RwLock``;
+here plain dicts (atomic under the GIL) plus a per-object ``asyncio.Lock``
+give the same serialized ``&mut self`` execution without ever holding a
+map-wide lock across an ``await`` (the deadlock the reference stress-tests in
+``registry/mod.rs:561-625``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+from typing import Any, Callable
+
+from .. import codec
+from ..errors import (
+    HandlerNotFound,
+    ObjectNotFound,
+    TypeNotFound,
+)
+from .handler import (
+    ERROR_TYPES,
+    MESSAGE_TYPES,
+    HandlerSpec,
+    decode_error,
+    encode_error,
+    handler,
+    message,
+    resolve_handlers,
+    wire_error,
+)
+from .identifiable import type_id, type_name
+
+__all__ = [
+    "Registry",
+    "ObjectId",
+    "handler",
+    "message",
+    "wire_error",
+    "type_id",
+    "type_name",
+    "MESSAGE_TYPES",
+    "ERROR_TYPES",
+    "encode_error",
+    "decode_error",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class ObjectId:
+    """Cluster-wide actor address ``(type_name, object_id)``.
+
+    Reference: ``rio-rs/src/service_object.rs:20``.
+    """
+
+    type_name: str
+    id: str
+
+    def __str__(self) -> str:  # storage key form used by placement backends
+        return f"{self.type_name}.{self.id}"
+
+
+class ApplicationRaised(Exception):
+    """Internal carrier: a registered (typed) user error crossed dispatch."""
+
+    def __init__(self, payload: bytes, type_name: str, original: BaseException):
+        super().__init__(type_name)
+        self.payload = payload
+        self.type_name = type_name
+        self.original = original
+
+
+@dataclasses.dataclass
+class _Entry:
+    obj: Any
+    lock: asyncio.Lock
+
+
+class Registry:
+    """Holds live service objects and dispatches serialized messages to them."""
+
+    def __init__(self) -> None:
+        self._constructors: dict[str, Callable[[], Any]] = {}
+        self._handlers: dict[tuple[str, str], HandlerSpec] = {}
+        self._objects: dict[tuple[str, str], _Entry] = {}
+
+    # -- type / handler registration (reference registry/mod.rs:82-182) ----
+
+    def add_type(self, cls: type, constructor: Callable[[], Any] | None = None) -> "Registry":
+        """Register a service class: constructor + all its ``@handler`` methods."""
+        tname = type_id(cls)
+        self._constructors[tname] = constructor or cls
+        for spec in resolve_handlers(cls):
+            self._handlers[(tname, spec.message_type_name)] = spec
+        return self
+
+    def add_handler(self, cls: type, msg_cls: type, fn: Callable, returns: Any = Any) -> "Registry":
+        """Explicitly register ``fn`` as ``cls``'s handler for ``msg_cls``.
+
+        Escape hatch matching the reference's manual ``add_handler``; most
+        code should rely on ``@handler`` methods picked up by `add_type`.
+        """
+        import inspect
+
+        if not inspect.iscoroutinefunction(fn):
+            raise TypeError("handler must be async")
+        self._handlers[(type_id(cls), type_id(msg_cls))] = HandlerSpec(
+            message_type=msg_cls,
+            message_type_name=type_id(msg_cls),
+            returns=returns,
+            fn=fn,
+        )
+        return self
+
+    def has_type(self, type_name: str) -> bool:
+        return type_name in self._constructors
+
+    def has_handler(self, type_name: str, message_type: str) -> bool:
+        return (type_name, message_type) in self._handlers
+
+    def registered_types(self) -> list[str]:
+        return list(self._constructors)
+
+    # -- object lifecycle (reference registry/mod.rs:205-239) ---------------
+
+    def new_from_type(self, type_name: str, object_id: str) -> Any:
+        ctor = self._constructors.get(type_name)
+        if ctor is None:
+            raise TypeNotFound(type_name)
+        obj = ctor()
+        obj.id = object_id
+        return obj
+
+    def has(self, type_name: str, object_id: str) -> bool:
+        return (type_name, object_id) in self._objects
+
+    def insert(self, type_name: str, object_id: str, obj: Any) -> None:
+        self._objects[(type_name, object_id)] = _Entry(obj, asyncio.Lock())
+
+    def get(self, type_name: str, object_id: str) -> Any | None:
+        entry = self._objects.get((type_name, object_id))
+        return entry.obj if entry else None
+
+    def remove(self, type_name: str, object_id: str) -> Any | None:
+        entry = self._objects.pop((type_name, object_id), None)
+        return entry.obj if entry else None
+
+    def count_objects(self) -> int:
+        return len(self._objects)
+
+    def object_ids(self) -> list[ObjectId]:
+        return [ObjectId(t, i) for (t, i) in self._objects]
+
+    # -- dispatch (reference registry/mod.rs:123-203) -----------------------
+
+    async def send_raw(
+        self,
+        type_name: str,
+        object_id: str,
+        message_type: str,
+        payload: bytes,
+        app_data: Any,
+    ) -> bytes:
+        """Deserialize → lock object → run handler → serialize result.
+
+        Raises :class:`ObjectNotFound` / :class:`HandlerNotFound` for routing
+        errors, :class:`ApplicationRaised` for registered user error types,
+        and propagates anything else raw (the Service layer treats that as a
+        panic: deallocate + ``Unknown``).
+        """
+        spec = self._handlers.get((type_name, message_type))
+        if spec is None:
+            raise HandlerNotFound(f"{type_name}/{message_type}")
+        entry = self._objects.get((type_name, object_id))
+        if entry is None:
+            raise ObjectNotFound(f"{type_name}/{object_id}")
+        msg = codec.deserialize(payload, spec.message_type)
+        # Serialized &mut self execution: one handler at a time per object.
+        async with entry.lock:
+            try:
+                result = await spec.fn(entry.obj, msg, app_data)
+            except Exception as e:  # noqa: BLE001 - triaged below
+                if type_id(type(e)) in ERROR_TYPES:
+                    pl, tn = encode_error(e)
+                    raise ApplicationRaised(pl, tn, e) from e
+                raise
+        return codec.serialize(result)
+
+    async def send(
+        self,
+        type_name: str,
+        object_id: str,
+        msg: Any,
+        app_data: Any,
+        returns: Any = None,
+    ) -> Any:
+        """Typed convenience over :meth:`send_raw` (tests, internal callers)."""
+        mtype = type_id(type(msg))
+        raw = await self.send_raw(type_name, object_id, mtype, codec.serialize(msg), app_data)
+        spec = self._handlers.get((type_name, mtype))
+        ty = returns if returns is not None else (spec.returns if spec else Any)
+        return codec.deserialize(raw, ty)

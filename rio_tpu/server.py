@@ -1,0 +1,242 @@
+"""Server: one cluster node.
+
+Reference: ``rio-rs/src/server.rs`` — builder (``:85-110``), storage
+migrations in ``prepare`` (``:120-125``), ``bind`` (``:135-140``), and a
+``run`` loop that drives the TCP acceptor, the cluster provider, the
+internal-client consumer, the admin consumer, and the optional HTTP
+membership endpoint concurrently (``:178-283``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+
+from .app_data import AppData
+from .cluster.membership_protocol import ClusterProvider
+from .cluster.storage import MembershipStorage
+from .commands import (
+    AdminCommand,
+    AdminCommandKind,
+    AdminSender,
+    InternalClientSender,
+    SendCommand,
+    ServerInfo,
+)
+from .errors import ServerError
+from .message_router import MessageRouter
+from .object_placement import ObjectPlacement
+from .registry import ObjectId, Registry
+from .service import Service
+from .service_object import LifecycleKind, LifecycleMessage
+
+log = logging.getLogger("rio_tpu.server")
+
+
+class Server:
+    """A node hosting service objects.
+
+    Construct with keyword args (the Python stand-in for the reference's
+    ``bon``-derived builder)::
+
+        server = Server(
+            address="0.0.0.0:0",
+            registry=registry,
+            cluster_provider=provider,
+            object_placement_provider=placement,
+            app_data=app_data,          # optional
+            http_members_address=None,  # optional read-only members API
+        )
+        await server.prepare()
+        await server.bind()
+        await server.run()
+    """
+
+    def __init__(
+        self,
+        *,
+        address: str,
+        registry: Registry,
+        cluster_provider: ClusterProvider,
+        object_placement_provider: ObjectPlacement,
+        app_data: AppData | None = None,
+        http_members_address: str | None = None,
+    ) -> None:
+        self.requested_address = address
+        self.registry = registry
+        self.cluster_provider = cluster_provider
+        self.object_placement = object_placement_provider
+        self.app_data = app_data or AppData()
+        self.http_members_address = http_members_address
+
+        self._listener: asyncio.Server | None = None
+        self._local_addr: str | None = None
+        self._admin = AdminSender()
+        self._internal = InternalClientSender()
+        self._stopped = asyncio.Event()
+        self._conn_tasks: set[asyncio.Task] = set()
+
+        # Inject framework handles (reference server.rs wiring of AppData).
+        self.app_data.set(self._admin)
+        self.app_data.set(self._internal)
+        self.app_data.get_or_default(MessageRouter)
+        self.app_data.set(self.members_storage, as_type=MembershipStorage)
+        self.app_data.set(self.object_placement, as_type=ObjectPlacement)
+
+    # ------------------------------------------------------------------
+
+    @property
+    def members_storage(self) -> MembershipStorage:
+        return self.cluster_provider.members_storage()
+
+    @property
+    def local_address(self) -> str:
+        """The actually-bound address (resolves ``0.0.0.0:0`` ephemeral bind).
+
+        Reference ``server.rs:155-168`` (``try_local_addr``).
+        """
+        if self._local_addr is None:
+            raise ServerError("server is not bound yet")
+        return self._local_addr
+
+    async def prepare(self) -> None:
+        """Run storage migrations (reference ``server.rs:120-125``)."""
+        await self.members_storage.prepare()
+        await self.object_placement.prepare()
+
+    async def bind(self) -> str:
+        host, _, port = self.requested_address.rpartition(":")
+        handler = self._accept
+        self._listener = await asyncio.start_server(handler, host or "0.0.0.0", int(port))
+        sock = self._listener.sockets[0]
+        bound_host, bound_port = sock.getsockname()[:2]
+        if bound_host in ("0.0.0.0", "::"):
+            bound_host = "127.0.0.1"
+        self._local_addr = f"{bound_host}:{bound_port}"
+        self.app_data.set(ServerInfo(self._local_addr))
+        return self._local_addr
+
+    def _service(self) -> Service:
+        return Service(
+            address=self.local_address,
+            registry=self.registry,
+            object_placement=self.object_placement,
+            members_storage=self.members_storage,
+            app_data=self.app_data,
+        )
+
+    async def _accept(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        # Per-connection service instance, as the reference clones its
+        # Service per accepted socket (server.rs:285-305). Track the task so
+        # shutdown actually severs live connections — a stopped node must not
+        # keep serving over previously-accepted sockets.
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
+            task.add_done_callback(self._conn_tasks.discard)
+        await self._service().run(reader, writer)
+
+    # ------------------------------------------------------------------
+    # Internal client + admin consumers (reference server.rs:309-363)
+    # ------------------------------------------------------------------
+
+    async def _consume_internal_commands(self) -> None:
+        from .protocol import RequestEnvelope
+
+        pending: set[asyncio.Task] = set()
+        while True:
+            cmd: SendCommand = await self._internal.queue.get()
+
+            async def dispatch(c: SendCommand) -> None:
+                try:
+                    env = RequestEnvelope(c.handler_type, c.handler_id, c.message_type, c.payload)
+                    resp = await self._service().call(env)
+                    if not c.response.done():
+                        c.response.set_result(resp.to_bytes())
+                except Exception as e:  # noqa: BLE001 — must never hang the sender
+                    if not c.response.done():
+                        c.response.set_exception(e)
+
+            # Spawned, never inline: an actor awaiting this send may hold its
+            # own lock (reference server.rs:309-332 + test_proxy_deadlock).
+            # Strong refs keep tasks alive (asyncio holds only weak ones).
+            task = asyncio.ensure_future(dispatch(cmd))
+            pending.add(task)
+            task.add_done_callback(pending.discard)
+
+    async def _consume_admin_commands(self) -> None:
+        while True:
+            cmd = await self._admin.queue.get()
+            if cmd.kind == AdminCommandKind.SERVER_EXIT:
+                log.info("%s: AdminCommand::ServerExit", self._local_addr)
+                self._stopped.set()
+                return
+            if cmd.kind == AdminCommandKind.SHUTDOWN_OBJECT:
+                await self.shutdown_object(cmd.type_name, cmd.object_id)
+
+    async def shutdown_object(self, type_name: str, object_id: str) -> None:
+        """Run ``before_shutdown``, drop the instance, delete its placement.
+
+        Reference ``server.rs:338-363``.
+        """
+        if self.registry.has(type_name, object_id):
+            with contextlib.suppress(Exception):
+                await self.registry.send(
+                    type_name,
+                    object_id,
+                    LifecycleMessage(kind=LifecycleKind.SHUTDOWN),
+                    self.app_data,
+                )
+        self.registry.remove(type_name, object_id)
+        await self.object_placement.remove(ObjectId(type_name, object_id))
+
+    # ------------------------------------------------------------------
+
+    async def run(self) -> None:
+        """Serve until an admin ``ServerExit`` or cancellation.
+
+        Reference ``server.rs:178-283``: all loops race under one select;
+        any loop finishing tears the node down.
+        """
+        if self._listener is None:
+            await self.bind()
+        assert self._listener is not None
+        tasks = [
+            asyncio.ensure_future(self.cluster_provider.serve(self.local_address)),
+            asyncio.ensure_future(self._consume_internal_commands()),
+            asyncio.ensure_future(self._consume_admin_commands()),
+            asyncio.ensure_future(self._stopped.wait()),
+        ]
+        if self.http_members_address:
+            from .cluster.storage.http import serve_members_http
+
+            tasks.append(
+                asyncio.ensure_future(
+                    serve_members_http(self.http_members_address, self.members_storage)
+                )
+            )
+        try:
+            await asyncio.wait(tasks, return_when=asyncio.FIRST_COMPLETED)
+        finally:
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            self._listener.close()
+            for t in list(self._conn_tasks):
+                t.cancel()
+            await asyncio.gather(*self._conn_tasks, return_exceptions=True)
+            await self._listener.wait_closed()
+            # Leaving the cluster: mark self inactive so peers stop routing here.
+            with contextlib.suppress(Exception):
+                host, _, port = self.local_address.rpartition(":")
+                await self.members_storage.set_inactive(host, int(port))
+
+    def admin_sender(self) -> AdminSender:
+        return self._admin
+
+    async def serve(self) -> None:
+        """Convenience: ``prepare`` + ``bind`` + ``run``."""
+        await self.prepare()
+        await self.bind()
+        await self.run()

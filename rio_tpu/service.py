@@ -1,0 +1,260 @@
+"""Per-connection request engine.
+
+Reference: ``rio-rs/src/service.rs`` — the tower ``Service`` that every
+accepted TCP connection runs through:
+
+* ``call(RequestEnvelope)`` (``:54-110``): placement check → local start →
+  registry dispatch → panic isolation (deallocate on panic).
+* ``get_or_create_placement`` (``:193-254``): directory lookup; prune
+  malformed rows and rows owned by dead nodes; self-assign unplaced objects.
+* ``check_address_mismatch`` (``:261-298``): redirect to a live owner,
+  deallocate when the owner is dead.
+* ``start_service_object`` (``:304-359``): construct + insert + lifecycle
+  ``Load`` with full rollback on failure.
+* ``run(stream)`` (``:370-459``): the length-delimited frame loop, carrying
+  both request/response and subscription streaming.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any
+
+from .app_data import AppData
+from .cluster.storage import MembershipStorage
+from .codec import frame, read_frame
+from .errors import HandlerNotFound, ObjectNotFound, SerializationError, TypeNotFound
+from .message_router import MessageRouter
+from .object_placement import ObjectPlacement, ObjectPlacementItem
+from .protocol import (
+    ErrorKind,
+    RequestEnvelope,
+    ResponseEnvelope,
+    ResponseError,
+    SubscriptionRequest,
+    decode_inbound,
+)
+from .registry import ApplicationRaised, ObjectId, Registry
+from .service_object import LifecycleMessage
+from .tracing import span
+
+log = logging.getLogger("rio_tpu.service")
+
+
+def _address_well_formed(addr: str) -> bool:
+    host, sep, port = addr.rpartition(":")
+    return bool(sep) and bool(host) and port.isdigit()
+
+
+class Service:
+    """Stateless-per-connection request engine; shares node-wide structures."""
+
+    def __init__(
+        self,
+        address: str,
+        registry: Registry,
+        object_placement: ObjectPlacement,
+        members_storage: MembershipStorage,
+        app_data: AppData,
+    ) -> None:
+        self.address = address
+        self.registry = registry
+        self.object_placement = object_placement
+        self.members_storage = members_storage
+        self.app_data = app_data
+
+    # ------------------------------------------------------------------
+    # Placement (reference service.rs:193-298)
+    # ------------------------------------------------------------------
+
+    async def get_or_create_placement(self, object_id: ObjectId) -> str:
+        """Resolve the owning server for ``object_id``, self-assigning if free."""
+        with span("placement_lookup", object=str(object_id)):
+            addr = await self.object_placement.lookup(object_id)
+        if addr is not None:
+            if not _address_well_formed(addr):
+                # Corrupt row: drop it and fall through to self-assign
+                # (reference service.rs:213-221).
+                await self.object_placement.remove(object_id)
+                addr = None
+            elif addr != self.address and not await self.members_storage.is_active(addr):
+                # Owner is dead: bulk-unassign everything it held
+                # (reference service.rs:227-238).
+                await self.object_placement.clean_server(addr)
+                addr = None
+        if addr is None:
+            addr = self.address
+            await self.object_placement.update(
+                ObjectPlacementItem(object_id=object_id, server_address=addr)
+            )
+        return addr
+
+    async def check_address_mismatch(self, addr: str) -> ResponseError | None:
+        """``None`` when this node owns the object; an error to return otherwise."""
+        if addr == self.address:
+            return None
+        if await self.members_storage.is_active(addr):
+            return ResponseError.redirect(addr)
+        await self.object_placement.clean_server(addr)
+        return ResponseError.deallocate()
+
+    # ------------------------------------------------------------------
+    # Activation (reference service.rs:304-359)
+    # ------------------------------------------------------------------
+
+    async def start_service_object(self, object_id: ObjectId) -> ResponseError | None:
+        if self.registry.has(object_id.type_name, object_id.id):
+            return None
+        with span("object_activate", object=str(object_id)):
+            try:
+                obj = self.registry.new_from_type(object_id.type_name, object_id.id)
+            except TypeNotFound:
+                return ResponseError.not_supported(object_id.type_name)
+            self.registry.insert(object_id.type_name, object_id.id, obj)
+            try:
+                await self.registry.send(
+                    object_id.type_name, object_id.id, LifecycleMessage(), self.app_data
+                )
+            except Exception as e:  # lifecycle failure → full rollback
+                self.registry.remove(object_id.type_name, object_id.id)
+                await self.object_placement.remove(object_id)
+                log.warning("activation of %s failed: %r", object_id, e)
+                return ResponseError.allocate(str(e))
+        return None
+
+    # ------------------------------------------------------------------
+    # Request dispatch (reference service.rs:54-110)
+    # ------------------------------------------------------------------
+
+    async def call(self, req: RequestEnvelope) -> ResponseEnvelope:
+        object_id = ObjectId(req.handler_type, req.handler_id)
+        if not self.registry.has_type(req.handler_type):
+            return ResponseEnvelope.err(ResponseError.not_supported(req.handler_type))
+
+        addr = await self.get_or_create_placement(object_id)
+        mismatch = await self.check_address_mismatch(addr)
+        if mismatch is not None:
+            return ResponseEnvelope.err(mismatch)
+
+        start_err = await self.start_service_object(object_id)
+        if start_err is not None:
+            return ResponseEnvelope.err(start_err)
+
+        try:
+            with span("handler_dispatch", object=str(object_id), msg=req.message_type):
+                body = await self.registry.send_raw(
+                    req.handler_type,
+                    req.handler_id,
+                    req.message_type,
+                    req.payload,
+                    self.app_data,
+                )
+            return ResponseEnvelope.ok(body)
+        except ApplicationRaised as e:
+            # Typed user error: object stays alive (reference Err path).
+            return ResponseEnvelope.err(ResponseError.application(e.payload, e.type_name))
+        except HandlerNotFound as e:
+            return ResponseEnvelope.err(ResponseError.not_supported(str(e)))
+        except ObjectNotFound:
+            # Lost a race with shutdown; tell the client to retry/allocate.
+            return ResponseEnvelope.err(ResponseError.allocate("object disappeared"))
+        except SerializationError as e:
+            # Malformed payload / unserializable result: the actor never ran
+            # (or ran fine); a bad byte blob must not deallocate a healthy
+            # object.
+            return ResponseEnvelope.err(
+                ResponseError(kind=ErrorKind.SERIALIZATION, detail=str(e))
+            )
+        except Exception as e:  # noqa: BLE001 — "panic" isolation
+            # Reference service.rs:92-107: catch_unwind → deallocate → Unknown.
+            self.registry.remove(req.handler_type, req.handler_id)
+            await self.object_placement.remove(object_id)
+            log.exception("handler panic for %s", object_id)
+            return ResponseEnvelope.err(ResponseError.unknown(f"Panic: {e!r}"))
+
+    # ------------------------------------------------------------------
+    # Subscription dispatch (reference service.rs:151-185)
+    # ------------------------------------------------------------------
+
+    async def subscribe(self, req: SubscriptionRequest) -> ResponseError | asyncio.Queue:
+        object_id = ObjectId(req.handler_type, req.handler_id)
+        if not self.registry.has_type(req.handler_type):
+            return ResponseError.not_supported(req.handler_type)
+        addr = await self.get_or_create_placement(object_id)
+        mismatch = await self.check_address_mismatch(addr)
+        if mismatch is not None:
+            return mismatch
+        start_err = await self.start_service_object(object_id)
+        if start_err is not None:
+            return start_err
+        router = self.app_data.get(MessageRouter)
+        return router.create_subscription(req.handler_type, req.handler_id)
+
+    # ------------------------------------------------------------------
+    # Connection loop (reference service.rs:370-459)
+    # ------------------------------------------------------------------
+
+    async def run(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        """Serve one TCP connection until EOF.
+
+        Requests are answered in order (the wire has no correlation ids, as
+        in the reference); a subscription request switches the connection
+        into streaming mode until the peer disconnects.
+        """
+        peer = writer.get_extra_info("peername")
+        try:
+            while True:
+                payload = await read_frame(reader)
+                if payload is None:
+                    return
+                try:
+                    inbound = decode_inbound(payload)
+                except Exception as e:  # malformed frame → error response
+                    resp = ResponseEnvelope.err(ResponseError.unknown(f"bad frame: {e}"))
+                    writer.write(frame(resp.to_bytes()))
+                    await writer.drain()
+                    continue
+                if isinstance(inbound, RequestEnvelope):
+                    resp = await self.call(inbound)
+                    writer.write(frame(resp.to_bytes()))
+                    await writer.drain()
+                else:
+                    await self._stream_subscription(inbound, writer)
+                    return
+        except (ConnectionError, asyncio.CancelledError):
+            raise
+        except SerializationError as e:
+            # Unframeable input (e.g. oversized length header): drop the
+            # connection; nothing sane can follow on this byte stream.
+            log.warning("dropping connection %s: %s", peer, e)
+        except Exception:
+            log.exception("connection loop error (peer=%s)", peer)
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _stream_subscription(
+        self, req: SubscriptionRequest, writer: asyncio.StreamWriter
+    ) -> None:
+        from .protocol import SubscriptionResponse
+
+        result = await self.subscribe(req)
+        if isinstance(result, ResponseError):
+            writer.write(frame(SubscriptionResponse(error=result).to_bytes()))
+            await writer.drain()
+            return
+        queue = result
+        router = self.app_data.get(MessageRouter)
+        try:
+            while True:
+                item = await queue.get()
+                writer.write(frame(item.to_bytes()))
+                await writer.drain()
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            router.drop_subscription(req.handler_type, req.handler_id, queue)

@@ -1,0 +1,120 @@
+"""ServiceObject: the actor base class.
+
+Reference: ``rio-rs/src/service_object.rs`` — lifecycle hooks
+(``:85-116``), the static in-server ``send`` (``:52-83``), ``WithId``
+(``:33-36``), and the blanket ``Handler<LifecycleMessage>`` (``:129-164``).
+
+A service object is addressed by ``ObjectId(type_name, id)``; the framework
+constructs it on demand on whichever node placement chose, drives its
+lifecycle (``before_load`` → state load → ``after_load``; ``before_shutdown``
+→ removal), and serializes handler execution per object.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Any, TypeVar
+
+from . import codec
+from .app_data import AppData
+from .commands import AdminCommand, AdminSender, InternalClientSender
+from .errors import ServiceObjectLifeCycleError
+from .protocol import ErrorKind, ResponseEnvelope
+from .registry import decode_error, handler, message, type_id
+
+T = TypeVar("T")
+
+
+class LifecycleKind(Enum):
+    LOAD = "load"
+    SHUTDOWN = "shutdown"
+
+
+@message(name="rio.LifecycleMessage")
+class LifecycleMessage:
+    """Framework-internal activation/deactivation signal.
+
+    Reference ``service_object.rs:129-141``; ``Load`` is sent right after an
+    object is constructed and inserted (``service.rs:330-343``).
+    """
+
+    kind: LifecycleKind = LifecycleKind.LOAD
+
+
+class ServiceObject:
+    """Base class for all actors. Subclasses add ``@handler`` methods.
+
+    The ``id`` attribute plays the reference's ``WithId`` role; it is set by
+    the registry right after construction.
+    """
+
+    id: str = ""
+
+    # -- lifecycle hooks (reference service_object.rs:85-116) ---------------
+
+    async def before_load(self, ctx: AppData) -> None:  # noqa: ARG002
+        return None
+
+    async def after_load(self, ctx: AppData) -> None:  # noqa: ARG002
+        return None
+
+    async def before_shutdown(self, ctx: AppData) -> None:  # noqa: ARG002
+        return None
+
+    async def load_state(self, ctx: AppData) -> None:  # noqa: ARG002
+        """Pull persisted state. Overridden by ``@managed_state`` (see
+        :mod:`rio_tpu.state.managed`); default is stateless."""
+        return None
+
+    @handler
+    async def _handle_lifecycle(self, msg: LifecycleMessage, ctx: AppData) -> None:
+        """Blanket lifecycle handler (reference ``service_object.rs:150-163``)."""
+        if msg.kind == LifecycleKind.LOAD:
+            try:
+                await self.before_load(ctx)
+                await self.load_state(ctx)
+                await self.after_load(ctx)
+            except Exception as e:
+                raise ServiceObjectLifeCycleError(str(e)) from e
+        elif msg.kind == LifecycleKind.SHUTDOWN:
+            await self.before_shutdown(ctx)
+
+    # -- in-server messaging (reference service_object.rs:52-83) ------------
+
+    @staticmethod
+    async def send(
+        ctx: AppData,
+        handler_type: str | type,
+        handler_id: str,
+        msg: Any,
+        returns: Any = Any,
+    ) -> Any:
+        """Message another object through this node's own dispatch path.
+
+        Goes through the server's internal-client queue — the full placement
+        → start → dispatch path — so the target may live anywhere in the
+        cluster (a remote owner surfaces as a ``Redirect`` error here, as in
+        the reference; use a real Client for cross-node fan-out).
+        """
+        tname = handler_type if isinstance(handler_type, str) else type_id(handler_type)
+        sender = ctx.get(InternalClientSender)
+        raw = await sender.send(tname, handler_id, type_id(type(msg)), codec.serialize(msg))
+        env = ResponseEnvelope.from_bytes(raw)
+        if env.is_ok:
+            return codec.deserialize(env.body, returns)
+        err = env.error
+        assert err is not None
+        if err.kind == ErrorKind.APPLICATION:
+            raise decode_error(err.payload, err.detail)
+        from .errors import HandlerError
+
+        raise HandlerError(f"{err.kind.name}: {err.detail}")
+
+    async def shutdown(self, ctx: AppData) -> None:
+        """Request this object's removal from its hosting server.
+
+        Reference ``service_object.rs`` + ``server.rs:338-363`` admin path:
+        the server runs ``before_shutdown``, drops the instance from the
+        registry, and deletes its placement row.
+        """
+        ctx.get(AdminSender).send(AdminCommand.shutdown(type_id(type(self)), self.id))

@@ -1,0 +1,65 @@
+"""Request-path tracing spans.
+
+Reference: the ``tracing`` crate spans on the hot path
+(``rio-rs/src/service.rs:192,260,303,369``; ``registry/mod.rs:151-176``),
+exported app-side via OpenTelemetry (observability example). Here: a
+zero-dependency span API that records name, duration, and key/values; sinks
+are pluggable (logging sink provided; an OTLP sink can be registered by the
+application the same way the reference wires ``tracing_subscriber``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+log = logging.getLogger("rio_tpu.trace")
+
+_SINKS: list[Callable[["Span"], None]] = []
+_ENABLED = False
+
+
+@dataclass
+class Span:
+    name: str
+    attrs: dict[str, Any] = field(default_factory=dict)
+    start: float = 0.0
+    duration: float = 0.0
+
+
+def add_sink(sink: Callable[[Span], None]) -> None:
+    """Register a span consumer (e.g. an OTLP exporter bridge)."""
+    global _ENABLED
+    _SINKS.append(sink)
+    _ENABLED = True
+
+
+def clear_sinks() -> None:
+    global _ENABLED
+    _SINKS.clear()
+    _ENABLED = False
+
+
+def logging_sink(span: Span) -> None:
+    log.debug("span %s %.3fms %s", span.name, span.duration * 1e3, span.attrs)
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs: Any):
+    """Trace a block. Near-free when no sink is registered."""
+    if not _ENABLED:
+        yield None
+        return
+    s = Span(name=name, attrs=attrs, start=time.perf_counter())
+    try:
+        yield s
+    finally:
+        s.duration = time.perf_counter() - s.start
+        for sink in _SINKS:
+            try:
+                sink(s)
+            except Exception:  # sinks must never break the request path
+                log.exception("trace sink failed")

@@ -1,0 +1,6 @@
+"""Small shared utilities (LRU cache, backoff policy, async helpers)."""
+
+from .lru import LruCache
+from .backoff import ExponentialBackoff
+
+__all__ = ["LruCache", "ExponentialBackoff"]

@@ -1,0 +1,28 @@
+"""Exponential backoff policy for the client retry middleware.
+
+Reference: ``rio-rs/src/client/tower_services.rs:142-146`` — 1 µs doubling
+to a 2 s cap, at most 20 retries.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+
+
+@dataclasses.dataclass
+class ExponentialBackoff:
+    initial: float = 1e-6
+    cap: float = 2.0
+    factor: float = 2.0
+    max_retries: int = 20
+
+    def delays(self):
+        """Yield ``max_retries`` sleep durations."""
+        d = self.initial
+        for _ in range(self.max_retries):
+            yield min(d, self.cap)
+            d *= self.factor
+
+    async def sleep(self, attempt: int) -> None:
+        await asyncio.sleep(min(self.initial * (self.factor**attempt), self.cap))

@@ -1,0 +1,132 @@
+"""Unit tests for the value codec + framing (rio_tpu.codec)."""
+
+from dataclasses import dataclass, field
+from enum import Enum, IntEnum
+from typing import Any, Optional
+
+import pytest
+
+from rio_tpu import codec
+from rio_tpu.errors import SerializationError
+
+
+@dataclass
+class Inner:
+    x: int
+    y: float
+
+
+@dataclass
+class Outer:
+    name: str
+    inner: Inner
+    tags: list[str]
+    blob: bytes
+    maybe: Optional[int] = None
+    table: dict[str, int] = field(default_factory=dict)
+
+
+class Color(Enum):
+    RED = "red"
+    BLUE = "blue"
+
+
+class Level(IntEnum):
+    LOW = 1
+    HIGH = 2
+
+
+def test_primitive_roundtrip():
+    for v in (1, -5, 0, 3.25, "hello", b"\x00\xff", True, False, None):
+        assert codec.deserialize(codec.serialize(v), type(v) if v is not None else Any) == v
+
+
+def test_dataclass_roundtrip():
+    o = Outer("a", Inner(1, 2.5), ["t1", "t2"], b"xyz", maybe=7, table={"k": 1})
+    assert codec.deserialize(codec.serialize(o), Outer) == o
+
+
+def test_dataclass_is_positional_compact():
+    # bincode-like: no field names on the wire
+    data = codec.serialize(Inner(1, 2.0))
+    assert b"x" not in data and b"y" not in data
+
+
+def test_optional_none_roundtrip():
+    o = Outer("a", Inner(0, 0.0), [], b"")
+    assert codec.deserialize(codec.serialize(o), Outer).maybe is None
+
+
+def test_enum_roundtrip():
+    assert codec.deserialize(codec.serialize(Color.BLUE), Color) is Color.BLUE
+    assert codec.deserialize(codec.serialize(Level.HIGH), Level) is Level.HIGH
+
+
+def test_nested_containers():
+    v = {"a": [Inner(1, 1.0), Inner(2, 2.0)]}
+    out = codec.deserialize(codec.serialize(v), dict[str, list[Inner]])
+    assert out == v
+
+
+def test_tuple_and_set():
+    assert codec.deserialize(codec.serialize((1, "a")), tuple[int, str]) == (1, "a")
+    assert codec.deserialize(codec.serialize({3, 1, 2}), set[int]) == {1, 2, 3}
+
+
+def test_schema_evolution_appended_field_tolerated():
+    # Old reader (Inner) can decode wire written with extra trailing data? No:
+    # extra fields are an error (strict, like bincode).
+    data = codec.serialize([1, 2.0, "extra"])
+    with pytest.raises(SerializationError):
+        codec.deserialize(data, Inner)
+
+
+def test_missing_trailing_optional_fields_defaulted():
+    # New reader with appended default field decodes old wire.
+    @dataclass
+    class InnerV2:
+        x: int
+        y: float
+        z: str = "default"
+
+    data = codec.serialize(Inner(5, 6.0))
+    v2 = codec.deserialize(data, InnerV2)
+    assert (v2.x, v2.y, v2.z) == (5, 6.0, "default")
+
+
+def test_unserializable_raises():
+    class NotAMessage:
+        pass
+
+    with pytest.raises(SerializationError):
+        codec.serialize(NotAMessage())
+
+
+def test_type_mismatch_raises():
+    with pytest.raises(SerializationError):
+        codec.deserialize(codec.serialize("str"), int)
+
+
+def test_frame_roundtrip():
+    f = codec.frame(b"hello")
+    assert f[:4] == (5).to_bytes(4, "big")
+    r = codec.FrameReader()
+    assert r.feed(f) == [b"hello"]
+
+
+def test_frame_reader_partial_and_multiple():
+    f1, f2 = codec.frame(b"aa"), codec.frame(b"bbb")
+    stream = f1 + f2
+    r = codec.FrameReader()
+    out = []
+    for i in range(0, len(stream), 3):  # drip-feed 3 bytes at a time
+        out.extend(r.feed(stream[i : i + 3]))
+    assert out == [b"aa", b"bbb"]
+
+
+def test_frame_too_large_rejected():
+    with pytest.raises(SerializationError):
+        codec.frame(b"x" * (codec.MAX_FRAME + 1))
+    r = codec.FrameReader()
+    with pytest.raises(SerializationError):
+        r.feed((codec.MAX_FRAME + 1).to_bytes(4, "big"))

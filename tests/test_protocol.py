@@ -1,0 +1,78 @@
+"""Unit tests for wire envelopes (rio_tpu.protocol)."""
+
+import pytest
+
+from rio_tpu import protocol
+from rio_tpu.errors import SerializationError
+from rio_tpu.protocol import (
+    ErrorKind,
+    RequestEnvelope,
+    ResponseEnvelope,
+    ResponseError,
+    SubscriptionRequest,
+    SubscriptionResponse,
+)
+
+
+def test_request_envelope_roundtrip():
+    env = RequestEnvelope("Svc", "obj-1", "Ping", b"\x01\x02")
+    assert RequestEnvelope.from_bytes(env.to_bytes()) == env
+
+
+def test_response_ok_roundtrip():
+    env = ResponseEnvelope.ok(b"result")
+    out = ResponseEnvelope.from_bytes(env.to_bytes())
+    assert out.is_ok and out.body == b"result"
+
+
+@pytest.mark.parametrize(
+    "err",
+    [
+        ResponseError.redirect("10.0.0.1:9000"),
+        ResponseError.deallocate(),
+        ResponseError.allocate("boom"),
+        ResponseError.not_supported("NoSuchType"),
+        ResponseError.application(b"payload", "MyError"),
+        ResponseError.unknown("Panic: ..."),
+    ],
+)
+def test_response_error_roundtrip(err):
+    out = ResponseEnvelope.from_bytes(ResponseEnvelope.err(err).to_bytes())
+    assert not out.is_ok
+    assert out.error == err
+
+
+def test_redirect_carries_address():
+    out = ResponseEnvelope.from_bytes(
+        ResponseEnvelope.err(ResponseError.redirect("1.2.3.4:5")).to_bytes()
+    )
+    assert out.error.kind == ErrorKind.REDIRECT
+    assert out.error.detail == "1.2.3.4:5"
+
+
+def test_subscription_roundtrips():
+    req = SubscriptionRequest("Svc", "obj")
+    assert SubscriptionRequest.from_bytes(req.to_bytes()) == req
+
+    ok = SubscriptionResponse(body=b"data", message_type="Tick")
+    out = SubscriptionResponse.from_bytes(ok.to_bytes())
+    assert out.error is None and out.body == b"data" and out.message_type == "Tick"
+
+    err = SubscriptionResponse(error=ResponseError.redirect("a:1"))
+    out = SubscriptionResponse.from_bytes(err.to_bytes())
+    assert out.error is not None and out.error.kind == ErrorKind.REDIRECT
+
+
+def test_frame_kind_dispatch():
+    req = RequestEnvelope("S", "i", "M", b"")
+    decoded = protocol.decode_inbound(protocol.KIND_REQUEST + req.to_bytes())
+    assert isinstance(decoded, RequestEnvelope)
+
+    sub = SubscriptionRequest("S", "i")
+    decoded = protocol.decode_inbound(protocol.KIND_SUBSCRIBE + sub.to_bytes())
+    assert isinstance(decoded, SubscriptionRequest)
+
+    with pytest.raises(SerializationError):
+        protocol.decode_inbound(b"\x07junk")
+    with pytest.raises(SerializationError):
+        protocol.decode_inbound(b"")

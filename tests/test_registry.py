@@ -1,0 +1,169 @@
+"""Unit tests for the actor registry + dispatch (rio_tpu.registry)."""
+
+import asyncio
+
+import pytest
+
+from rio_tpu import codec
+from rio_tpu.app_data import AppData
+from rio_tpu.errors import HandlerNotFound, ObjectNotFound, TypeNotFound
+from rio_tpu.registry import (
+    ApplicationRaised,
+    ObjectId,
+    Registry,
+    decode_error,
+    handler,
+    message,
+    type_id,
+    type_name,
+    wire_error,
+)
+from rio_tpu.service_object import ServiceObject
+
+
+@message
+class Ping:
+    n: int = 0
+
+
+@message
+class Pong:
+    n: int = 0
+
+
+@wire_error
+class TooMany(Exception):
+    pass
+
+
+class Counter(ServiceObject):
+    def __init__(self):
+        self.count = 0
+
+    @handler
+    async def ping(self, msg: Ping, ctx: AppData) -> Pong:
+        self.count += msg.n
+        if self.count > 100:
+            raise TooMany(self.count)
+        return Pong(n=self.count)
+
+    @handler
+    async def slow(self, msg: Pong, ctx: AppData) -> int:
+        before = self.count
+        await asyncio.sleep(0.01)
+        self.count = before + 1
+        return self.count
+
+
+def make_registry() -> Registry:
+    r = Registry()
+    r.add_type(Counter)
+    return r
+
+
+def test_object_id_str():
+    assert str(ObjectId("Counter", "a")) == "Counter.a"
+
+
+def test_type_name_override():
+    @type_name("wire.Name")
+    class X:
+        pass
+
+    assert type_id(X) == "wire.Name"
+
+
+def test_registration_introspection():
+    r = make_registry()
+    assert r.has_type("Counter")
+    assert r.has_handler("Counter", "Ping")
+    assert r.has_handler("Counter", "rio.LifecycleMessage")  # blanket lifecycle
+    assert not r.has_handler("Counter", "Nope")
+
+
+def test_new_from_type_sets_id():
+    r = make_registry()
+    obj = r.new_from_type("Counter", "c1")
+    assert isinstance(obj, Counter) and obj.id == "c1"
+    with pytest.raises(TypeNotFound):
+        r.new_from_type("Ghost", "x")
+
+
+@pytest.mark.asyncio
+async def test_dispatch_roundtrip():
+    r = make_registry()
+    r.insert("Counter", "c1", r.new_from_type("Counter", "c1"))
+    out = await r.send("Counter", "c1", Ping(n=5), AppData())
+    assert out == Pong(n=5)
+    out = await r.send("Counter", "c1", Ping(n=2), AppData())
+    assert out == Pong(n=7)
+
+
+@pytest.mark.asyncio
+async def test_dispatch_routing_errors():
+    r = make_registry()
+    with pytest.raises(ObjectNotFound):
+        await r.send("Counter", "ghost", Ping(), AppData())
+    r.insert("Counter", "c1", r.new_from_type("Counter", "c1"))
+    with pytest.raises(HandlerNotFound):
+        await r.send_raw("Counter", "c1", "NoSuchMsg", b"", AppData())
+
+
+@pytest.mark.asyncio
+async def test_typed_error_tunneling():
+    r = make_registry()
+    r.insert("Counter", "c1", r.new_from_type("Counter", "c1"))
+    with pytest.raises(ApplicationRaised) as ei:
+        await r.send("Counter", "c1", Ping(n=101), AppData())
+    # Client side: reconstruct the typed exception from the wire payload.
+    exc = decode_error(ei.value.payload, ei.value.type_name)
+    assert isinstance(exc, TooMany)
+    assert exc.args == (101,)
+
+
+@pytest.mark.asyncio
+async def test_unregistered_exception_propagates_raw():
+    class Bad(ServiceObject):
+        @handler
+        async def boom(self, msg: Ping, ctx: AppData) -> None:
+            raise RuntimeError("panic!")
+
+    r = Registry()
+    r.add_type(Bad)
+    r.insert("Bad", "b", r.new_from_type("Bad", "b"))
+    with pytest.raises(RuntimeError):
+        await r.send("Bad", "b", Ping(), AppData())
+
+
+@pytest.mark.asyncio
+async def test_per_object_serialized_execution():
+    """Concurrent sends to one object run one at a time (no lost updates)."""
+    r = make_registry()
+    r.insert("Counter", "c1", r.new_from_type("Counter", "c1"))
+    await asyncio.gather(*(r.send("Counter", "c1", Pong(), AppData()) for _ in range(20)))
+    assert r.get("Counter", "c1").count == 20
+
+
+@pytest.mark.asyncio
+async def test_different_objects_run_concurrently():
+    r = make_registry()
+    for i in range(10):
+        r.insert("Counter", f"c{i}", r.new_from_type("Counter", f"c{i}"))
+    start = asyncio.get_event_loop().time()
+    await asyncio.gather(
+        *(r.send("Counter", f"c{i}", Pong(), AppData()) for i in range(10))
+    )
+    elapsed = asyncio.get_event_loop().time() - start
+    # 10 × 10ms sleeps overlapping, not serialized (≪ 100ms).
+    assert elapsed < 0.08
+
+
+def test_remove_and_count():
+    r = make_registry()
+    r.insert("Counter", "c1", r.new_from_type("Counter", "c1"))
+    assert r.count_objects() == 1
+    assert r.object_ids() == [ObjectId("Counter", "c1")]
+    obj = r.remove("Counter", "c1")
+    assert isinstance(obj, Counter)
+    assert r.count_objects() == 0
+    assert r.remove("Counter", "c1") is None
