@@ -129,6 +129,134 @@ def deserialize(data: bytes, ty: Any) -> Any:
 
 
 # ---------------------------------------------------------------------------
+# JSON flavor — used by state persistence (the reference persists actor state
+# as serde_json strings, ``rio-rs/src/state/sqlite.rs:54-115``), so stored
+# state stays human-inspectable. Dataclasses serialize as *objects* here (not
+# positional arrays): durable data should survive field reordering.
+# ---------------------------------------------------------------------------
+
+import json as _json
+
+
+def _json_key(key: Any) -> str:
+    if isinstance(key, Enum):
+        key = key.value
+    if isinstance(key, bool):
+        return "true" if key else "false"
+    if isinstance(key, (str, int, float)):
+        return str(key)
+    raise SerializationError(f"cannot json-serialize dict key {type(key)!r}")
+
+
+def _to_json(value: Any) -> Any:
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        return {f.name: _to_json(getattr(value, f.name)) for f in dataclasses.fields(value)}
+    if isinstance(value, Enum):
+        return value.value
+    if isinstance(value, (list, tuple, set, frozenset)):
+        return [_to_json(v) for v in value]
+    if isinstance(value, dict):
+        return {_json_key(k): _to_json(v) for k, v in value.items()}
+    if isinstance(value, bytes):
+        return {"__bytes__": value.hex()}
+    if isinstance(value, (str, bool, int, float)) or value is None:
+        return value
+    raise SerializationError(f"cannot json-serialize {type(value)!r}")
+
+
+def _key_from_json(key: str, ty: Any) -> Any:
+    try:
+        if ty is int:
+            return int(key)
+        if ty is float:
+            return float(key)
+        if ty is bool:
+            return key == "true"
+        if isinstance(ty, type) and issubclass(ty, Enum):
+            member = next((m for m in ty if str(m.value) == key), None)
+            if member is None:
+                raise SerializationError(f"no {ty.__name__} member with value {key!r}")
+            return member
+    except ValueError as e:
+        raise SerializationError(f"bad dict key {key!r} for {ty}: {e}") from e
+    return key
+
+
+def _from_json(wire: Any, ty: Any) -> Any:
+    # The bytes sentinel is only honored where the schema expects bytes (or
+    # is untyped): a declared dict field can legitimately contain that key.
+    if ty is bytes:
+        if isinstance(wire, dict) and set(wire) == {"__bytes__"}:
+            try:
+                return bytes.fromhex(wire["__bytes__"])
+            except (TypeError, ValueError) as e:
+                raise SerializationError(f"bad __bytes__ payload: {e}") from e
+        raise SerializationError("expected bytes sentinel")
+    if ty is Any and isinstance(wire, dict) and set(wire) == {"__bytes__"}:
+        try:
+            return bytes.fromhex(wire["__bytes__"])
+        except (TypeError, ValueError):
+            return wire
+    if get_origin(ty) is typing.Union or isinstance(ty, types.UnionType):
+        args = get_args(ty)
+        if wire is None and _NONE_TYPE in args:
+            return None
+        for a in args:
+            if a is _NONE_TYPE:
+                continue
+            try:
+                return _from_json(wire, a)
+            except (SerializationError, TypeError, ValueError):
+                continue
+        raise SerializationError(f"no Union arm of {ty} matched JSON value")
+    if dataclasses.is_dataclass(ty) and isinstance(wire, dict):
+        hints = get_type_hints(ty)
+        fields = {f.name for f in dataclasses.fields(ty)}
+        unknown = set(wire) - fields
+        if unknown:
+            raise SerializationError(f"{ty.__name__}: unknown state fields {unknown}")
+        return ty(**{k: _from_json(v, hints.get(k, Any)) for k, v in wire.items()})
+    if dataclasses.is_dataclass(ty):
+        raise SerializationError(f"expected object for dataclass {ty.__name__}")
+    origin = get_origin(ty)
+    if origin in (list, tuple, set, frozenset):
+        if not isinstance(wire, list):
+            raise SerializationError(f"expected array for {ty}")
+        elem = (get_args(ty) or (Any,))[0]
+        return origin(_from_json(v, elem) for v in wire)
+    if origin is dict:
+        if not isinstance(wire, dict):
+            raise SerializationError(f"expected object for {ty}")
+        args = get_args(ty) or (Any, Any)
+        return {_key_from_json(k, args[0]): _from_json(v, args[1]) for k, v in wire.items()}
+    if isinstance(ty, type) and issubclass(ty, Enum):
+        try:
+            return ty(wire)
+        except ValueError as e:
+            raise SerializationError(str(e)) from e
+    if ty is float and isinstance(wire, int):
+        return float(wire)
+    if isinstance(ty, type) and ty is not Any and not isinstance(wire, ty):
+        raise SerializationError(f"expected {ty.__name__}, got {type(wire).__name__}")
+    return wire
+
+
+def serialize_json(value: Any) -> str:
+    try:
+        return _json.dumps(_to_json(value))
+    except (TypeError, ValueError) as e:
+        raise SerializationError(str(e)) from e
+
+
+def deserialize_json(data: str, ty: Any) -> Any:
+    try:
+        wire = _json.loads(data)
+    except ValueError as e:
+        raise SerializationError(str(e)) from e
+    return _from_json(wire, ty)
+
+
+# ---------------------------------------------------------------------------
 # Framing
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,64 @@
+"""SQLite object-placement directory.
+
+Reference: ``rio-rs/src/object_placement/sqlite.rs`` — table
+``object_placement(struct_name, object_id, server_address)`` with an index
+on ``server_address``; upsert (``:68-85``), lookup (``:86-100``),
+``clean_server`` DELETE-by-address (``:101-112``).
+"""
+
+from __future__ import annotations
+
+from ..registry import ObjectId
+from ..utils.sqlite import SqliteDb
+from . import ObjectPlacement, ObjectPlacementItem
+
+MIGRATIONS = [
+    """
+    CREATE TABLE IF NOT EXISTS object_placement (
+        struct_name    TEXT NOT NULL,
+        object_id      TEXT NOT NULL,
+        server_address TEXT,
+        PRIMARY KEY (struct_name, object_id)
+    );
+    CREATE INDEX IF NOT EXISTS idx_object_placement_server
+        ON object_placement (server_address);
+    """
+]
+
+
+class SqliteObjectPlacement(ObjectPlacement):
+    def __init__(self, path: str) -> None:
+        self.db = SqliteDb(path)
+
+    async def prepare(self) -> None:
+        await self.db.migrate(MIGRATIONS)
+
+    async def update(self, item: ObjectPlacementItem) -> None:
+        await self.db.execute(
+            "INSERT INTO object_placement (struct_name, object_id, server_address) "
+            "VALUES (?,?,?) ON CONFLICT(struct_name, object_id) "
+            "DO UPDATE SET server_address=excluded.server_address",
+            item.object_id.type_name, item.object_id.id, item.server_address,
+        )
+
+    async def lookup(self, object_id: ObjectId) -> str | None:
+        rows = await self.db.execute(
+            "SELECT server_address FROM object_placement "
+            "WHERE struct_name=? AND object_id=?",
+            object_id.type_name, object_id.id,
+        )
+        return rows[0][0] if rows else None
+
+    async def clean_server(self, address: str) -> None:
+        await self.db.execute(
+            "DELETE FROM object_placement WHERE server_address=?", address
+        )
+
+    async def remove(self, object_id: ObjectId) -> None:
+        await self.db.execute(
+            "DELETE FROM object_placement WHERE struct_name=? AND object_id=?",
+            object_id.type_name, object_id.id,
+        )
+
+    def close(self) -> None:
+        self.db.close()

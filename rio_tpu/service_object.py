@@ -61,10 +61,23 @@ class ServiceObject:
     async def before_shutdown(self, ctx: AppData) -> None:  # noqa: ARG002
         return None
 
-    async def load_state(self, ctx: AppData) -> None:  # noqa: ARG002
-        """Pull persisted state. Overridden by ``@managed_state`` (see
-        :mod:`rio_tpu.state.managed`); default is stateless."""
-        return None
+    async def load_state(self, ctx: AppData) -> None:
+        """Pull persisted state for every ``managed_state`` field.
+
+        The default covers the common case (reference's
+        ``#[derive(ManagedState)]`` + ``ServiceObjectStateLoad`` blanket);
+        objects with custom persistence override this.
+        """
+        from .state import load_state as _load_managed
+
+        await _load_managed(self, ctx)
+
+    async def save_state(self, ctx: AppData, field_name: str | None = None) -> None:
+        """Persist managed fields (all, or one by name). Handler-driven, as
+        in the reference (``ObjectStateManager::save_state``)."""
+        from .state import save_state as _save_managed
+
+        await _save_managed(self, ctx, field_name)
 
     @handler
     async def _handle_lifecycle(self, msg: LifecycleMessage, ctx: AppData) -> None:

@@ -112,24 +112,45 @@ async def run_integration_test(
     cluster.tasks = [asyncio.create_task(s.run()) for s in servers]
     try:
         await wait_for_active_members(members, num_servers)
-        # Race the test against server crashes and the timeout
-        # (reference tokio::select! at server_utils.rs:92-101).
+        # Race the test against *all* servers exiting and the timeout
+        # (reference tokio::select! over join_all(servers) vs test vs sleep,
+        # server_utils.rs:92-101 — a single server exiting is a legitimate
+        # event some tests trigger on purpose).
         test = asyncio.create_task(test_fn(cluster))
+        # A server finishing *cleanly* (admin exit) is legitimate; a server
+        # crashing with an exception fails the test immediately with that
+        # exception, and all-servers-gone fails it too.
+        crash: asyncio.Future = asyncio.get_event_loop().create_future()
+        remaining = len(cluster.tasks)
+
+        def on_server_done(t: asyncio.Task) -> None:
+            nonlocal remaining
+            remaining -= 1
+            if crash.done():
+                return
+            exc = None if t.cancelled() else t.exception()
+            if exc is not None:
+                crash.set_exception(exc)
+            elif remaining == 0:
+                crash.set_exception(
+                    AssertionError("every server exited before the test completed")
+                )
+
+        for t in cluster.tasks:
+            t.add_done_callback(on_server_done)
         done, _ = await asyncio.wait(
-            [test, *cluster.tasks],
-            timeout=timeout,
-            return_when=asyncio.FIRST_COMPLETED,
+            [test, crash], timeout=timeout, return_when=asyncio.FIRST_COMPLETED
         )
         if not done:
             test.cancel()
+            crash.cancel()
             raise TimeoutError(f"integration test timed out after {timeout}s")
         if test in done:
+            crash.cancel()
             test.result()  # re-raise test failures
         else:
-            finished = next(iter(done))
-            exc = finished.exception()
             test.cancel()
-            raise AssertionError(f"server exited before test completed: {exc!r}")
+            crash.result()  # raises the server's exception
     finally:
         for t in cluster.tasks:
             t.cancel()

@@ -1,0 +1,156 @@
+"""Backend matrix tests: generic trait-level sanity checks instantiated per
+backend (reference ``tests/cluster_storage_backend.rs``,
+``tests/object_placement_backend.rs``, ``tests/state.rs``)."""
+
+import pytest
+
+from rio_tpu.cluster.storage import LocalStorage, Member, MembershipStorage
+from rio_tpu.cluster.storage.sqlite import SqliteMembershipStorage
+from rio_tpu.errors import StateNotFound
+from rio_tpu.object_placement import (
+    LocalObjectPlacement,
+    ObjectId,
+    ObjectPlacement,
+    ObjectPlacementItem,
+)
+from rio_tpu.object_placement.sqlite import SqliteObjectPlacement
+from rio_tpu.state import LocalState, StateProvider
+from rio_tpu.state.sqlite import SqliteState
+from rio_tpu.registry import message
+
+
+# ---------------------------------------------------------------------------
+# membership
+# ---------------------------------------------------------------------------
+
+
+def membership_backends(tmp_path):
+    return [LocalStorage(), SqliteMembershipStorage(str(tmp_path / "members.db"))]
+
+
+async def check_membership(storage: MembershipStorage):
+    await storage.prepare()
+    await storage.push(Member(ip="10.0.0.1", port=5000, active=True))
+    await storage.push(Member(ip="10.0.0.2", port=5001, active=False))
+    members = await storage.members()
+    assert {m.address for m in members} == {"10.0.0.1:5000", "10.0.0.2:5001"}
+    assert [m.address for m in await storage.active_members()] == ["10.0.0.1:5000"]
+    assert await storage.is_active("10.0.0.1:5000")
+    assert not await storage.is_active("10.0.0.2:5001")
+
+    # upsert semantics
+    await storage.push(Member(ip="10.0.0.2", port=5001, active=True))
+    assert await storage.is_active("10.0.0.2:5001")
+    assert len(await storage.members()) == 2
+
+    # activity flips
+    await storage.set_inactive("10.0.0.1", 5000)
+    assert not await storage.is_active("10.0.0.1:5000")
+    await storage.set_active("10.0.0.1", 5000)
+    assert await storage.is_active("10.0.0.1:5000")
+
+    # failure ledger
+    assert await storage.member_failures("10.0.0.1", 5000) == []
+    await storage.notify_failure("10.0.0.1", 5000)
+    await storage.notify_failure("10.0.0.1", 5000)
+    failures = await storage.member_failures("10.0.0.1", 5000)
+    assert len(failures) == 2 and all(isinstance(f, float) for f in failures)
+
+    # removal clears both member and failures
+    await storage.remove("10.0.0.1", 5000)
+    assert len(await storage.members()) == 1
+    assert await storage.member_failures("10.0.0.1", 5000) == []
+
+
+@pytest.mark.asyncio
+async def test_membership_backends(tmp_path):
+    for backend in membership_backends(tmp_path):
+        await check_membership(backend)
+
+
+# ---------------------------------------------------------------------------
+# placement
+# ---------------------------------------------------------------------------
+
+
+def placement_backends(tmp_path):
+    return [LocalObjectPlacement(), SqliteObjectPlacement(str(tmp_path / "placement.db"))]
+
+
+async def check_placement(p: ObjectPlacement):
+    await p.prepare()
+    oid = ObjectId("Svc", "a")
+    assert await p.lookup(oid) is None
+    await p.update(ObjectPlacementItem(object_id=oid, server_address="h1:1"))
+    assert await p.lookup(oid) == "h1:1"
+    # upsert overwrites
+    await p.update(ObjectPlacementItem(object_id=oid, server_address="h2:2"))
+    assert await p.lookup(oid) == "h2:2"
+    # clean_server removes every object on that address
+    await p.update(ObjectPlacementItem(ObjectId("Svc", "b"), "h2:2"))
+    await p.update(ObjectPlacementItem(ObjectId("Svc", "c"), "h3:3"))
+    await p.clean_server("h2:2")
+    assert await p.lookup(oid) is None
+    assert await p.lookup(ObjectId("Svc", "b")) is None
+    assert await p.lookup(ObjectId("Svc", "c")) == "h3:3"
+    # remove one
+    await p.remove(ObjectId("Svc", "c"))
+    assert await p.lookup(ObjectId("Svc", "c")) is None
+    # batch hooks
+    ids = [ObjectId("Svc", f"x{i}") for i in range(5)]
+    await p.update_batch([ObjectPlacementItem(i, "h9:9") for i in ids])
+    assert await p.lookup_batch(ids) == ["h9:9"] * 5
+
+
+@pytest.mark.asyncio
+async def test_placement_backends(tmp_path):
+    for backend in placement_backends(tmp_path):
+        await check_placement(backend)
+
+
+# ---------------------------------------------------------------------------
+# state
+# ---------------------------------------------------------------------------
+
+
+@message
+class GameScore:
+    wins: int = 0
+    losses: int = 0
+    history: list[str] = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.history is None:
+            self.history = []
+
+
+def state_backends(tmp_path):
+    return [LocalState(), SqliteState(str(tmp_path / "state.db"))]
+
+
+async def check_state(s: StateProvider):
+    await s.prepare()
+    with pytest.raises(StateNotFound):
+        await s.load("Player", "p1", "GameScore", GameScore)
+    score = GameScore(wins=3, losses=1, history=["w", "w", "l", "w"])
+    await s.save("Player", "p1", "GameScore", score)
+    loaded = await s.load("Player", "p1", "GameScore", GameScore)
+    assert loaded == score
+    # overwrite
+    await s.save("Player", "p1", "GameScore", GameScore(wins=4, losses=1))
+    assert (await s.load("Player", "p1", "GameScore", GameScore)).wins == 4
+    # key isolation
+    with pytest.raises(StateNotFound):
+        await s.load("Player", "p2", "GameScore", GameScore)
+    with pytest.raises(StateNotFound):
+        await s.load("Npc", "p1", "GameScore", GameScore)
+    # delete
+    await s.delete("Player", "p1", "GameScore")
+    with pytest.raises(StateNotFound):
+        await s.load("Player", "p1", "GameScore", GameScore)
+
+
+@pytest.mark.asyncio
+async def test_state_backends(tmp_path):
+    for backend in state_backends(tmp_path):
+        await check_state(backend)

@@ -124,6 +124,71 @@ def test_frame_reader_partial_and_multiple():
     assert out == [b"aa", b"bbb"]
 
 
+def test_json_roundtrip_dataclass():
+    o = Outer("a", Inner(1, 2.5), ["t"], b"\x00\x01", maybe=3, table={"k": 9})
+    assert codec.deserialize_json(codec.serialize_json(o), Outer) == o
+
+
+def test_json_optional_dataclass_field():
+    @dataclass
+    class Txn:
+        amount: int
+
+    @dataclass
+    class S:
+        last: Optional[Txn] = None
+
+    s = S(last=Txn(amount=5))
+    out = codec.deserialize_json(codec.serialize_json(s), S)
+    assert isinstance(out.last, Txn) and out.last.amount == 5
+    assert codec.deserialize_json(codec.serialize_json(S()), S).last is None
+
+
+def test_json_int_keyed_dict():
+    @dataclass
+    class S:
+        counts: dict[int, int] = field(default_factory=dict)
+
+    s = S(counts={1: 2, 30: 4})
+    out = codec.deserialize_json(codec.serialize_json(s), S)
+    assert out.counts == {1: 2, 30: 4}
+    assert all(isinstance(k, int) for k in out.counts)
+
+
+def test_json_enum_keyed_dict():
+    @dataclass
+    class S:
+        by_color: dict[Color, int] = field(default_factory=dict)
+
+    s = S(by_color={Color.RED: 1, Color.BLUE: 2})
+    out = codec.deserialize_json(codec.serialize_json(s), S)
+    assert out.by_color == {Color.RED: 1, Color.BLUE: 2}
+
+
+def test_json_bytes_sentinel_not_hijacking_user_dicts():
+    @dataclass
+    class S:
+        meta: dict[str, str] = field(default_factory=dict)
+
+    s = S(meta={"__bytes__": "deadbeef"})
+    out = codec.deserialize_json(codec.serialize_json(s), S)
+    assert out.meta == {"__bytes__": "deadbeef"}  # stays a dict, not bytes
+
+
+def test_json_frozenset_roundtrip():
+    @dataclass
+    class S:
+        tags: frozenset[int] = frozenset()
+
+    out = codec.deserialize_json(codec.serialize_json(S(tags=frozenset({1, 2}))), S)
+    assert out.tags == frozenset({1, 2})
+
+
+def test_json_unknown_field_rejected():
+    with pytest.raises(SerializationError):
+        codec.deserialize_json('{"x": 1, "y": 2.0, "zz": 1}', Inner)
+
+
 def test_frame_too_large_rejected():
     with pytest.raises(SerializationError):
         codec.frame(b"x" * (codec.MAX_FRAME + 1))

@@ -1,0 +1,149 @@
+"""Lifecycle + failure-handling integration tests.
+
+Reference: ``rio-rs/tests/service_lifecycle.rs`` (failed loads must not
+leave allocations), ``tests/object_service_error_handling.rs`` (Ok/Err/panic
+in handlers; panic deallocates), ``tests/object_allocation.rs`` (kill the
+hosting server → object transparently re-allocates).
+"""
+
+import asyncio
+
+import pytest
+
+from rio_tpu import (
+    AdminCommand,
+    AdminSender,
+    AppData,
+    Registry,
+    ServiceObject,
+    handler,
+    message,
+)
+from rio_tpu.errors import RetryExhausted
+from rio_tpu.utils import ExponentialBackoff
+
+from .server_utils import Cluster, run_integration_test
+
+
+@message
+class Poke:
+    mode: str = "ok"  # ok | panic | kill-server
+
+
+@message
+class Ack:
+    count: int = 0
+    server: str = ""
+
+
+class Fragile(ServiceObject):
+    def __init__(self):
+        self.count = 0
+
+    async def before_load(self, ctx: AppData) -> None:
+        if self.id.startswith("bad-load"):
+            raise RuntimeError("refusing to load")
+
+    @handler
+    async def poke(self, msg: Poke, ctx: AppData) -> Ack:
+        from rio_tpu import ServerInfo
+
+        self.count += 1
+        if msg.mode == "panic":
+            raise ValueError("handler panic")
+        if msg.mode == "kill-server":
+            ctx.get(AdminSender).send(AdminCommand.server_exit())
+        return Ack(count=self.count, server=ctx.get(ServerInfo).address)
+
+
+def build_registry() -> Registry:
+    return Registry().add_type(Fragile)
+
+
+def fast_client(cluster: Cluster):
+    c = cluster.client()
+    c._backoff = ExponentialBackoff(initial=1e-4, cap=1e-2, max_retries=5)
+    return c
+
+
+def test_failed_load_leaves_no_allocation():
+    async def body(cluster: Cluster):
+        client = fast_client(cluster)
+        with pytest.raises(RetryExhausted) as ei:
+            await client.send(Fragile, "bad-load-1", Poke(), returns=Ack)
+        assert "ALLOCATE" in str(ei.value.last)
+        assert not await cluster.is_allocated("Fragile", "bad-load-1")
+        assert all(
+            not s.registry.has("Fragile", "bad-load-1") for s in cluster.servers
+        )
+        client.close()
+
+    asyncio.run(run_integration_test(body, registry_builder=build_registry, num_servers=2))
+
+
+def test_handler_panic_deallocates():
+    async def body(cluster: Cluster):
+        client = fast_client(cluster)
+        ok = await client.send(Fragile, "f1", Poke(), returns=Ack)
+        assert ok.count == 1
+        assert await cluster.is_allocated("Fragile", "f1")
+
+        from rio_tpu.errors import ClientError
+
+        with pytest.raises(ClientError) as ei:
+            await client.send(Fragile, "f1", Poke(mode="panic"), returns=Ack)
+        assert "Panic" in str(ei.value)
+        # the panicking instance was destroyed; next request builds a fresh one
+        out = await client.send(Fragile, "f1", Poke(), returns=Ack)
+        assert out.count == 1
+        client.close()
+
+    asyncio.run(run_integration_test(body, registry_builder=build_registry, num_servers=2))
+
+
+def test_kill_server_object_reallocates():
+    """The elasticity test (reference tests/object_allocation.rs:72-137)."""
+
+    async def body(cluster: Cluster):
+        client = fast_client(cluster)
+        first = await client.send(Fragile, "mover", Poke(), returns=Ack)
+        # Kill the hosting server from inside a handler.
+        await client.send(Fragile, "mover", Poke(mode="kill-server"), returns=Ack)
+
+        # Wait for gossip to mark the killed node inactive.
+        for _ in range(100):
+            actives = {m.address for m in await cluster.members.active_members()}
+            if first.server not in actives:
+                break
+            await asyncio.sleep(0.1)
+        else:
+            raise AssertionError("gossip never marked the killed server inactive")
+
+        out = await client.send(Fragile, "mover", Poke(), returns=Ack)
+        assert out.server != first.server, "object must move to the survivor"
+        assert out.count == 1, "fresh instance on the new node"
+        assert await cluster.allocation_address("Fragile", "mover") == out.server
+        client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body, registry_builder=build_registry, num_servers=2, gossip=True
+        )
+    )
+
+
+def test_unknown_message_type_not_supported():
+    async def body(cluster: Cluster):
+        @message
+        class Stray:
+            pass
+
+        client = cluster.client()
+        from rio_tpu.errors import ClientError
+
+        with pytest.raises(ClientError) as ei:
+            await client.send(Fragile, "f1", Stray(), returns=Ack)
+        assert "NOT_SUPPORTED" in str(ei.value)
+        client.close()
+
+    asyncio.run(run_integration_test(body, registry_builder=build_registry, num_servers=1))

@@ -1,0 +1,121 @@
+"""Managed-state lifecycle integration: automatic load at activation,
+handler-driven saves, state surviving object migration.
+
+Reference: ``rio-rs/tests/object_state.rs`` and ``tests/state.rs``.
+"""
+
+import asyncio
+
+import pytest
+
+from rio_tpu import AppData, Registry, ServiceObject, handler, message
+from rio_tpu.state import LocalState, StateProvider, managed_state
+from rio_tpu.state.sqlite import SqliteState
+
+from .server_utils import Cluster, run_integration_test
+
+
+@message
+class Deposit:
+    amount: int = 0
+
+
+@message
+class Balance:
+    total: int = 0
+    loads: int = 0
+
+
+@message
+class AccountState:
+    total: int = 0
+
+
+class Account(ServiceObject):
+    state = managed_state(AccountState)
+
+    def __init__(self):
+        self.loads = 0
+
+    async def after_load(self, ctx: AppData) -> None:
+        self.loads += 1
+
+    @handler
+    async def deposit(self, msg: Deposit, ctx: AppData) -> Balance:
+        self.state.total += msg.amount
+        await self.save_state(ctx)  # manual, handler-driven save
+        return Balance(total=self.state.total, loads=self.loads)
+
+
+def build_registry() -> Registry:
+    return Registry().add_type(Account)
+
+
+def run_with_state(body, state: StateProvider, num_servers=2):
+    async def wrapped(cluster: Cluster):
+        for s in cluster.servers:
+            s.app_data.set(state, as_type=StateProvider)
+        await body(cluster)
+
+    asyncio.run(
+        run_integration_test(wrapped, registry_builder=build_registry, num_servers=num_servers)
+    )
+
+
+def test_state_persists_across_deallocation():
+    state = LocalState()
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        out = await client.send(Account, "a1", Deposit(amount=10), returns=Balance)
+        assert out == Balance(total=10, loads=1)
+        out = await client.send(Account, "a1", Deposit(amount=5), returns=Balance)
+        assert out == Balance(total=15, loads=1)  # same live instance
+
+        # Force deallocation (admin path), then hit it again: state reloads.
+        addr = await cluster.allocation_address("Account", "a1")
+        server = next(s for s in cluster.servers if s.local_address == addr)
+        await server.shutdown_object("Account", "a1")
+        assert not await cluster.is_allocated("Account", "a1")
+
+        out = await client.send(Account, "a1", Deposit(amount=1), returns=Balance)
+        assert out.total == 16  # persisted 15 + 1
+        assert out.loads == 1  # fresh instance, loaded once
+        client.close()
+
+    run_with_state(body, LocalState() if False else state)
+
+
+def test_state_sqlite_provider(tmp_path):
+    state = SqliteState(str(tmp_path / "state.db"))
+
+    async def body(cluster: Cluster):
+        await state.prepare()
+        client = cluster.client()
+        await client.send(Account, "a1", Deposit(amount=7), returns=Balance)
+        addr = await cluster.allocation_address("Account", "a1")
+        server = next(s for s in cluster.servers if s.local_address == addr)
+        await server.shutdown_object("Account", "a1")
+        out = await client.send(Account, "a1", Deposit(amount=3), returns=Balance)
+        assert out.total == 10
+        client.close()
+
+    run_with_state(body, state)
+
+
+def test_missing_provider_fails_activation():
+    async def body(cluster: Cluster):
+        # No StateProvider registered: activation must fail with ALLOCATE,
+        # not leave a half-initialized object behind.
+        client = cluster.client()
+        from rio_tpu.errors import RetryExhausted
+        from rio_tpu.utils import ExponentialBackoff
+
+        client._backoff = ExponentialBackoff(initial=1e-4, cap=1e-3, max_retries=3)
+        with pytest.raises(RetryExhausted) as ei:
+            await client.send(Account, "a1", Deposit(amount=1), returns=Balance)
+        assert "ALLOCATE" in str(ei.value.last)
+        assert not await cluster.is_allocated("Account", "a1")
+        client.close()
+
+    asyncio.run(run_integration_test(body, registry_builder=build_registry, num_servers=1))

@@ -1,0 +1,128 @@
+"""Pub/sub integration tests.
+
+Reference: ``rio-rs/tests/client_server_integration_test.rs:182-307`` —
+subscribe to an object's stream, receive handler-published messages,
+redirect-following resubscribe.
+"""
+
+import asyncio
+
+from rio_tpu import AppData, MessageRouter, Registry, ServiceObject, handler, message
+from rio_tpu.registry import type_id
+
+from .server_utils import Cluster, run_integration_test
+
+
+@message
+class Publish:
+    text: str = ""
+
+
+@message
+class Done:
+    pass
+
+
+@message
+class Event:
+    text: str = ""
+    seq: int = 0
+
+
+class Broadcaster(ServiceObject):
+    def __init__(self):
+        self.seq = 0
+
+    @handler
+    async def publish(self, msg: Publish, ctx: AppData) -> Done:
+        self.seq += 1
+        router = ctx.get(MessageRouter)
+        router.publish(type_id(Broadcaster), self.id, Event(text=msg.text, seq=self.seq))
+        return Done()
+
+
+def build_registry() -> Registry:
+    return Registry().add_type(Broadcaster)
+
+
+def test_subscribe_receives_published_messages():
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        # Allocate the object first so the subscription lands on its host.
+        await client.send(Broadcaster, "b1", Publish(text="warmup"), returns=Done)
+
+        stream = await client.subscribe(Broadcaster, "b1")
+        received: list[Event] = []
+
+        async def consume():
+            async for event in stream:
+                received.append(event)
+                if len(received) == 3:
+                    return
+
+        consumer = asyncio.create_task(consume())
+        await asyncio.sleep(0.2)  # let the subscription attach
+        for i in range(3):
+            await client.send(Broadcaster, "b1", Publish(text=f"m{i}"), returns=Done)
+        await asyncio.wait_for(consumer, timeout=5)
+
+        assert [e.text for e in received] == ["m0", "m1", "m2"]
+        assert [e.seq for e in received] == [2, 3, 4]  # warmup was seq 1
+        assert all(isinstance(e, Event) for e in received)
+        client.close()
+
+    asyncio.run(run_integration_test(body, registry_builder=build_registry, num_servers=2))
+
+
+def test_subscribe_from_cold_cache_follows_redirect():
+    async def body(cluster: Cluster):
+        c1 = cluster.client()
+        await c1.send(Broadcaster, "b2", Publish(text="seed"), returns=Done)
+
+        # Fresh client: random first pick, must end up streaming from the
+        # true owner via redirect-following resubscribe.
+        c2 = cluster.client()
+        stream = await c2.subscribe(Broadcaster, "b2")
+        received = []
+
+        async def consume():
+            async for event in stream:
+                received.append(event)
+                return
+
+        consumer = asyncio.create_task(consume())
+        await asyncio.sleep(0.3)
+        await c1.send(Broadcaster, "b2", Publish(text="hello"), returns=Done)
+        await asyncio.wait_for(consumer, timeout=5)
+        assert received[0].text == "hello"
+        c1.close()
+        c2.close()
+
+    asyncio.run(
+        run_integration_test(body, registry_builder=build_registry, num_servers=6)
+    )
+
+
+def test_multiple_subscribers_fan_out():
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        await client.send(Broadcaster, "b3", Publish(text="seed"), returns=Done)
+
+        streams = [await cluster.client().subscribe(Broadcaster, "b3") for _ in range(3)]
+        results: list[list[str]] = [[] for _ in streams]
+
+        async def consume(i, stream):
+            async for event in stream:
+                results[i].append(event.text)
+                if len(results[i]) == 2:
+                    return
+
+        consumers = [asyncio.create_task(consume(i, s)) for i, s in enumerate(streams)]
+        await asyncio.sleep(0.3)
+        await client.send(Broadcaster, "b3", Publish(text="x"), returns=Done)
+        await client.send(Broadcaster, "b3", Publish(text="y"), returns=Done)
+        await asyncio.wait_for(asyncio.gather(*consumers), timeout=5)
+        assert results == [["x", "y"]] * 3
+        client.close()
+
+    asyncio.run(run_integration_test(body, registry_builder=build_registry, num_servers=2))
