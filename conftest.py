@@ -10,10 +10,17 @@ import asyncio
 import inspect
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard override: the ambient environment (sitecustomize) may pin
+# JAX_PLATFORMS to the real TPU tunnel ("axon"); tests always run on the
+# virtual CPU mesh, so force both the env var and the live jax config.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (must come after the env setup above)
+
+jax.config.update("jax_platforms", "cpu")
 
 
 def pytest_configure(config):

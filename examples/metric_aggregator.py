@@ -74,8 +74,10 @@ class MetricAggregator(ServiceObject):
         s.count += 1
         s.total += msg.value
         await self.save_state(ctx)
-        if msg.tag and "." not in self.id:
-            # fan out to the per-tag aggregator (reference services.rs:30-49)
+        # Fan out to the per-tag aggregator (reference services.rs:30-49).
+        # The forwarded copy carries tag="" so the child never re-fans-out,
+        # regardless of what characters the metric name contains.
+        if msg.tag:
             await ServiceObject.send(
                 ctx, MetricAggregator, f"{self.id}.{msg.tag}",
                 Metric(tag="", value=msg.value), returns=Stats,

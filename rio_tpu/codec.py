@@ -215,14 +215,25 @@ def _from_json(wire: Any, ty: Any) -> Any:
         unknown = set(wire) - fields
         if unknown:
             raise SerializationError(f"{ty.__name__}: unknown state fields {unknown}")
-        return ty(**{k: _from_json(v, hints.get(k, Any)) for k, v in wire.items()})
+        try:
+            return ty(**{k: _from_json(v, hints.get(k, Any)) for k, v in wire.items()})
+        except TypeError as e:  # e.g. stored JSON missing a newly required field
+            raise SerializationError(f"{ty.__name__}: {e}") from e
     if dataclasses.is_dataclass(ty):
         raise SerializationError(f"expected object for dataclass {ty.__name__}")
     origin = get_origin(ty)
     if origin in (list, tuple, set, frozenset):
         if not isinstance(wire, list):
             raise SerializationError(f"expected array for {ty}")
-        elem = (get_args(ty) or (Any,))[0]
+        args = get_args(ty)
+        if origin is tuple and args and args[-1] is not Ellipsis:
+            # Heterogeneous tuple: decode element-wise (mirrors _from_wire).
+            if len(wire) != len(args):
+                raise SerializationError(
+                    f"expected {len(args)}-tuple for {ty}, got {len(wire)} items"
+                )
+            return tuple(_from_json(v, a) for v, a in zip(wire, args))
+        elem = (args or (Any,))[0]
         return origin(_from_json(v, elem) for v in wire)
     if origin is dict:
         if not isinstance(wire, dict):

@@ -1,0 +1,32 @@
+"""TPU-side numerical ops for rio-tpu.
+
+The reference (rio-rs) resolves object placement row-by-row through SQL
+(``rio-rs/src/object_placement/sqlite.rs:68-100``, consulted per request in
+``rio-rs/src/service.rs:193-254``) with no load-balancing policy at all
+(random client pick + receiving-server self-assign,
+``rio-rs/src/client/mod.rs:255-262``, ``service.rs:241-253``).
+
+rio-tpu recasts placement as a **batched assignment problem** solved
+on-device: an (objects x nodes) cost matrix built from liveness + load, an
+entropic optimal-transport (Sinkhorn) solve or an iterative penalized-argmin
+("greedy") solve, and an assignment extraction that is a single fused
+argmin. Everything here is jit-friendly: static shapes, ``lax.scan`` control
+flow, bfloat16 matmul paths with float32 log-sum-exp accumulation.
+"""
+
+from .assignment import (
+    assign_from_potentials,
+    build_cost_matrix,
+    greedy_balanced_assign,
+)
+from .sinkhorn import SinkhornResult, plan_rounded_assign, sinkhorn, sinkhorn_assign
+
+__all__ = [
+    "SinkhornResult",
+    "assign_from_potentials",
+    "build_cost_matrix",
+    "greedy_balanced_assign",
+    "plan_rounded_assign",
+    "sinkhorn",
+    "sinkhorn_assign",
+]

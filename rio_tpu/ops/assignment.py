@@ -1,0 +1,115 @@
+"""Cost-matrix construction and greedy balanced assignment.
+
+The cost model replaces the reference's implicit placement policy (random
+active server on client cache miss, ``rio-rs/src/client/mod.rs:255-262``;
+unconditional self-assign on the receiving server,
+``rio-rs/src/service.rs:241-253``) with an explicit objective:
+
+  cost[i, j] = load_penalty * (node_load[j] / capacity[j])
+             + affinity_penalty * (1 - affinity[i, j])
+             + BIG * (1 - alive[j])
+
+Dead nodes are priced out rather than masked so the matrix keeps a static
+shape (cluster size changes do not recompile).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+DEAD_NODE_COST = 1e6
+
+
+def build_cost_matrix(
+    node_load: jax.Array,
+    node_capacity: jax.Array,
+    alive: jax.Array,
+    affinity: jax.Array | None = None,
+    *,
+    load_weight: float = 1.0,
+    affinity_weight: float = 0.25,
+) -> jax.Array:
+    """(n_objects x n_nodes) cost from liveness + relative load (+ affinity).
+
+    Args:
+      node_load: (n_nodes,) current absorbed load per node.
+      node_capacity: (n_nodes,) capacity per node (0 for retired slots).
+      alive: (n_nodes,) 1.0 if the member is active (gossip liveness,
+        reference ``peer_to_peer.rs:101-112``), else 0.0.
+      affinity: optional (n_objects, n_nodes) in [0, 1]; 1 = strongly prefer
+        (e.g. state locality / cache warmth). If None, costs are per-node
+        only and the result is broadcast to (1, n_nodes).
+    """
+    cap = jnp.maximum(node_capacity.astype(jnp.float32), 1e-6)
+    per_node = load_weight * (node_load.astype(jnp.float32) / cap)
+    per_node = per_node + DEAD_NODE_COST * (1.0 - alive.astype(jnp.float32))
+    if affinity is None:
+        return per_node[None, :]
+    aff = affinity_weight * (1.0 - affinity.astype(jnp.float32))
+    return per_node[None, :] + aff
+
+
+def assign_from_potentials(cost_rows: jax.Array, g: jax.Array) -> jax.Array:
+    """Incremental placement: argmin_j cost[i,j] - g[j] with cached potentials.
+
+    This is the warm-start fast path — new/churned objects are placed against
+    the last solve's node potentials without re-running Sinkhorn.
+    """
+    g = jnp.where(jnp.isfinite(g), g, -jnp.inf)
+    return jnp.argmin(cost_rows.astype(jnp.float32) - g[None, :], axis=1).astype(jnp.int32)
+
+
+@jax.jit
+def greedy_balanced_assign(
+    cost: jax.Array,
+    row_mass: jax.Array,
+    node_capacity: jax.Array,
+    node_load: jax.Array | None = None,
+) -> jax.Array:
+    """Capacity-proportional waterfilling: the cheap balanced-assignment tier.
+
+    Nodes are sorted by their (column-mean) cost; each node absorbs mass up to
+    its *headroom* — the gap between its capacity-fair share of the total
+    (existing + incoming) load and its current load. Objects are laid onto
+    this sorted partition by cumulative-mass position (``searchsorted``), so
+    the result is exactly capacity-balanced, deterministic, and free of the
+    oscillation/herding failure modes of simultaneous penalized argmin.
+    Zero-capacity (dead) nodes get zero-width intervals and are never chosen.
+
+    Per-object affinity is intentionally ignored here — this tier trades
+    placement quality for a single O(N log M) pass; the Sinkhorn tier
+    (:func:`rio_tpu.ops.sinkhorn.sinkhorn_assign`) honors full per-object
+    costs.
+    """
+    cost = cost.astype(jnp.float32)
+    mass = jnp.maximum(row_mass.astype(jnp.float32), 0.0)
+    cap = jnp.maximum(node_capacity.astype(jnp.float32), 0.0)
+    n_nodes = cost.shape[1]
+    load = (
+        jnp.zeros((n_nodes,), jnp.float32)
+        if node_load is None
+        else node_load.astype(jnp.float32)
+    )
+
+    total_mass = jnp.sum(mass)
+    cap_share = cap / jnp.maximum(jnp.sum(cap), 1e-30)
+    fair = (total_mass + jnp.sum(load)) * cap_share
+    headroom = jnp.maximum(fair - load, 0.0)
+    # If the cluster is already at/over fair everywhere, fall back to pure
+    # capacity shares so the incoming batch still spreads proportionally.
+    total_headroom = jnp.sum(headroom)
+    width = jnp.where(total_headroom > 1e-30, headroom, cap_share * total_mass)
+    # Scale widths to cover exactly the incoming mass (overflow spreads pro rata).
+    width = width * (total_mass / jnp.maximum(jnp.sum(width), 1e-30))
+
+    score = jnp.mean(cost, axis=0) + DEAD_NODE_COST * (cap <= 0)
+    order = jnp.argsort(score)
+    boundaries = jnp.cumsum(width[order])
+    # Mid-mass position of each object avoids boundary ties on zero-width bins.
+    pos = jnp.cumsum(mass) - 0.5 * mass
+    idx = jnp.clip(jnp.searchsorted(boundaries, pos, side="left"), 0, n_nodes - 1)
+    return order[idx].astype(jnp.int32)

@@ -146,7 +146,13 @@ async def run_integration_test(
             crash.cancel()
             raise TimeoutError(f"integration test timed out after {timeout}s")
         if test in done:
-            crash.cancel()
+            # Retrieve (and surface) a crash that completed in the same
+            # wakeup; cancel() on an already-failed future is a no-op and
+            # would leave its exception unretrieved.
+            if crash.done() and not crash.cancelled():
+                crash.result()
+            else:
+                crash.cancel()
             test.result()  # re-raise test failures
         else:
             test.cancel()

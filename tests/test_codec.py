@@ -195,3 +195,32 @@ def test_frame_too_large_rejected():
     r = codec.FrameReader()
     with pytest.raises(SerializationError):
         r.feed((codec.MAX_FRAME + 1).to_bytes(4, "big"))
+
+
+def test_json_heterogeneous_tuple_roundtrip():
+    # Regression: deserialize_json decoded tuple[int, str] with int only.
+    import dataclasses
+    from rio_tpu.codec import deserialize_json, serialize_json
+
+    @dataclasses.dataclass
+    class S:
+        pair: tuple[int, str] = (0, "")
+
+    wire = serialize_json(S(pair=(1, "a")))
+    out = deserialize_json(wire, S)
+    assert out.pair == (1, "a")
+
+
+def test_json_missing_required_field_raises_serialization_error():
+    import dataclasses
+    import pytest
+    from rio_tpu.codec import deserialize_json
+    from rio_tpu.errors import SerializationError
+
+    @dataclasses.dataclass
+    class S:
+        a: int
+        b: int  # newly required field absent from stored JSON
+
+    with pytest.raises(SerializationError):
+        deserialize_json('{"a": 1}', S)

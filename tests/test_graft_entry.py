@@ -1,0 +1,23 @@
+"""Driver-contract checks: entry() compiles; dryrun_multichip runs on 8 CPUs."""
+
+import pathlib
+import sys
+
+import jax
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import __graft_entry__ as graft
+
+
+def test_entry_compiles_and_runs():
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    out = np.asarray(jax.block_until_ready(out))
+    assert out.shape == (args[0].shape[0],)
+    assert out.min() >= 0 and out.max() < args[0].shape[1]
+
+
+def test_dryrun_multichip_8():
+    graft.dryrun_multichip(8)

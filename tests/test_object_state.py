@@ -83,7 +83,7 @@ def test_state_persists_across_deallocation():
         assert out.loads == 1  # fresh instance, loaded once
         client.close()
 
-    run_with_state(body, LocalState() if False else state)
+    run_with_state(body, state)
 
 
 def test_state_sqlite_provider(tmp_path):

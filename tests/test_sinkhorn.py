@@ -1,0 +1,119 @@
+"""Solver tests: Sinkhorn marginals, greedy balance, sharded-vs-single parity.
+
+Runs on the virtual 8-device CPU mesh from ``conftest.py`` (the same
+mechanism the driver's ``dryrun_multichip`` uses).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rio_tpu.ops import (
+    assign_from_potentials,
+    build_cost_matrix,
+    greedy_balanced_assign,
+    sinkhorn,
+    sinkhorn_assign,
+)
+from rio_tpu.parallel import make_mesh, shard_cost, sharded_sinkhorn_assign
+
+
+def _random_cost(n_obj, n_nodes, seed=0):
+    key = jax.random.PRNGKey(seed)
+    return jax.random.uniform(key, (n_obj, n_nodes), jnp.float32)
+
+
+def test_sinkhorn_marginals_converge():
+    cost = _random_cost(256, 16)
+    mass = jnp.ones((256,))
+    cap = jnp.ones((16,))
+    res = sinkhorn(cost, mass, cap, eps=0.05, n_iters=100)
+    assert float(res.err) < 1e-2
+    assert np.isfinite(np.asarray(res.f)).all()
+    assert np.isfinite(np.asarray(res.g)).all()
+
+
+def test_sinkhorn_dead_nodes_attract_nothing():
+    cost = _random_cost(128, 8)
+    mass = jnp.ones((128,))
+    cap = jnp.asarray([1, 1, 1, 1, 0, 0, 1, 1], jnp.float32)
+    assignment, res = sinkhorn_assign(cost, mass, cap, eps=0.05, n_iters=60)
+    assignment = np.asarray(assignment)
+    assert not np.any(np.isin(assignment, [4, 5]))
+    assert np.isneginf(np.asarray(res.g)[[4, 5]]).all()
+
+
+def test_sinkhorn_balances_load():
+    # Uniform cost: mass should spread ~evenly over nodes.
+    cost = _random_cost(1024, 8, seed=3) * 0.01
+    assignment, _ = sinkhorn_assign(
+        cost, jnp.ones((1024,)), jnp.ones((8,)), eps=0.02, n_iters=80
+    )
+    counts = np.bincount(np.asarray(assignment), minlength=8)
+    assert counts.max() <= 2.0 * 1024 / 8  # no node more than 2x fair share
+
+
+def test_padding_rows_are_inert():
+    cost = _random_cost(128, 8)
+    mass = jnp.concatenate([jnp.ones((100,)), jnp.zeros((28,))])
+    res = sinkhorn(cost, mass, jnp.ones((8,)), eps=0.05, n_iters=60)
+    assert np.isneginf(np.asarray(res.f)[100:]).all()
+
+
+def test_greedy_balanced_assign_spreads():
+    cost = jnp.zeros((800, 8))
+    assignment = greedy_balanced_assign(cost, jnp.ones((800,)), jnp.ones((8,)))
+    counts = np.bincount(np.asarray(assignment), minlength=8)
+    assert counts.max() <= 2 * 100
+    assert counts.min() >= 50  # waterfilling is near-exactly balanced
+
+
+def test_greedy_accounts_for_existing_load():
+    # Node 0 already carries 100; incoming 60 should land elsewhere.
+    cost = jnp.zeros((60, 4))
+    load = jnp.asarray([100.0, 0.0, 0.0, 0.0])
+    assignment = np.asarray(
+        greedy_balanced_assign(cost, jnp.ones((60,)), jnp.ones((4,)), load)
+    )
+    assert not np.any(assignment == 0)
+
+
+def test_greedy_respects_dead_nodes():
+    load = jnp.zeros((8,))
+    cap = jnp.ones((8,))
+    alive = jnp.asarray([1, 1, 0, 1, 1, 1, 1, 1], jnp.float32)
+    cost = jnp.broadcast_to(build_cost_matrix(load, cap, alive), (64, 8))
+    assignment = np.asarray(
+        greedy_balanced_assign(cost, jnp.ones((64,)), cap * alive)
+    )
+    assert not np.any(assignment == 2)
+
+
+def test_assign_from_potentials_matches_full_solve():
+    cost = _random_cost(256, 16, seed=7)
+    mass = jnp.ones((256,))
+    cap = jnp.ones((16,))
+    assignment, res = sinkhorn_assign(cost, mass, cap, eps=0.05, n_iters=80)
+    incr = assign_from_potentials(cost, res.g)
+    np.testing.assert_array_equal(np.asarray(assignment), np.asarray(incr))
+
+
+def test_sharded_matches_single_device():
+    assert len(jax.devices()) == 8, "conftest must force 8 virtual devices"
+    mesh = make_mesh()
+    n_obj, n_nodes = 512, 32  # divisible by both mesh axis sizes
+    cost = _random_cost(n_obj, n_nodes, seed=11)
+    mass = jnp.ones((n_obj,))
+    cap = jnp.ones((n_nodes,))
+
+    single, _ = sinkhorn_assign(cost, mass, cap, eps=0.05, n_iters=40)
+    sharded = sharded_sinkhorn_assign(
+        mesh, shard_cost(mesh, cost), mass, cap, eps=0.05, n_iters=40
+    )
+    np.testing.assert_array_equal(np.asarray(single), np.asarray(sharded))
+
+
+def test_mesh_factorization():
+    mesh = make_mesh()
+    assert mesh.devices.size == 8
+    assert mesh.axis_names == ("obj", "node")
