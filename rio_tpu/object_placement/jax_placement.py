@@ -277,32 +277,39 @@ class JaxObjectPlacement(ObjectPlacement):
 
         n = len(keys)
         bucket = _next_bucket(n)
-        base_cost = build_cost_matrix(jnp.zeros_like(load), cap, alive)
-        cost = jnp.broadcast_to(base_cost, (bucket, base_cost.shape[1]))
-        mass = jnp.concatenate(
-            [jnp.ones((n,), jnp.float32), jnp.zeros((bucket - n,), jnp.float32)]
-        )
-        t0 = time.perf_counter()
-        if mode == "sinkhorn":
-            if self._mesh is not None:
-                from ..parallel import shard_cost, sharded_sinkhorn
 
-                cost = shard_cost(self._mesh, cost)
-                f, g = sharded_sinkhorn(
-                    self._mesh, cost, mass, cap * alive,
-                    eps=self._eps, n_iters=self._n_iters,
-                )
+        def _solve() -> tuple[np.ndarray, jax.Array | None, float]:
+            """Device solve off the event loop: np.asarray blocks until the
+            TPU finishes, so running it in a thread keeps lookups/gossip/RPCs
+            live — and makes the epoch-discard check below load-bearing."""
+            base_cost = build_cost_matrix(jnp.zeros_like(load), cap, alive)
+            cost = jnp.broadcast_to(base_cost, (bucket, base_cost.shape[1]))
+            mass = jnp.concatenate(
+                [jnp.ones((n,), jnp.float32), jnp.zeros((bucket - n,), jnp.float32)]
+            )
+            t0 = time.perf_counter()
+            if mode == "sinkhorn":
+                if self._mesh is not None:
+                    from ..parallel import shard_cost, sharded_sinkhorn
+
+                    cost = shard_cost(self._mesh, cost)
+                    f, g = sharded_sinkhorn(
+                        self._mesh, cost, mass, cap * alive,
+                        eps=self._eps, n_iters=self._n_iters,
+                    )
+                else:
+                    res = sinkhorn(
+                        cost, mass, cap * alive, eps=self._eps, n_iters=self._n_iters
+                    )
+                    f, g = res.f, res.g
+                assignment = plan_rounded_assign(cost, f, g, self._eps)
             else:
-                res = sinkhorn(
-                    cost, mass, cap * alive, eps=self._eps, n_iters=self._n_iters
-                )
-                f, g = res.f, res.g
-            assignment = plan_rounded_assign(cost, f, g, self._eps)
-        else:
-            assignment = greedy_balanced_assign(cost, mass, cap * alive)
-            g = None
-        assignment = np.asarray(assignment)[:n]
-        solve_ms = (time.perf_counter() - t0) * 1e3
+                assignment = greedy_balanced_assign(cost, mass, cap * alive)
+                g = None
+            out = np.asarray(assignment)[:n]
+            return out, g, (time.perf_counter() - t0) * 1e3
+
+        assignment, g, solve_ms = await asyncio.to_thread(_solve)
 
         async with self._lock:
             if self._epoch != snapshot_epoch:

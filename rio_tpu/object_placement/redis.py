@@ -1,0 +1,81 @@
+"""Redis object-placement directory.
+
+Reference: ``rio-rs/src/object_placement/redis.rs:36-87`` — one key per
+object (``{prefix}:placement:{type}.{id} -> address``) plus a per-server set
+of placed object keys so ``clean_server`` can bulk-unassign everything on a
+dead node without scanning the keyspace.
+"""
+
+from __future__ import annotations
+
+from ..registry import ObjectId
+from ..utils.resp import RedisClient
+from . import ObjectPlacement, ObjectPlacementItem
+
+
+class RedisObjectPlacement(ObjectPlacement):
+    def __init__(self, client: RedisClient | str, key_prefix: str = "rio") -> None:
+        self.client = (
+            RedisClient.from_url(client) if isinstance(client, str) else client
+        )
+        self.prefix = key_prefix
+
+    def _obj_key(self, key: str) -> str:
+        return f"{self.prefix}:placement:{key}"
+
+    def _server_key(self, address: str) -> str:
+        return f"{self.prefix}:placement_server:{address}"
+
+    async def update(self, item: ObjectPlacementItem) -> None:
+        await self.update_batch([item])
+
+    async def update_batch(self, items: list[ObjectPlacementItem]) -> None:
+        """Pipelined upsert (reference uses ``redis::pipe()`` similarly):
+        one round trip to read old addresses, one for all writes."""
+        if not items:
+            return
+        keys = [str(i.object_id) for i in items]
+        olds = await self.client.execute_pipeline(
+            [("GET", self._obj_key(k)) for k in keys]
+        )
+        cmds: list[tuple] = []
+        for item, key, old in zip(items, keys, olds):
+            if isinstance(old, bytes):
+                cmds.append(("SREM", self._server_key(old.decode()), key))
+            if item.server_address is None:
+                cmds.append(("DEL", self._obj_key(key)))
+            else:
+                cmds.append(("SET", self._obj_key(key), item.server_address))
+                cmds.append(("SADD", self._server_key(item.server_address), key))
+        await self.client.execute_pipeline(cmds)
+
+    async def lookup(self, object_id: ObjectId) -> str | None:
+        raw = await self.client.execute("GET", self._obj_key(str(object_id)))
+        return raw.decode() if raw is not None else None
+
+    async def clean_server(self, address: str) -> None:
+        keys = await self.client.execute("SMEMBERS", self._server_key(address))
+        if keys:
+            # one variadic DEL, not one round trip per object: this runs on
+            # the dead-node path while requests are actively being redirected
+            await self.client.execute(
+                "DEL", *(self._obj_key(k.decode()) for k in keys)
+            )
+        await self.client.execute("DEL", self._server_key(address))
+
+    async def remove(self, object_id: ObjectId) -> None:
+        key = str(object_id)
+        old = await self.client.execute("GET", self._obj_key(key))
+        cmds: list[tuple] = [("DEL", self._obj_key(key))]
+        if old is not None:
+            cmds.insert(0, ("SREM", self._server_key(old.decode()), key))
+        await self.client.execute_pipeline(cmds)
+
+    async def lookup_batch(self, object_ids: list[ObjectId]) -> list[str | None]:
+        raws = await self.client.execute_pipeline(
+            [("GET", self._obj_key(str(o))) for o in object_ids]
+        )
+        return [r.decode() if isinstance(r, bytes) else None for r in raws]
+
+    def close(self) -> None:
+        self.client.close()

@@ -103,20 +103,25 @@ def plan_rounded_assign(cost: jax.Array, f: jax.Array, g: jax.Array, eps: float 
     ``(i+0.5)/n``: aggregate node loads then match the plan's column
     marginals — i.e. capacities — while identical rows spread contiguously.
     Padding rows (``f = -inf``) fall back to the plan-uniform distribution of
-    live columns; callers slice them off.
+    live columns; callers slice them off. Quantiles are taken over the *real*
+    rows only — ranking by position among finite-``f`` rows — so bucket
+    padding never skews the spread toward low-cumulative nodes.
     """
     cost = cost.astype(jnp.float32)
-    n = cost.shape[0]
+    is_real = jnp.isfinite(f)
     logit = (f[:, None] + g[None, :] - cost) / eps
     alive_cols = jnp.isfinite(g)
     logit = jnp.where(
-        jnp.isfinite(f)[:, None],
+        is_real[:, None],
         logit,
         jnp.where(alive_cols[None, :], 0.0, -jnp.inf),
     )
     p = jax.nn.softmax(logit, axis=1)
     cum = jnp.cumsum(p, axis=1)
-    u = (jnp.arange(n, dtype=jnp.float32) + 0.5) / n
+    realf = is_real.astype(jnp.float32)
+    n_real = jnp.maximum(jnp.sum(realf), 1.0)
+    rank = jnp.cumsum(realf) - 1.0  # 0..n_real-1 over real rows
+    u = jnp.where(is_real, (rank + 0.5) / n_real, 0.5)
     idx = jnp.sum((cum < u[:, None]).astype(jnp.int32), axis=1)
     return jnp.clip(idx, 0, cost.shape[1] - 1).astype(jnp.int32)
 

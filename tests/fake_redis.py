@@ -1,0 +1,162 @@
+"""In-process RESP2 server for backend-matrix tests.
+
+The reference tests Redis backends against a real valkey container
+(``compose.yaml``, nextest setup-scripts). No Redis server exists in this
+environment, so tests boot this asyncio fake instead: a real TCP server
+speaking the actual wire protocol, backed by an in-memory keyspace. The
+Redis backends under test use their production code path end to end
+(``rio_tpu/utils/resp.py`` over a socket).
+
+Supported commands: PING SELECT SET GET DEL EXISTS HSET HGET HGETALL HDEL
+RPUSH LTRIM LRANGE SADD SREM SMEMBERS FLUSHDB KEYS.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import fnmatch
+from typing import Any
+
+from rio_tpu.utils.resp import read_reply
+
+
+def _enc_bulk(v: bytes | None) -> bytes:
+    if v is None:
+        return b"$-1\r\n"
+    return b"$%d\r\n%s\r\n" % (len(v), v)
+
+
+def _enc(v: Any) -> bytes:
+    if v is None or isinstance(v, bytes):
+        return _enc_bulk(v)
+    if isinstance(v, bool):
+        return b":%d\r\n" % int(v)
+    if isinstance(v, int):
+        return b":%d\r\n" % v
+    if isinstance(v, str):
+        return b"+%s\r\n" % v.encode()
+    if isinstance(v, list):
+        return b"*%d\r\n" % len(v) + b"".join(_enc(x) for x in v)
+    raise TypeError(type(v))
+
+
+class FakeRedisServer:
+    def __init__(self) -> None:
+        self.data: dict[bytes, Any] = {}
+        self._server: asyncio.AbstractServer | None = None
+        self._writers: set[asyncio.StreamWriter] = set()
+        self.port = 0
+
+    async def start(self) -> "FakeRedisServer":
+        self._server = await asyncio.start_server(self._serve, "127.0.0.1", 0)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # Force-close lingering client connections (pooled RedisClient
+            # conns): wait_closed() would otherwise block on their handlers.
+            for w in list(self._writers):
+                w.close()
+            await self._server.wait_closed()
+
+    async def _serve(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        self._writers.add(writer)
+        try:
+            while True:
+                try:
+                    cmd = await read_reply(reader)
+                except (ConnectionError, asyncio.IncompleteReadError):
+                    break
+                if not cmd:
+                    break
+                try:
+                    reply = self._dispatch(cmd)
+                except Exception as e:  # noqa: BLE001 — surfaced as -ERR
+                    writer.write(b"-ERR %s\r\n" % str(e).encode())
+                else:
+                    writer.write(reply)
+                await writer.drain()
+        finally:
+            self._writers.discard(writer)
+            writer.close()
+
+    def _dispatch(self, cmd: list[bytes]) -> bytes:
+        name = cmd[0].decode().upper()
+        args = cmd[1:]
+        d = self.data
+        if name == "PING":
+            return _enc("PONG")
+        if name in ("SELECT", "FLUSHDB"):
+            if name == "FLUSHDB":
+                d.clear()
+            return _enc("OK")
+        if name == "SET":
+            d[args[0]] = args[1]
+            return _enc("OK")
+        if name == "GET":
+            v = d.get(args[0])
+            if v is not None and not isinstance(v, bytes):
+                raise ValueError("WRONGTYPE")
+            return _enc_bulk(v)
+        if name == "DEL":
+            n = sum(1 for k in args if d.pop(k, None) is not None)
+            return _enc(n)
+        if name == "EXISTS":
+            return _enc(sum(1 for k in args if k in d))
+        if name == "KEYS":
+            pat = args[0].decode()
+            return _enc([k for k in d if fnmatch.fnmatchcase(k.decode(), pat)])
+        if name == "HSET":
+            h = d.setdefault(args[0], {})
+            added = 0
+            for i in range(1, len(args), 2):
+                added += args[i] not in h
+                h[args[i]] = args[i + 1]
+            return _enc(added)
+        if name == "HGET":
+            return _enc_bulk(d.get(args[0], {}).get(args[1]))
+        if name == "HGETALL":
+            out: list[bytes] = []
+            for k, v in d.get(args[0], {}).items():
+                out.extend((k, v))
+            return _enc(out)
+        if name == "HDEL":
+            h = d.get(args[0], {})
+            n = sum(1 for f in args[1:] if h.pop(f, None) is not None)
+            if not h:
+                d.pop(args[0], None)
+            return _enc(n)
+        if name == "RPUSH":
+            lst = d.setdefault(args[0], [])
+            lst.extend(args[1:])
+            return _enc(len(lst))
+        if name == "LTRIM":
+            lst = d.get(args[0], [])
+            start, stop = int(args[1]), int(args[2])
+            stop = len(lst) if stop == -1 else stop + 1 if stop >= 0 else len(lst) + stop + 1
+            start = max(0, start if start >= 0 else len(lst) + start)
+            d[args[0]] = lst[start:stop]
+            return _enc("OK")
+        if name == "LRANGE":
+            lst = d.get(args[0], [])
+            start, stop = int(args[1]), int(args[2])
+            stop = len(lst) if stop == -1 else stop + 1 if stop >= 0 else len(lst) + stop + 1
+            start = max(0, start if start >= 0 else len(lst) + start)
+            return _enc(lst[start:stop])
+        if name == "SADD":
+            s = d.setdefault(args[0], set())
+            n = sum(1 for m in args[1:] if m not in s)
+            s.update(args[1:])
+            return _enc(n)
+        if name == "SREM":
+            s = d.get(args[0], set())
+            n = len(s & set(args[1:]))
+            s -= set(args[1:])
+            if not s:
+                d.pop(args[0], None)
+            return _enc(n)
+        if name == "SMEMBERS":
+            return _enc(sorted(d.get(args[0], set())))
+        raise ValueError(f"unknown command '{name}'")

@@ -117,3 +117,28 @@ def test_mesh_factorization():
     mesh = make_mesh()
     assert mesh.devices.size == 8
     assert mesh.axis_names == ("obj", "node")
+
+
+def test_rounding_quantiles_ignore_padding():
+    """Regression: quantile rounding must rank over REAL rows only.
+
+    130 identical objects padded to a 256-row bucket across 4 equal nodes
+    once yielded loads ~64/64/2/0 because padding rows stretched the
+    quantile range; correct behavior is ~33 objects per node.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rio_tpu.ops import plan_rounded_assign, sinkhorn
+
+    n_real, bucket, n_nodes = 130, 256, 4
+    cost = jnp.zeros((bucket, n_nodes), jnp.float32)
+    mass = jnp.concatenate(
+        [jnp.ones((n_real,), jnp.float32), jnp.zeros((bucket - n_real,), jnp.float32)]
+    )
+    cap = jnp.ones((n_nodes,), jnp.float32)
+    res = sinkhorn(cost, mass, cap, eps=0.05, n_iters=30)
+    assignment = np.asarray(plan_rounded_assign(cost, res.f, res.g, 0.05))[:n_real]
+    loads = np.bincount(assignment, minlength=n_nodes)
+    assert loads.sum() == n_real
+    assert loads.max() - loads.min() <= 2, loads
