@@ -30,6 +30,7 @@ from rio_tpu import (
     Server,
     ServiceObject,
     handler,
+    make_registry,
     message,
 )
 from rio_tpu.cluster.membership_protocol.peer_to_peer import (
@@ -89,8 +90,19 @@ class MetricAggregator(ServiceObject):
         return self.stats
 
 
+# Declarative registry + typed client stubs — the reference builds this
+# example with `make_registry!` (metric-aggregator/src/lib.rs); `decl.client`
+# carries `metric_aggregator.send_metric/send_get_stats` typed wrappers.
+decl = make_registry({
+    MetricAggregator: [
+        (Metric, Stats),
+        (GetStats, Stats),
+    ],
+})
+
+
 def build_registry() -> Registry:
-    return Registry().add_type(MetricAggregator)
+    return decl.registry()
 
 
 def sqlite_cluster(db: str):
@@ -124,11 +136,11 @@ async def run_server(db: str, port: int) -> None:
 async def run_loadall(db: str, n: int, name: str) -> None:
     members, _, _ = sqlite_cluster(db)
     client = Client(members)
+    send_metric = decl.client.metric_aggregator.send_metric
     t0 = time.perf_counter()
     for i in range(n):
-        await client.send(
-            MetricAggregator, name,
-            Metric(tag=f"tag{i % 10}", value=float(i % 100)), returns=Stats,
+        await send_metric(
+            client, name, Metric(tag=f"tag{i % 10}", value=float(i % 100))
         )
     dt = time.perf_counter() - t0
     print(f"[loadall] {n} requests in {dt:.2f}s = {n / dt:.0f} req/s", flush=True)

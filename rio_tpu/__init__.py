@@ -22,6 +22,7 @@ from .errors import RioError
 from .message_router import MessageRouter
 from .object_placement import LocalObjectPlacement, ObjectPlacement, ObjectPlacementItem
 from .registry import ObjectId, Registry, handler, message, type_id, type_name, wire_error
+from .registry.declarative import RegistryDeclaration, make_registry
 from .server import Server
 from .service_object import LifecycleKind, LifecycleMessage, ServiceObject
 
@@ -47,11 +48,13 @@ __all__ = [
     "ObjectPlacement",
     "ObjectPlacementItem",
     "Registry",
+    "RegistryDeclaration",
     "RioError",
     "Server",
     "ServerInfo",
     "ServiceObject",
     "handler",
+    "make_registry",
     "message",
     "type_id",
     "type_name",

@@ -54,13 +54,27 @@ class RedisObjectPlacement(ObjectPlacement):
         return raw.decode() if raw is not None else None
 
     async def clean_server(self, address: str) -> None:
-        keys = await self.client.execute("SMEMBERS", self._server_key(address))
+        """Bulk-unassign a dead node's objects.
+
+        The per-server set is a snapshot, so an object concurrently re-placed
+        onto a *live* node must not be deleted: re-read each key and delete
+        only those still pointing at ``address`` (the SQL backends get this
+        for free from ``DELETE WHERE server_address=?`` atomicity). Pipelined:
+        2 round trips + 1 variadic DEL regardless of object count.
+        """
+        raw_keys = await self.client.execute("SMEMBERS", self._server_key(address))
+        keys = [k.decode() for k in raw_keys or []]
         if keys:
-            # one variadic DEL, not one round trip per object: this runs on
-            # the dead-node path while requests are actively being redirected
-            await self.client.execute(
-                "DEL", *(self._obj_key(k.decode()) for k in keys)
+            current = await self.client.execute_pipeline(
+                [("GET", self._obj_key(k)) for k in keys]
             )
+            stale = [
+                self._obj_key(k)
+                for k, cur in zip(keys, current)
+                if isinstance(cur, bytes) and cur.decode() == address
+            ]
+            if stale:
+                await self.client.execute("DEL", *stale)
         await self.client.execute("DEL", self._server_key(address))
 
     async def remove(self, object_id: ObjectId) -> None:

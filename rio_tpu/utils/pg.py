@@ -59,7 +59,7 @@ class PgDb:
         self._driver = _find_driver()
         if self._driver is None:
             raise RuntimeError(
-                "no PostgreSQL driver installed (tried psycopg, psycopg2, pg8000); "
+                "no PostgreSQL driver installed (tried psycopg, psycopg2); "
                 "install one to use the Postgres backends"
             )
         self.dsn = dsn

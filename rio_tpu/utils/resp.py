@@ -114,7 +114,11 @@ class RedisClient:
         db = int(u.path.lstrip("/") or 0) if u.path.strip("/") else 0
         return cls(
             u.hostname or "127.0.0.1", u.port or 6379, db=db,
-            password=u.password, username=u.username, **kw,
+            password=u.password,
+            # '' (redis://:pw@host) means password-only auth: one-arg AUTH,
+            # not a lookup of the '' ACL user.
+            username=u.username or None,
+            **kw,
         )
 
     async def _acquire(self) -> _Conn:
@@ -129,13 +133,17 @@ class RedisClient:
                 asyncio.open_connection(self.host, self.port), self.connect_timeout
             )
             conn = _Conn(reader, writer)
-            if self.password is not None:
-                if self.username is not None:
-                    await conn.execute("AUTH", self.username, self.password)
-                else:
-                    await conn.execute("AUTH", self.password)
-            if self.db:
-                await conn.execute("SELECT", self.db)
+            try:
+                if self.password is not None:
+                    if self.username:
+                        await conn.execute("AUTH", self.username, self.password)
+                    else:
+                        await conn.execute("AUTH", self.password)
+                if self.db:
+                    await conn.execute("SELECT", self.db)
+            except BaseException:
+                conn.close()  # handshake failed: don't leak the socket
+                raise
             return conn
         except BaseException:
             self._sem.release()

@@ -278,5 +278,6 @@ async def test_redis_pipeline_and_url_credentials():
     assert (c.host, c.port, c.db, c.username, c.password) == (
         "10.0.0.5", 6380, 2, "user", "secret"
     )
+    # password-only URL: username must be None so AUTH uses the one-arg form
     c2 = RedisClient.from_url("redis://:pw@h")
-    assert (c2.port, c2.password, c2.username) == (6379, "pw", "")
+    assert (c2.port, c2.password, c2.username) == (6379, "pw", None)

@@ -1,0 +1,128 @@
+"""make_registry declaration layer: typed stubs + registration-time
+validation (the runtime analog of the reference's trybuild compile tests,
+``rio-macros/tests/ui.rs`` / ``rio-macros/src/registry.rs:190-195``)."""
+
+import pytest
+
+from rio_tpu import AppData, ServiceObject, handler, message, wire_error
+from rio_tpu.registry.declarative import make_registry
+
+from .server_utils import run_integration_test
+
+
+@message
+class Deposit:
+    amount: int = 0
+
+
+@message
+class GetBalance:
+    pass
+
+
+@message
+class Balance:
+    total: int = 0
+
+
+@wire_error
+class Overdraft(Exception):
+    pass
+
+
+class BankAccount(ServiceObject):
+    def __init__(self):
+        super().__init__()
+        self.total = 0
+
+    @handler
+    async def deposit(self, msg: Deposit, ctx: AppData) -> Balance:
+        if self.total + msg.amount < 0:
+            raise Overdraft(self.total)
+        self.total += msg.amount
+        return Balance(total=self.total)
+
+    @handler
+    async def get_balance(self, msg: GetBalance, ctx: AppData) -> Balance:
+        return Balance(total=self.total)
+
+
+def declare():
+    return make_registry({
+        BankAccount: [
+            (Deposit, Balance, Overdraft),
+            (GetBalance, Balance),
+        ],
+    })
+
+
+def test_declaration_builds_registry_and_stubs():
+    decl = declare()
+    reg = decl.registry()
+    assert reg.has_type("BankAccount")
+    assert reg.has_handler("BankAccount", "Deposit")
+    assert reg.has_handler("BankAccount", "GetBalance")
+    # independent registries per call (one per server)
+    assert decl.registry() is not reg
+    # typed stub namespace: client.bank_account.send_deposit / send_get_balance
+    ns = decl.client.bank_account
+    assert callable(ns.send_deposit) and callable(ns.send_get_balance)
+    assert decl.services == [BankAccount]
+
+
+@pytest.mark.asyncio
+async def test_typed_stubs_end_to_end():
+    decl = declare()
+
+    async def body(cluster):
+        client = cluster.client()
+        bank = decl.client.bank_account
+        b = await bank.send_deposit(client, "acct-1", Deposit(amount=30))
+        assert b == Balance(total=30)
+        b = await bank.send_deposit(client, "acct-1", Deposit(amount=12))
+        assert b.total == 42
+        assert (await bank.send_get_balance(client, "acct-1", GetBalance())).total == 42
+        # typed error tunnels through the stub
+        with pytest.raises(Overdraft):
+            await bank.send_deposit(client, "acct-1", Deposit(amount=-100))
+        # stub rejects the wrong message type before touching the wire
+        with pytest.raises(TypeError):
+            await bank.send_deposit(client, "acct-1", GetBalance())
+        client.close()
+
+    await run_integration_test(body, registry_builder=decl.registry, num_servers=2)
+
+
+# --- trybuild-fail equivalents ---------------------------------------------
+
+
+def test_missing_handler_rejected():
+    @message
+    class Unhandled:
+        pass
+
+    with pytest.raises(TypeError, match="no @handler for message Unhandled"):
+        make_registry({BankAccount: [(Unhandled, Balance)]})
+
+
+def test_return_type_mismatch_rejected():
+    with pytest.raises(TypeError, match="assert_handler_type"):
+        make_registry({BankAccount: [(Deposit, Deposit)]})
+
+
+def test_unregistered_error_rejected():
+    class NotWired(Exception):
+        pass
+
+    with pytest.raises(TypeError, match="@wire_error"):
+        make_registry({BankAccount: [(Deposit, Balance, NotWired)]})
+
+
+def test_non_exception_error_rejected():
+    with pytest.raises(TypeError, match="not an exception class"):
+        make_registry({BankAccount: [(Deposit, Balance, Balance)]})
+
+
+def test_bad_tuple_arity_rejected():
+    with pytest.raises(TypeError, match="Message, Response"):
+        make_registry({BankAccount: [(Deposit,)]})
