@@ -1,0 +1,115 @@
+"""observability: exporting request-path trace spans.
+
+Parity with the reference's observability example
+(``/root/reference/examples/observability/src/bin/observability_server.rs:37-63``),
+which wires ``tracing_subscriber`` + an OpenTelemetry OTLP layer into
+Jaeger. rio-tpu's span taxonomy mirrors the reference's
+(``frame_receive``, ``placement_lookup``, ``handler_handle``, …, see
+``rio_tpu/tracing.py``); sinks are pluggable the same way the reference's
+subscriber layers are. This demo registers two sinks:
+
+* the built-in ``logging_sink`` (the reference's fmt layer), and
+* an in-process aggregator standing where an OTLP exporter would go —
+  any callable ``Span -> None`` can forward to a collector.
+
+Run::
+
+    python examples/observability.py
+"""
+
+import asyncio
+import logging
+import statistics
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, ".")
+
+from rio_tpu import (
+    AppData,
+    Client,
+    LocalObjectPlacement,
+    LocalStorage,
+    Registry,
+    Server,
+    ServiceObject,
+    handler,
+    message,
+)
+from rio_tpu import tracing
+from rio_tpu.cluster.membership_protocol import LocalClusterProvider
+
+
+@message
+class Work:
+    item: str = ""
+
+
+@message
+class Ack:
+    item: str = ""
+
+
+class Worker(ServiceObject):
+    @handler
+    async def work(self, msg: Work, ctx: AppData) -> Ack:
+        await asyncio.sleep(0.002)  # pretend to do something
+        return Ack(item=msg.item)
+
+
+class SpanAggregator:
+    """Collects spans like an OTLP exporter would; prints a summary table."""
+
+    def __init__(self) -> None:
+        self.durations: dict[str, list[float]] = defaultdict(list)
+
+    def __call__(self, span: tracing.Span) -> None:
+        self.durations[span.name].append(span.duration * 1e3)
+
+    def report(self) -> None:
+        print(f"{'span':<28}{'count':>6}{'mean ms':>10}{'p99 ms':>10}")
+        for name in sorted(self.durations):
+            d = self.durations[name]
+            p99 = statistics.quantiles(d, n=100)[98] if len(d) >= 2 else d[0]
+            print(f"{name:<28}{len(d):>6}{statistics.fmean(d):>10.3f}{p99:>10.3f}")
+
+
+async def main() -> None:
+    logging.basicConfig(level=logging.INFO)  # DEBUG to see per-span log lines
+    aggregator = SpanAggregator()
+    tracing.add_sink(tracing.logging_sink)
+    tracing.add_sink(aggregator)
+
+    members = LocalStorage()
+    placement = LocalObjectPlacement()
+    servers = []
+    for _ in range(2):
+        s = Server(
+            address="127.0.0.1:0",
+            registry=Registry().add_type(Worker),
+            cluster_provider=LocalClusterProvider(members),
+            object_placement_provider=placement,
+        )
+        await s.prepare()
+        print(f"[server] traced node on {await s.bind()}")
+        servers.append(s)
+    tasks = [asyncio.create_task(s.run()) for s in servers]
+    await asyncio.sleep(0.1)
+
+    client = Client(members)
+    for i in range(50):
+        await client.send(Worker, f"w{i % 5}", Work(item=f"job-{i}"), returns=Ack)
+    client.close()
+
+    for t in tasks:
+        t.cancel()
+    await asyncio.gather(*tasks, return_exceptions=True)
+
+    print("\n[trace] span summary (what an OTLP exporter would ship):")
+    aggregator.report()
+    tracing.clear_sinks()
+    print("[demo] done")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

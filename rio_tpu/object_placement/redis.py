@@ -56,11 +56,14 @@ class RedisObjectPlacement(ObjectPlacement):
     async def clean_server(self, address: str) -> None:
         """Bulk-unassign a dead node's objects.
 
-        The per-server set is a snapshot, so an object concurrently re-placed
-        onto a *live* node must not be deleted: re-read each key and delete
-        only those still pointing at ``address`` (the SQL backends get this
-        for free from ``DELETE WHERE server_address=?`` atomicity). Pipelined:
-        2 round trips + 1 variadic DEL regardless of object count.
+        The per-server set is a snapshot, so re-read each key and delete only
+        those still pointing at ``address`` — a re-placement that lands
+        before the GET survives. A re-placement racing *between* the GET and
+        the DEL can still be lost (check-then-act; closing it fully needs
+        Lua/WATCH compare-and-delete) — the same exposure class as the
+        reference's snapshot-then-delete Redis impl, vs. the SQL backends'
+        atomic ``DELETE WHERE server_address=?``. Pipelined: 2 round trips +
+        1 variadic DEL regardless of object count.
         """
         raw_keys = await self.client.execute("SMEMBERS", self._server_key(address))
         keys = [k.decode() for k in raw_keys or []]

@@ -90,12 +90,26 @@ class Registry:
 
     # -- type / handler registration (reference registry/mod.rs:82-182) ----
 
-    def add_type(self, cls: type, constructor: Callable[[], Any] | None = None) -> "Registry":
-        """Register a service class: constructor + all its ``@handler`` methods."""
+    def add_type(
+        self,
+        cls: type,
+        constructor: Callable[[], Any] | None = None,
+        *,
+        auto_handlers: bool = True,
+    ) -> "Registry":
+        """Register a service class: constructor + all its ``@handler`` methods.
+
+        ``auto_handlers=False`` registers the constructor only — used by the
+        declarative layer (``make_registry``) to expose exactly the declared
+        message surface and nothing else.
+        """
         tname = type_id(cls)
         self._constructors[tname] = constructor or cls
         for spec in resolve_handlers(cls):
-            self._handlers[(tname, spec.message_type_name)] = spec
+            # Lifecycle dispatch (activation Load) is framework plumbing and
+            # must exist regardless of the declared message surface.
+            if auto_handlers or spec.message_type_name == "rio.LifecycleMessage":
+                self._handlers[(tname, spec.message_type_name)] = spec
         return self
 
     def add_handler(self, cls: type, msg_cls: type, fn: Callable, returns: Any = Any) -> "Registry":

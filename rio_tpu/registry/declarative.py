@@ -96,12 +96,17 @@ class RegistryDeclaration:
 
     def registry(self) -> Registry:
         """Fresh :class:`Registry` with every declared type + handler
-        (one per server, like the generated ``server::registry()``)."""
+        (one per server, like the generated ``server::registry()``).
+
+        Only *declared* handlers are exposed: undeclared ``@handler`` methods
+        on the class stay unreachable over the wire, exactly like the macro,
+        whose expansion registers only the listed message types.
+        """
         reg = Registry()
         seen: set[type] = set()
         for e in self._entries:
             if e.service not in seen:
-                reg.add_type(e.service)
+                reg.add_type(e.service, auto_handlers=False)
                 seen.add(e.service)
             reg.add_handler(e.service, e.spec.message_type, e.spec.fn, returns=e.response)
         return reg

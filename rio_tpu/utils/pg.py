@@ -5,8 +5,8 @@ reuse the SQLite backends' query logic (the reference keeps the same shape
 between its sqlx SQLite and Postgres impls, e.g.
 ``rio-rs/src/cluster/storage/postgres.rs:28-56`` vs ``sqlite.rs:74-92``).
 
-The driver is discovered at runtime — ``psycopg`` (v3), ``psycopg2``, or
-``pg8000`` — and queries written with ``?`` placeholders are translated to
+The driver is discovered at runtime — ``psycopg`` (v3) or ``psycopg2`` —
+and queries written with ``?`` placeholders are translated to
 the DBAPI ``%s`` paramstyle. If no driver is installed, constructing a
 :class:`PgDb` raises a clear error; the rest of the framework never imports
 this module unless a Postgres backend is requested (the reference gates the

@@ -1,0 +1,107 @@
+"""Black-jack game-rule unit tests (reference
+``examples/black-jack/tests/game.rs``): pure rules, no framework."""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "examples"))
+
+from black_jack import (  # noqa: E402
+    Deck,
+    GameEngine,
+    dealer_should_hit,
+    hand_value,
+    is_blackjack,
+    settle,
+)
+
+
+def test_hand_values():
+    assert hand_value(["2♠", "3♥"]) == 5
+    assert hand_value(["K♠", "Q♥"]) == 20
+    assert hand_value(["A♠", "K♥"]) == 21            # blackjack
+    assert hand_value(["A♠", "A♥"]) == 12            # one ace demotes
+    assert hand_value(["A♠", "A♥", "A♦", "A♣"]) == 14
+    assert hand_value(["A♠", "9♥", "5♦"]) == 15      # soft 15 -> hard
+    assert hand_value(["K♠", "Q♥", "5♦"]) == 25      # bust stays bust
+
+
+def test_blackjack_detection():
+    assert is_blackjack(["A♠", "J♥"])
+    assert not is_blackjack(["A♠", "5♥", "5♦"])      # 21 in 3 cards ≠ blackjack
+    assert not is_blackjack(["10♠", "9♥"])
+
+
+def test_dealer_policy_draws_to_17():
+    assert dealer_should_hit(["K♠", "6♥"])           # 16: hit
+    assert not dealer_should_hit(["K♠", "7♥"])       # 17: stand
+    assert not dealer_should_hit(["A♠", "6♥"])       # soft 17: stand (all 17s)
+
+
+def test_settle_outcomes():
+    assert settle(["K♠", "Q♥", "5♦"], ["K♥", "7♦"]) == "player_bust"
+    assert settle(["A♠", "K♥"], ["K♦", "9♣"]) == "player_blackjack"
+    assert settle(["A♠", "K♥"], ["A♦", "K♣"]) == "push"  # BJ vs BJ
+    assert settle(["A♠", "5♥", "5♦"], ["A♦", "K♣"]) == "dealer_win"  # natural beats made 21
+    assert settle(["A♠", "K♥"], ["A♦", "5♣", "5♥"]) == "player_blackjack"
+    assert settle(["10♠", "9♥"], ["K♦", "6♣", "9♠"]) == "dealer_bust"
+    assert settle(["10♠", "9♥"], ["K♦", "8♣"]) == "player_win"
+    assert settle(["10♠", "7♥"], ["K♦", "8♣"]) == "dealer_win"
+    assert settle(["10♠", "8♥"], ["K♦", "8♣"]) == "push"
+
+
+def test_deck_is_seeded_and_complete():
+    d1, d2 = Deck(seed=42), Deck(seed=42)
+    assert d1.cards == d2.cards
+    assert len(set(d1.cards)) == 52
+    assert Deck(seed=1).cards != Deck(seed=2).cards
+
+
+def test_engine_full_round():
+    eng = GameEngine("t1", seed=7)
+    s = eng.apply("join", "ada")
+    assert s.phase in ("player_turn", "settled")
+    if s.phase == "player_turn":
+        s = eng.apply("stand")
+    assert s.phase == "settled"
+    assert s.outcome in (
+        "player_win", "dealer_win", "push",
+        "player_blackjack", "player_bust", "dealer_bust",
+    )
+    # dealer finished by policy
+    assert not dealer_should_hit(s.dealer_cards) or s.outcome == "player_bust"
+
+
+def test_engine_player_bust():
+    eng = GameEngine("t2", seed=3)
+    s = eng.apply("join", "bob")
+    while s.phase == "player_turn":
+        s = eng.apply("hit")
+    assert s.phase == "settled"
+    if hand_value(s.player_cards) > 21:
+        assert s.outcome == "player_bust"
+
+
+def test_engine_rejects_out_of_phase_commands():
+    eng = GameEngine("t3", seed=5)
+    with pytest.raises(ValueError):
+        eng.apply("hit")                # can't hit before joining
+    s = eng.apply("join", "cy")
+    if s.phase == "settled":            # dealt blackjack: no more moves
+        with pytest.raises(ValueError):
+            eng.apply("stand")
+    else:
+        eng.apply("stand")
+        with pytest.raises(ValueError):
+            eng.apply("hit")            # settled: no more hits
+
+
+def test_dealer_hidden_card_until_settled():
+    eng = GameEngine("t4", seed=11)
+    s = eng.apply("join", "dee")
+    if s.phase == "player_turn":
+        assert s.visible_dealer()[1] == "??"
+        s = eng.apply("stand")
+    assert "??" not in s.visible_dealer()

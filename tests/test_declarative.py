@@ -126,3 +126,12 @@ def test_non_exception_error_rejected():
 def test_bad_tuple_arity_rejected():
     with pytest.raises(TypeError, match="Message, Response"):
         make_registry({BankAccount: [(Deposit,)]})
+
+
+def test_undeclared_handlers_not_exposed():
+    """Only the declared message surface is reachable over the wire (the
+    macro registers exactly the listed pairs, nothing more)."""
+    decl = make_registry({BankAccount: [(GetBalance, Balance)]})
+    reg = decl.registry()
+    assert reg.has_handler("BankAccount", "GetBalance")
+    assert not reg.has_handler("BankAccount", "Deposit")
