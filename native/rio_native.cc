@@ -1,0 +1,851 @@
+// rio-tpu native data plane.
+//
+// Two subsystems behind a plain-C ABI (consumed from Python via ctypes):
+//
+//  1. Wire codec — encoders/decoders for the framework's envelope types
+//     (RequestEnvelope / ResponseEnvelope / Subscription{Request,Response})
+//     in the exact positional-msgpack layout of rio_tpu/codec.py +
+//     rio_tpu/protocol.py, plus an incremental length-delimited frame
+//     reader. The reference implements this layer with tokio's
+//     LengthDelimitedCodec + bincode (rio-rs/src/service.rs:370-378,
+//     client/mod.rs:199-203); here it is C++ so the per-frame hot path
+//     does no Python-level packing.
+//
+//  2. Connection engine — an epoll-driven TCP server loop owning the
+//     listening socket, connection lifecycle, framing, and write
+//     backpressure on a dedicated native thread (the reference's accept +
+//     per-connection frame loops, rio-rs/src/server.rs:285-305 and
+//     service.rs:370-459). Completed frames are queued to Python through
+//     an eventfd + drain call; Python never touches a socket.
+//
+// No Python.h dependency: the library is pure C++/syscalls, so native
+// threads run fully outside the GIL.
+
+#include <algorithm>
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <utility>
+#include <vector>
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr size_t kMaxFrame = 8u * 1024u * 1024u;  // codec.py MAX_FRAME
+
+// ---------------------------------------------------------------------------
+// msgpack writer (the subset the protocol uses)
+// ---------------------------------------------------------------------------
+
+struct Writer {
+  std::vector<uint8_t> buf;
+
+  void u8(uint8_t v) { buf.push_back(v); }
+  void raw(const uint8_t* p, size_t n) { buf.insert(buf.end(), p, p + n); }
+  void be16(uint16_t v) {
+    u8(static_cast<uint8_t>(v >> 8));
+    u8(static_cast<uint8_t>(v));
+  }
+  void be32(uint32_t v) {
+    u8(static_cast<uint8_t>(v >> 24));
+    u8(static_cast<uint8_t>(v >> 16));
+    u8(static_cast<uint8_t>(v >> 8));
+    u8(static_cast<uint8_t>(v));
+  }
+  void fixarray(uint8_t n) { u8(0x90 | n); }  // n < 16 throughout the protocol
+  void boolean(bool v) { u8(v ? 0xc3 : 0xc2); }
+  void uint(uint64_t v) {
+    if (v < 0x80) {
+      u8(static_cast<uint8_t>(v));
+    } else if (v <= 0xff) {
+      u8(0xcc);
+      u8(static_cast<uint8_t>(v));
+    } else if (v <= 0xffff) {
+      u8(0xcd);
+      be16(static_cast<uint16_t>(v));
+    } else if (v <= 0xffffffffull) {
+      u8(0xce);
+      be32(static_cast<uint32_t>(v));
+    } else {
+      u8(0xcf);
+      for (int i = 7; i >= 0; --i) u8(static_cast<uint8_t>(v >> (8 * i)));
+    }
+  }
+  void str(const uint8_t* p, uint32_t n) {
+    if (n < 32) {
+      u8(0xa0 | static_cast<uint8_t>(n));
+    } else if (n <= 0xff) {
+      u8(0xd9);
+      u8(static_cast<uint8_t>(n));
+    } else if (n <= 0xffff) {
+      u8(0xda);
+      be16(static_cast<uint16_t>(n));
+    } else {
+      u8(0xdb);
+      be32(n);
+    }
+    raw(p, n);
+  }
+  void bin(const uint8_t* p, uint32_t n) {
+    if (n <= 0xff) {
+      u8(0xc4);
+      u8(static_cast<uint8_t>(n));
+    } else if (n <= 0xffff) {
+      u8(0xc5);
+      be16(static_cast<uint16_t>(n));
+    } else {
+      u8(0xc6);
+      be32(n);
+    }
+    raw(p, n);
+  }
+};
+
+// Wrap the writer's body in a 4-byte big-endian length prefix; malloc'd so
+// Python frees with rn_free.
+uint8_t* finish_frame(const Writer& w, uint32_t* out_len) {
+  size_t body = w.buf.size();
+  if (body > kMaxFrame) return nullptr;
+  auto* out = static_cast<uint8_t*>(std::malloc(body + 4));
+  if (!out) return nullptr;
+  out[0] = static_cast<uint8_t>(body >> 24);
+  out[1] = static_cast<uint8_t>(body >> 16);
+  out[2] = static_cast<uint8_t>(body >> 8);
+  out[3] = static_cast<uint8_t>(body);
+  std::memcpy(out + 4, w.buf.data(), body);
+  *out_len = static_cast<uint32_t>(body + 4);
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// msgpack parser (zero-copy: string/bin results are spans into the input)
+// ---------------------------------------------------------------------------
+
+struct Parser {
+  const uint8_t* base;
+  const uint8_t* p;
+  const uint8_t* end;
+
+  explicit Parser(const uint8_t* buf, size_t len)
+      : base(buf), p(buf), end(buf + len) {}
+
+  bool need(size_t n) const { return static_cast<size_t>(end - p) >= n; }
+  uint64_t be(int n) {
+    uint64_t v = 0;
+    for (int i = 0; i < n; ++i) v = (v << 8) | p[i];
+    p += n;
+    return v;
+  }
+  // Returns element count, or -1 on malformed input.
+  int array_header() {
+    if (!need(1)) return -1;
+    uint8_t t = *p++;
+    if ((t & 0xf0) == 0x90) return t & 0x0f;
+    if (t == 0xdc) return need(2) ? static_cast<int>(be(2)) : -1;
+    if (t == 0xdd) return need(4) ? static_cast<int>(be(4)) : -1;
+    return -1;
+  }
+  // Accepts str*, bin*, or nil (as an empty span) — the Python codec packs
+  // text fields as str and payloads as bin, but be liberal on input.
+  bool str_or_bin(uint32_t* off, uint32_t* len) {
+    if (!need(1)) return false;
+    uint8_t t = *p++;
+    uint64_t n;
+    if ((t & 0xe0) == 0xa0) {
+      n = t & 0x1f;
+    } else if (t == 0xd9 || t == 0xc4) {
+      if (!need(1)) return false;
+      n = be(1);
+    } else if (t == 0xda || t == 0xc5) {
+      if (!need(2)) return false;
+      n = be(2);
+    } else if (t == 0xdb || t == 0xc6) {
+      if (!need(4)) return false;
+      n = be(4);
+    } else if (t == 0xc0) {  // nil → empty (ResponseEnvelope body=None)
+      *off = static_cast<uint32_t>(p - base);
+      *len = 0;
+      return true;
+    } else {
+      return false;
+    }
+    if (!need(n)) return false;
+    *off = static_cast<uint32_t>(p - base);
+    *len = static_cast<uint32_t>(n);
+    p += n;
+    return true;
+  }
+  bool uint_(uint64_t* out) {
+    if (!need(1)) return false;
+    uint8_t t = *p++;
+    if (t < 0x80) {
+      *out = t;
+      return true;
+    }
+    if (t == 0xcc) {
+      if (!need(1)) return false;
+      *out = be(1);
+      return true;
+    }
+    if (t == 0xcd) {
+      if (!need(2)) return false;
+      *out = be(2);
+      return true;
+    }
+    if (t == 0xce) {
+      if (!need(4)) return false;
+      *out = be(4);
+      return true;
+    }
+    if (t == 0xcf) {
+      if (!need(8)) return false;
+      *out = be(8);
+      return true;
+    }
+    return false;
+  }
+  bool boolean(bool* out) {
+    if (!need(1)) return false;
+    uint8_t t = *p++;
+    if (t == 0xc2) {
+      *out = false;
+      return true;
+    }
+    if (t == 0xc3) {
+      *out = true;
+      return true;
+    }
+    return false;
+  }
+};
+
+// [false, [kind, detail, payload]] error arm shared by ResponseEnvelope and
+// SubscriptionResponse. Fills kind + offs/lens[0]=detail, [1]=payload.
+bool parse_error_arm(Parser& pr, uint32_t* kind, uint32_t* offs, uint32_t* lens) {
+  if (pr.array_header() != 3) return false;
+  uint64_t k;
+  if (!pr.uint_(&k)) return false;
+  *kind = static_cast<uint32_t>(k);
+  if (!pr.str_or_bin(&offs[0], &lens[0])) return false;
+  if (!pr.str_or_bin(&offs[1], &lens[1])) return false;
+  return true;
+}
+
+// Shared length-prefix extraction: pulls every complete frame out of buf
+// (compacting it), invoking on_frame(ptr, len) per frame. Returns false when
+// an oversized frame poisons the stream.
+template <typename F>
+bool extract_frames(std::vector<uint8_t>& buf, F&& on_frame) {
+  size_t scan = 0;
+  bool ok = true;
+  while (buf.size() - scan >= 4) {
+    const uint8_t* h = buf.data() + scan;
+    size_t n = (size_t(h[0]) << 24) | (size_t(h[1]) << 16) |
+               (size_t(h[2]) << 8) | size_t(h[3]);
+    if (n > kMaxFrame) {
+      ok = false;
+      break;
+    }
+    if (buf.size() - scan < 4 + n) break;
+    on_frame(h + 4, n);
+    scan += 4 + n;
+  }
+  if (scan > 0) buf.erase(buf.begin(), buf.begin() + static_cast<long>(scan));
+  return ok;
+}
+
+}  // namespace
+
+extern "C" {
+
+void rn_free(uint8_t* ptr) { std::free(ptr); }
+
+// --- envelope encoders (all return a malloc'd complete frame: 4-byte BE
+//     length prefix + payload; caller frees with rn_free) -------------------
+
+// Frame payload = 0x00 kind byte + msgpack [handler_type, handler_id,
+// message_type, payload]  (protocol.py encode_request_frame).
+uint8_t* rn_encode_request_frame(const uint8_t* ht, uint32_t htl,
+                                 const uint8_t* hid, uint32_t hidl,
+                                 const uint8_t* mt, uint32_t mtl,
+                                 const uint8_t* pay, uint32_t pl,
+                                 uint32_t* out_len) {
+  Writer w;
+  w.u8(0x00);
+  w.fixarray(4);
+  w.str(ht, htl);
+  w.str(hid, hidl);
+  w.str(mt, mtl);
+  w.bin(pay, pl);
+  return finish_frame(w, out_len);
+}
+
+// Frame payload = 0x01 kind byte + msgpack [handler_type, handler_id].
+uint8_t* rn_encode_subscribe_frame(const uint8_t* ht, uint32_t htl,
+                                   const uint8_t* hid, uint32_t hidl,
+                                   uint32_t* out_len) {
+  Writer w;
+  w.u8(0x01);
+  w.fixarray(2);
+  w.str(ht, htl);
+  w.str(hid, hidl);
+  return finish_frame(w, out_len);
+}
+
+// ResponseEnvelope ok arm: [true, body].
+uint8_t* rn_encode_response_ok_frame(const uint8_t* body, uint32_t blen,
+                                     uint32_t* out_len) {
+  Writer w;
+  w.fixarray(2);
+  w.boolean(true);
+  w.bin(body, blen);
+  return finish_frame(w, out_len);
+}
+
+// ResponseEnvelope error arm: [false, [kind, detail, payload]].
+uint8_t* rn_encode_response_err_frame(uint32_t kind, const uint8_t* detail,
+                                      uint32_t dlen, const uint8_t* pay,
+                                      uint32_t plen, uint32_t* out_len) {
+  Writer w;
+  w.fixarray(2);
+  w.boolean(false);
+  w.fixarray(3);
+  w.uint(kind);
+  w.str(detail, dlen);
+  w.bin(pay, plen);
+  return finish_frame(w, out_len);
+}
+
+// SubscriptionResponse ok arm: [true, message_type, body].
+uint8_t* rn_encode_subresponse_ok_frame(const uint8_t* mt, uint32_t mtl,
+                                        const uint8_t* body, uint32_t blen,
+                                        uint32_t* out_len) {
+  Writer w;
+  w.fixarray(3);
+  w.boolean(true);
+  w.str(mt, mtl);
+  w.bin(body, blen);
+  return finish_frame(w, out_len);
+}
+
+// SubscriptionResponse error arm: [false, [kind, detail, payload]].
+uint8_t* rn_encode_subresponse_err_frame(uint32_t kind, const uint8_t* detail,
+                                         uint32_t dlen, const uint8_t* pay,
+                                         uint32_t plen, uint32_t* out_len) {
+  Writer w;
+  w.fixarray(2);
+  w.boolean(false);
+  w.fixarray(3);
+  w.uint(kind);
+  w.str(detail, dlen);
+  w.bin(pay, plen);
+  return finish_frame(w, out_len);
+}
+
+// --- inbound decoders (zero-copy: offs/lens index into the input buffer) ---
+
+// Server-side decode of one frame payload (kind byte + body).
+// Returns 0 = request (offs/lens[0..3] = handler_type, handler_id,
+// message_type, payload), 1 = subscribe (offs/lens[0..1]), -1 = malformed.
+int rn_decode_inbound(const uint8_t* buf, uint32_t len, uint32_t* offs,
+                      uint32_t* lens) {
+  if (len == 0) return -1;
+  Parser pr(buf, len);
+  uint8_t kind = *pr.p++;
+  if (kind == 0x00) {
+    if (pr.array_header() != 4) return -1;
+    for (int i = 0; i < 4; ++i)
+      if (!pr.str_or_bin(&offs[i], &lens[i])) return -1;
+    return 0;
+  }
+  if (kind == 0x01) {
+    if (pr.array_header() != 2) return -1;
+    for (int i = 0; i < 2; ++i)
+      if (!pr.str_or_bin(&offs[i], &lens[i])) return -1;
+    return 1;
+  }
+  return -1;
+}
+
+// Client-side decode of a ResponseEnvelope payload.
+// Returns 1 = ok (offs/lens[0] = body), 0 = error (*kind, offs/lens[0] =
+// detail, [1] = payload), -1 = malformed.
+int rn_decode_response(const uint8_t* buf, uint32_t len, uint32_t* kind,
+                       uint32_t* offs, uint32_t* lens) {
+  Parser pr(buf, len);
+  if (pr.array_header() != 2) return -1;
+  bool ok;
+  if (!pr.boolean(&ok)) return -1;
+  if (ok) {
+    if (!pr.str_or_bin(&offs[0], &lens[0])) return -1;
+    return 1;
+  }
+  if (!parse_error_arm(pr, kind, offs, lens)) return -1;
+  return 0;
+}
+
+// Client-side decode of a SubscriptionResponse payload.
+// Returns 1 = ok (offs/lens[0] = message_type, [1] = body), 0 = error
+// (*kind, offs/lens[0] = detail, [1] = payload), -1 = malformed.
+int rn_decode_subresponse(const uint8_t* buf, uint32_t len, uint32_t* kind,
+                          uint32_t* offs, uint32_t* lens) {
+  Parser pr(buf, len);
+  int n = pr.array_header();
+  if (n == 3) {
+    bool ok;
+    if (!pr.boolean(&ok) || !ok) return -1;
+    if (!pr.str_or_bin(&offs[0], &lens[0])) return -1;
+    if (!pr.str_or_bin(&offs[1], &lens[1])) return -1;
+    return 1;
+  }
+  if (n == 2) {
+    bool ok;
+    if (!pr.boolean(&ok) || ok) return -1;
+    if (!parse_error_arm(pr, kind, offs, lens)) return -1;
+    return 0;
+  }
+  return -1;
+}
+
+// --- incremental frame reader ---------------------------------------------
+
+struct RnReader {
+  std::vector<uint8_t> buf;
+  std::deque<std::vector<uint8_t>> ready;
+  std::vector<uint8_t> current;  // frame handed to Python, kept alive
+};
+
+void* rn_reader_new() { return new RnReader(); }
+void rn_reader_free(void* r) { delete static_cast<RnReader*>(r); }
+
+// Appends bytes, extracts complete frames. Returns the number of frames now
+// queued, or -1 if a frame exceeds the max size (connection is poisoned).
+int rn_reader_feed(void* rp, const uint8_t* data, uint32_t len) {
+  auto* r = static_cast<RnReader*>(rp);
+  r->buf.insert(r->buf.end(), data, data + len);
+  if (!extract_frames(r->buf, [&](const uint8_t* p, size_t n) {
+        r->ready.emplace_back(p, p + n);
+      }))
+    return -1;
+  return static_cast<int>(r->ready.size());
+}
+
+// Pops the next frame; the returned pointer stays valid until the next call
+// to rn_reader_next or rn_reader_free. Returns 1, or 0 when empty.
+int rn_reader_next(void* rp, const uint8_t** data, uint32_t* len) {
+  auto* r = static_cast<RnReader*>(rp);
+  if (r->ready.empty()) return 0;
+  r->current = std::move(r->ready.front());
+  r->ready.pop_front();
+  *data = r->current.data();
+  *len = static_cast<uint32_t>(r->current.size());
+  return 1;
+}
+
+// --- epoll connection engine ----------------------------------------------
+
+enum : uint32_t {
+  RN_EV_FRAME = 1,   // data = frame payload
+  RN_EV_CLOSED = 2,  // data = empty
+  RN_EV_OPENED = 3,  // data = "ip:port" of the peer
+};
+
+struct RnEventOut {
+  uint32_t type;
+  uint32_t pad;
+  uint64_t conn;
+  const uint8_t* data;
+  uint64_t len;
+};
+
+namespace {
+
+struct Conn {
+  int fd = -1;
+  std::vector<uint8_t> rbuf;
+  std::deque<std::vector<uint8_t>> wq;
+  size_t woff = 0;
+  bool epollout = false;
+  bool read_eof = false;       // peer half-closed; write side may still flow
+  bool close_pending = false;  // close requested; waiting for wq to flush
+};
+
+struct EngineEvent {
+  uint32_t type;
+  uint64_t conn;
+  std::vector<uint8_t> data;
+};
+
+struct Engine {
+  int epfd = -1;
+  int listen_fd = -1;
+  int notify_fd = -1;  // engine → Python (readable when events pending)
+  int wake_fd = -1;    // Python → engine (sends/closes queued)
+  uint16_t port = 0;
+  std::thread thr;
+  std::atomic<bool> stopping{false};
+
+  std::mutex mu;
+  std::vector<EngineEvent> events;    // pending for Python
+  std::vector<EngineEvent> drained;   // alive until next drain
+  std::vector<std::pair<uint64_t, std::vector<uint8_t>>> outq;
+  std::vector<uint64_t> closeq;
+
+  std::unordered_map<uint64_t, Conn> conns;  // IO-thread only
+  uint64_t next_id = 1;
+
+  void notify() {
+    uint64_t one = 1;
+    ssize_t rc = write(notify_fd, &one, 8);
+    (void)rc;
+  }
+  void push_event(uint32_t type, uint64_t conn, std::vector<uint8_t> data) {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      events.push_back(EngineEvent{type, conn, std::move(data)});
+    }
+    notify();
+  }
+};
+
+void set_nonblock(int fd) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+void engine_close_conn(Engine* e, uint64_t id, bool emit) {
+  auto it = e->conns.find(id);
+  if (it == e->conns.end()) return;
+  epoll_ctl(e->epfd, EPOLL_CTL_DEL, it->second.fd, nullptr);
+  close(it->second.fd);
+  e->conns.erase(it);
+  if (emit) e->push_event(RN_EV_CLOSED, id, {});
+}
+
+// Flush as much of conn's write queue as the socket accepts; manage EPOLLOUT
+// interest. Returns false if the connection died (or was finally closed).
+bool engine_flush(Engine* e, uint64_t id, Conn& c) {
+  while (!c.wq.empty()) {
+    auto& front = c.wq.front();
+    ssize_t n = send(c.fd, front.data() + c.woff, front.size() - c.woff,
+                     MSG_NOSIGNAL);
+    if (n > 0) {
+      c.woff += static_cast<size_t>(n);
+      if (c.woff == front.size()) {
+        c.wq.pop_front();
+        c.woff = 0;
+      }
+      continue;
+    }
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+    engine_close_conn(e, id, true);
+    return false;
+  }
+  if (c.wq.empty() && c.close_pending) {
+    engine_close_conn(e, id, false);
+    return false;
+  }
+  bool want = !c.wq.empty();
+  if (want != c.epollout) {
+    c.epollout = want;
+    epoll_event ev{};
+    ev.events = (c.read_eof ? 0u : EPOLLIN) | (want ? EPOLLOUT : 0u);
+    ev.data.u64 = id;
+    epoll_ctl(e->epfd, EPOLL_CTL_MOD, c.fd, &ev);
+  }
+  return true;
+}
+
+void engine_handle_readable(Engine* e, uint64_t id, Conn& c) {
+  char tmp[65536];
+  std::vector<EngineEvent> batch;
+  bool hard_close = false;  // poisoned stream / socket error
+  bool soft_eof = false;    // clean EOF; keep the write side open
+  while (true) {
+    ssize_t n = recv(c.fd, tmp, sizeof(tmp), 0);
+    if (n > 0) {
+      c.rbuf.insert(c.rbuf.end(), tmp, tmp + n);
+      if (!extract_frames(c.rbuf, [&](const uint8_t* p, size_t flen) {
+            batch.push_back(
+                EngineEvent{RN_EV_FRAME, id, std::vector<uint8_t>(p, p + flen)});
+          })) {
+        // Poisoned stream: drop the connection (service.py does the same).
+        hard_close = true;
+        break;
+      }
+      continue;
+    }
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+    if (n == 0) {
+      // Half-close: a request that arrived in this same burst
+      // (write-then-shutdown peers) must still be dispatched AND answered,
+      // so the frames queue first, CLOSED follows them, and the fd stays
+      // open for writes until Python closes it after responding.
+      soft_eof = true;
+    } else {
+      hard_close = true;
+    }
+    break;
+  }
+  if (!batch.empty()) {
+    {
+      std::lock_guard<std::mutex> lk(e->mu);
+      for (auto& ev : batch) e->events.push_back(std::move(ev));
+    }
+    e->notify();
+  }
+  if (hard_close) {
+    engine_close_conn(e, id, true);
+  } else if (soft_eof && !c.read_eof) {
+    c.read_eof = true;
+    epoll_event ev{};
+    ev.events = c.epollout ? EPOLLOUT : 0u;
+    ev.data.u64 = id;
+    epoll_ctl(e->epfd, EPOLL_CTL_MOD, c.fd, &ev);
+    e->push_event(RN_EV_CLOSED, id, {});
+  }
+}
+
+void engine_accept_all(Engine* e) {
+  while (true) {
+    sockaddr_in peer{};
+    socklen_t plen = sizeof(peer);
+    int fd = accept4(e->listen_fd, reinterpret_cast<sockaddr*>(&peer), &plen,
+                     SOCK_NONBLOCK | SOCK_CLOEXEC);
+    if (fd < 0) return;
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    uint64_t id = e->next_id++;
+    Conn c;
+    c.fd = fd;
+    e->conns.emplace(id, std::move(c));
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u64 = id;
+    epoll_ctl(e->epfd, EPOLL_CTL_ADD, fd, &ev);
+    char ip[64];
+    inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof(ip));
+    std::string addr = std::string(ip) + ":" + std::to_string(ntohs(peer.sin_port));
+    e->push_event(RN_EV_OPENED, id,
+                  std::vector<uint8_t>(addr.begin(), addr.end()));
+  }
+}
+
+void engine_handle_wake(Engine* e) {
+  uint64_t buf;
+  while (read(e->wake_fd, &buf, 8) == 8) {
+  }
+  std::vector<std::pair<uint64_t, std::vector<uint8_t>>> outs;
+  std::vector<uint64_t> closes;
+  {
+    std::lock_guard<std::mutex> lk(e->mu);
+    outs.swap(e->outq);
+    closes.swap(e->closeq);
+  }
+  for (auto& [id, data] : outs) {
+    auto it = e->conns.find(id);
+    if (it == e->conns.end()) continue;
+    it->second.wq.push_back(std::move(data));
+  }
+  // Flush every connection we touched (dedup via the map walk is fine at
+  // these sizes; typical batches touch a handful of conns).
+  for (auto& [id, data] : outs) {
+    (void)data;
+    auto it = e->conns.find(id);
+    if (it != e->conns.end()) engine_flush(e, id, it->second);
+  }
+  for (uint64_t id : closes) {
+    auto it = e->conns.find(id);
+    if (it == e->conns.end()) continue;
+    if (it->second.wq.empty())
+      engine_close_conn(e, id, false);
+    else
+      it->second.close_pending = true;  // close once the responses flush
+  }
+}
+
+void engine_loop(Engine* e) {
+  constexpr uint64_t kListenTag = 0;
+  constexpr uint64_t kWakeTag = UINT64_MAX;
+  epoll_event evs[128];
+  while (!e->stopping.load(std::memory_order_relaxed)) {
+    int n = epoll_wait(e->epfd, evs, 128, 500);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    for (int i = 0; i < n; ++i) {
+      uint64_t tag = evs[i].data.u64;
+      if (tag == kListenTag) {
+        engine_accept_all(e);
+        continue;
+      }
+      if (tag == kWakeTag) {
+        engine_handle_wake(e);
+        continue;
+      }
+      auto it = e->conns.find(tag);
+      if (it == e->conns.end()) continue;
+      if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+        engine_close_conn(e, tag, true);
+        continue;
+      }
+      if (evs[i].events & EPOLLOUT) {
+        if (!engine_flush(e, tag, it->second)) continue;
+        it = e->conns.find(tag);
+        if (it == e->conns.end()) continue;
+      }
+      if (evs[i].events & EPOLLIN) engine_handle_readable(e, tag, it->second);
+    }
+  }
+}
+
+}  // namespace
+
+// Creates the engine and binds the listening socket. host is a dotted quad
+// ("0.0.0.0" for any); *port_inout carries the requested port in and the
+// actually-bound port out. Returns nullptr on failure.
+void* rn_engine_create(const char* host, uint16_t* port_inout) {
+  auto* e = new Engine();
+  e->epfd = epoll_create1(EPOLL_CLOEXEC);
+  e->notify_fd = eventfd(0, EFD_CLOEXEC | EFD_NONBLOCK);
+  e->wake_fd = eventfd(0, EFD_CLOEXEC | EFD_NONBLOCK);
+  e->listen_fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+  if (e->epfd < 0 || e->notify_fd < 0 || e->wake_fd < 0 || e->listen_fd < 0) {
+    for (int fd : {e->epfd, e->notify_fd, e->wake_fd, e->listen_fd})
+      if (fd >= 0) close(fd);
+    delete e;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(e->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(*port_inout);
+  // Only dotted quads: the Python caller resolves hostnames. Refusing here
+  // (rather than widening to INADDR_ANY) keeps "localhost" from silently
+  // binding every interface.
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
+      bind(e->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      listen(e->listen_fd, 512) < 0) {
+    close(e->listen_fd);
+    close(e->epfd);
+    close(e->notify_fd);
+    close(e->wake_fd);
+    delete e;
+    return nullptr;
+  }
+  sockaddr_in bound{};
+  socklen_t blen = sizeof(bound);
+  getsockname(e->listen_fd, reinterpret_cast<sockaddr*>(&bound), &blen);
+  e->port = ntohs(bound.sin_port);
+  *port_inout = e->port;
+
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.u64 = 0;  // listen tag
+  epoll_ctl(e->epfd, EPOLL_CTL_ADD, e->listen_fd, &ev);
+  epoll_event wev{};
+  wev.events = EPOLLIN;
+  wev.data.u64 = UINT64_MAX;  // wake tag
+  epoll_ctl(e->epfd, EPOLL_CTL_ADD, e->wake_fd, &wev);
+  return e;
+}
+
+int rn_engine_notify_fd(void* ep) { return static_cast<Engine*>(ep)->notify_fd; }
+uint16_t rn_engine_port(void* ep) { return static_cast<Engine*>(ep)->port; }
+
+void rn_engine_start(void* ep) {
+  auto* e = static_cast<Engine*>(ep);
+  e->thr = std::thread(engine_loop, e);
+}
+
+// Drains up to max pending events. Payload pointers stay valid until the
+// next drain call (Python copies immediately). Also clears the notify
+// eventfd so the caller can re-arm its reader.
+int rn_engine_drain(void* ep, RnEventOut* out, int max) {
+  auto* e = static_cast<Engine*>(ep);
+  uint64_t buf;
+  while (read(e->notify_fd, &buf, 8) == 8) {
+  }
+  std::lock_guard<std::mutex> lk(e->mu);
+  e->drained.clear();
+  int n = static_cast<int>(std::min<size_t>(max, e->events.size()));
+  e->drained.assign(std::make_move_iterator(e->events.begin()),
+                    std::make_move_iterator(e->events.begin() + n));
+  e->events.erase(e->events.begin(), e->events.begin() + n);
+  for (int i = 0; i < n; ++i) {
+    auto& ev = e->drained[static_cast<size_t>(i)];
+    out[i].type = ev.type;
+    out[i].pad = 0;
+    out[i].conn = ev.conn;
+    out[i].data = ev.data.data();
+    out[i].len = ev.data.size();
+  }
+  if (!e->events.empty()) e->notify();  // more pending: keep fd readable
+  return n;
+}
+
+// Queues a pre-framed byte string for sending on conn.
+void rn_engine_send(void* ep, uint64_t conn, const uint8_t* data, uint32_t len) {
+  auto* e = static_cast<Engine*>(ep);
+  {
+    std::lock_guard<std::mutex> lk(e->mu);
+    e->outq.emplace_back(conn, std::vector<uint8_t>(data, data + len));
+  }
+  uint64_t one = 1;
+  ssize_t rc = write(e->wake_fd, &one, 8);
+  (void)rc;
+}
+
+void rn_engine_close_conn(void* ep, uint64_t conn) {
+  auto* e = static_cast<Engine*>(ep);
+  {
+    std::lock_guard<std::mutex> lk(e->mu);
+    e->closeq.push_back(conn);
+  }
+  uint64_t one = 1;
+  ssize_t rc = write(e->wake_fd, &one, 8);
+  (void)rc;
+}
+
+void rn_engine_stop(void* ep) {
+  auto* e = static_cast<Engine*>(ep);
+  if (e->thr.joinable()) {
+    e->stopping.store(true);
+    uint64_t one = 1;
+    ssize_t rc = write(e->wake_fd, &one, 8);
+    (void)rc;
+    e->thr.join();
+  }
+  for (auto& [id, c] : e->conns) close(c.fd);
+  e->conns.clear();
+  if (e->listen_fd >= 0) close(e->listen_fd);
+  if (e->epfd >= 0) close(e->epfd);
+  if (e->notify_fd >= 0) close(e->notify_fd);
+  if (e->wake_fd >= 0) close(e->wake_fd);
+  e->listen_fd = e->epfd = e->notify_fd = e->wake_fd = -1;
+}
+
+void rn_engine_free(void* ep) {
+  auto* e = static_cast<Engine*>(ep);
+  rn_engine_stop(e);
+  delete e;
+}
+
+}  // extern "C"

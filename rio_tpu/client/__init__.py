@@ -30,6 +30,8 @@ from ..protocol import (
     ResponseEnvelope,
     SubscriptionRequest,
     SubscriptionResponse,
+    decode_response,
+    decode_subresponse,
     encode_request_frame,
     encode_subscribe_frame,
 )
@@ -123,6 +125,11 @@ class Client:
         self._pool_per_server = pool_per_server
         self._connect_timeout = connect_timeout
         self._backoff = backoff or ExponentialBackoff()
+        # Resolve the native codec eagerly (may compile once) so the first
+        # send() doesn't do it inside the event loop.
+        from .. import native as _native
+
+        _native.get()
 
     # -- server/membership view (reference client/mod.rs:153-220) -----------
 
@@ -181,7 +188,7 @@ class Client:
                 self._invalidate(None)
                 await asyncio.sleep(delay)
                 continue
-            resp = ResponseEnvelope.from_bytes(raw)
+            resp = decode_response(raw)
             if resp.is_ok:
                 self._placement.put(key, address)
                 return resp.body or b""
@@ -252,7 +259,7 @@ class Client:
                         payload = await codec.read_frame(reader)
                         if payload is None:
                             break  # server went away: resubscribe
-                        resp = SubscriptionResponse.from_bytes(payload)
+                        resp = decode_subresponse(payload)
                         if resp.error is not None:
                             if resp.error.kind == ErrorKind.REDIRECT:
                                 self._placement.put((tname, handler_id), resp.error.detail)

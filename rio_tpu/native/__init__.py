@@ -1,0 +1,304 @@
+"""ctypes binding for the C++ data plane (``native/rio_native.cc``).
+
+The native library provides:
+
+* a wire codec for the framework envelopes (exactly the byte layout of
+  :mod:`rio_tpu.protocol`) plus an incremental frame reader, and
+* an epoll connection engine that owns sockets + framing on a native
+  thread (see :mod:`rio_tpu.native.transport`).
+
+Everything degrades gracefully: :func:`get` returns ``None`` when the
+library can't be built/loaded (or ``RIO_TPU_NATIVE=0``), and callers fall
+back to the pure-Python paths, which are wire-compatible.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+from ..errors import SerializationError
+
+log = logging.getLogger("rio_tpu.native")
+
+_SRC_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+_SRC = _SRC_DIR / "rio_native.cc"
+_SO = _SRC_DIR / "librio_native.so"
+
+_lock = threading.Lock()
+_lib: "NativeLib | None | bool" = False  # False = not attempted yet
+
+
+class RnEvent(ctypes.Structure):
+    _fields_ = [
+        ("type", ctypes.c_uint32),
+        ("pad", ctypes.c_uint32),
+        ("conn", ctypes.c_uint64),
+        ("data", ctypes.POINTER(ctypes.c_uint8)),
+        ("len", ctypes.c_uint64),
+    ]
+
+
+EV_FRAME = 1
+EV_CLOSED = 2
+EV_OPENED = 3
+
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+_U32 = ctypes.c_uint32
+_U32P = ctypes.POINTER(ctypes.c_uint32)
+
+
+def _ensure_built() -> Path | None:
+    """Compile the shared library if missing or stale; None on failure."""
+    env_lib = os.environ.get("RIO_TPU_NATIVE_LIB")
+    if env_lib:
+        return Path(env_lib) if Path(env_lib).exists() else None
+    if not _SRC.exists():
+        return _SO if _SO.exists() else None
+    if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+        return _SO
+    try:
+        subprocess.run(
+            [
+                os.environ.get("CXX", "g++"),
+                "-O2", "-std=c++17", "-fPIC", "-Wall", "-pthread",
+                "-shared", "-o", str(_SO), str(_SRC),
+            ],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except (OSError, subprocess.SubprocessError) as e:
+        detail = getattr(e, "stderr", b"")
+        log.warning("native build failed: %s %s", e, detail)
+        return None
+    return _SO
+
+
+class NativeLib:
+    """Typed wrapper over the loaded shared library."""
+
+    def __init__(self, dll: ctypes.CDLL) -> None:
+        self._dll = dll
+        dll.rn_free.argtypes = [_U8P]
+        dll.rn_free.restype = None
+
+        enc_sig = {
+            "rn_encode_request_frame": 4,
+            "rn_encode_subscribe_frame": 2,
+            "rn_encode_subresponse_ok_frame": 2,
+        }
+        for name, n_bufs in enc_sig.items():
+            fn = getattr(dll, name)
+            fn.argtypes = [ctypes.c_char_p, _U32] * n_bufs + [_U32P]
+            fn.restype = _U8P
+        dll.rn_encode_response_ok_frame.argtypes = [ctypes.c_char_p, _U32, _U32P]
+        dll.rn_encode_response_ok_frame.restype = _U8P
+        for name in ("rn_encode_response_err_frame", "rn_encode_subresponse_err_frame"):
+            fn = getattr(dll, name)
+            fn.argtypes = [_U32, ctypes.c_char_p, _U32, ctypes.c_char_p, _U32, _U32P]
+            fn.restype = _U8P
+
+        dll.rn_decode_inbound.argtypes = [ctypes.c_char_p, _U32, _U32P, _U32P]
+        dll.rn_decode_inbound.restype = ctypes.c_int
+        for name in ("rn_decode_response", "rn_decode_subresponse"):
+            fn = getattr(dll, name)
+            fn.argtypes = [ctypes.c_char_p, _U32, _U32P, _U32P, _U32P]
+            fn.restype = ctypes.c_int
+
+        dll.rn_reader_new.argtypes = []
+        dll.rn_reader_new.restype = ctypes.c_void_p
+        dll.rn_reader_free.argtypes = [ctypes.c_void_p]
+        dll.rn_reader_free.restype = None
+        dll.rn_reader_feed.argtypes = [ctypes.c_void_p, ctypes.c_char_p, _U32]
+        dll.rn_reader_feed.restype = ctypes.c_int
+        dll.rn_reader_next.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_void_p),
+            _U32P,
+        ]
+        dll.rn_reader_next.restype = ctypes.c_int
+
+        dll.rn_engine_create.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint16)]
+        dll.rn_engine_create.restype = ctypes.c_void_p
+        dll.rn_engine_notify_fd.argtypes = [ctypes.c_void_p]
+        dll.rn_engine_notify_fd.restype = ctypes.c_int
+        dll.rn_engine_port.argtypes = [ctypes.c_void_p]
+        dll.rn_engine_port.restype = ctypes.c_uint16
+        dll.rn_engine_start.argtypes = [ctypes.c_void_p]
+        dll.rn_engine_start.restype = None
+        dll.rn_engine_drain.argtypes = [ctypes.c_void_p, ctypes.POINTER(RnEvent), ctypes.c_int]
+        dll.rn_engine_drain.restype = ctypes.c_int
+        dll.rn_engine_send.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, _U32]
+        dll.rn_engine_send.restype = None
+        dll.rn_engine_close_conn.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        dll.rn_engine_close_conn.restype = None
+        dll.rn_engine_stop.argtypes = [ctypes.c_void_p]
+        dll.rn_engine_stop.restype = None
+        dll.rn_engine_free.argtypes = [ctypes.c_void_p]
+        dll.rn_engine_free.restype = None
+
+    # -- codec ---------------------------------------------------------
+
+    def _take(self, ptr, n: int) -> bytes:
+        out = ctypes.string_at(ptr, n)
+        self._dll.rn_free(ptr)
+        return out
+
+    def encode_request_frame(self, ht: bytes, hid: bytes, mt: bytes, payload: bytes) -> bytes:
+        n = _U32(0)
+        ptr = self._dll.rn_encode_request_frame(
+            ht, len(ht), hid, len(hid), mt, len(mt), payload, len(payload), ctypes.byref(n)
+        )
+        if not ptr:
+            raise SerializationError("rn_encode_request_frame: frame too large")
+        return self._take(ptr, n.value)
+
+    def encode_subscribe_frame(self, ht: bytes, hid: bytes) -> bytes:
+        n = _U32(0)
+        ptr = self._dll.rn_encode_subscribe_frame(ht, len(ht), hid, len(hid), ctypes.byref(n))
+        if not ptr:
+            raise SerializationError("rn_encode_subscribe_frame: frame too large")
+        return self._take(ptr, n.value)
+
+    def encode_response_ok_frame(self, body: bytes) -> bytes:
+        n = _U32(0)
+        ptr = self._dll.rn_encode_response_ok_frame(body, len(body), ctypes.byref(n))
+        if not ptr:
+            raise SerializationError("rn_encode_response_ok_frame: frame too large")
+        return self._take(ptr, n.value)
+
+    def encode_response_err_frame(self, kind: int, detail: bytes, payload: bytes) -> bytes:
+        n = _U32(0)
+        ptr = self._dll.rn_encode_response_err_frame(
+            kind, detail, len(detail), payload, len(payload), ctypes.byref(n)
+        )
+        if not ptr:
+            raise SerializationError("rn_encode_response_err_frame: frame too large")
+        return self._take(ptr, n.value)
+
+    def encode_subresponse_ok_frame(self, message_type: bytes, body: bytes) -> bytes:
+        n = _U32(0)
+        ptr = self._dll.rn_encode_subresponse_ok_frame(
+            message_type, len(message_type), body, len(body), ctypes.byref(n)
+        )
+        if not ptr:
+            raise SerializationError("rn_encode_subresponse_ok_frame: frame too large")
+        return self._take(ptr, n.value)
+
+    def encode_subresponse_err_frame(self, kind: int, detail: bytes, payload: bytes) -> bytes:
+        n = _U32(0)
+        ptr = self._dll.rn_encode_subresponse_err_frame(
+            kind, detail, len(detail), payload, len(payload), ctypes.byref(n)
+        )
+        if not ptr:
+            raise SerializationError("rn_encode_subresponse_err_frame: frame too large")
+        return self._take(ptr, n.value)
+
+    def decode_inbound(self, payload: bytes):
+        """Returns ``(0, ht, hid, mt, body)`` | ``(1, ht, hid)`` | None."""
+        offs = (_U32 * 4)()
+        lens = (_U32 * 4)()
+        rc = self._dll.rn_decode_inbound(payload, len(payload), offs, lens)
+        if rc < 0:
+            return None
+        n_fields = 4 if rc == 0 else 2
+        spans = [payload[offs[i] : offs[i] + lens[i]] for i in range(n_fields)]
+        return (rc, *spans)
+
+    def decode_response(self, payload: bytes):
+        """Returns ``(True, body)`` | ``(False, kind, detail, err_payload)`` | None."""
+        kind = _U32(0)
+        offs = (_U32 * 2)()
+        lens = (_U32 * 2)()
+        rc = self._dll.rn_decode_response(payload, len(payload), ctypes.byref(kind), offs, lens)
+        if rc < 0:
+            return None
+        if rc == 1:
+            return (True, payload[offs[0] : offs[0] + lens[0]])
+        return (
+            False,
+            kind.value,
+            payload[offs[0] : offs[0] + lens[0]],
+            payload[offs[1] : offs[1] + lens[1]],
+        )
+
+    def decode_subresponse(self, payload: bytes):
+        """Returns ``(True, mt, body)`` | ``(False, kind, detail, err_payload)`` | None."""
+        kind = _U32(0)
+        offs = (_U32 * 2)()
+        lens = (_U32 * 2)()
+        rc = self._dll.rn_decode_subresponse(payload, len(payload), ctypes.byref(kind), offs, lens)
+        if rc < 0:
+            return None
+        if rc == 1:
+            return (
+                True,
+                payload[offs[0] : offs[0] + lens[0]],
+                payload[offs[1] : offs[1] + lens[1]],
+            )
+        return (
+            False,
+            kind.value,
+            payload[offs[0] : offs[0] + lens[0]],
+            payload[offs[1] : offs[1] + lens[1]],
+        )
+
+
+class NativeFrameReader:
+    """Incremental frame decoder backed by the C++ reader.
+
+    Drop-in for :class:`rio_tpu.codec.FrameReader`.
+    """
+
+    def __init__(self, lib: NativeLib | None = None) -> None:
+        self._lib = lib or get()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self._handle = self._lib._dll.rn_reader_new()
+
+    def feed(self, data: bytes) -> list[bytes]:
+        dll = self._lib._dll
+        n = dll.rn_reader_feed(self._handle, data, len(data))
+        if n < 0:
+            raise SerializationError("incoming frame too large")
+        out: list[bytes] = []
+        ptr = ctypes.c_void_p()
+        ln = _U32(0)
+        for _ in range(n):
+            if not dll.rn_reader_next(self._handle, ctypes.byref(ptr), ctypes.byref(ln)):
+                break
+            out.append(ctypes.string_at(ptr, ln.value))
+        return out
+
+    def __del__(self) -> None:
+        handle, self._handle = getattr(self, "_handle", None), None
+        if handle and getattr(self, "_lib", None) is not None:
+            self._lib._dll.rn_reader_free(handle)
+
+
+def get() -> NativeLib | None:
+    """Load (building on demand) the native library; None when unavailable."""
+    global _lib
+    if _lib is not False:
+        return _lib  # type: ignore[return-value]
+    with _lock:
+        if _lib is not False:
+            return _lib  # type: ignore[return-value]
+        if os.environ.get("RIO_TPU_NATIVE", "1") == "0":
+            _lib = None
+            return None
+        path = _ensure_built()
+        if path is None:
+            _lib = None
+            return None
+        try:
+            _lib = NativeLib(ctypes.CDLL(str(path)))
+        except OSError as e:
+            log.warning("failed to load %s: %s", path, e)
+            _lib = None
+    return _lib

@@ -1,0 +1,248 @@
+"""Server transport backed by the C++ epoll engine.
+
+The engine (``native/rio_native.cc``) owns the listening socket, the
+accepted connections, framing, and write backpressure on a dedicated
+native thread — the counterpart of the reference's accept + per-connection
+frame loops (``rio-rs/src/server.rs:285-305``, ``service.rs:370-459``).
+Python only sees complete frame payloads (via an eventfd the asyncio loop
+watches) and hands back complete response frames, so the per-byte work
+never touches the interpreter.
+
+Dispatch semantics match :meth:`rio_tpu.service.Service.run` exactly:
+requests on one connection are answered in order, and a subscription
+request switches the connection into streaming mode.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ctypes
+import logging
+from typing import TYPE_CHECKING, Callable
+
+from ..message_router import MessageRouter
+from ..protocol import (
+    RequestEnvelope,
+    ResponseEnvelope,
+    ResponseError,
+    SubscriptionRequest,
+    decode_inbound,
+    encode_response_frame,
+    encode_subresponse_frame,
+)
+from . import EV_CLOSED, EV_FRAME, EV_OPENED, NativeLib, RnEvent, get
+
+if TYPE_CHECKING:
+    from ..service import Service
+
+log = logging.getLogger("rio_tpu.native.transport")
+
+_DRAIN_BATCH = 256
+_MAX_PENDING_FRAMES = 1024  # per-conn cap (reference relies on TCP backpressure)
+
+
+class Engine:
+    """Thin pythonic wrapper over the rn_engine_* C ABI."""
+
+    def __init__(self, lib: NativeLib, host: str, port: int) -> None:
+        self._lib = lib
+        self._dll = lib._dll
+        port_inout = ctypes.c_uint16(port)
+        self._handle = self._dll.rn_engine_create(host.encode(), ctypes.byref(port_inout))
+        if not self._handle:
+            raise OSError(f"rn_engine_create failed for {host}:{port}")
+        self.port = port_inout.value
+        self.notify_fd: int = self._dll.rn_engine_notify_fd(self._handle)
+        self._events = (RnEvent * _DRAIN_BATCH)()
+
+    def start(self) -> None:
+        self._dll.rn_engine_start(self._handle)
+
+    def drain(self) -> list[tuple[int, int, bytes]]:
+        if self._handle is None:
+            return []
+        n = self._dll.rn_engine_drain(self._handle, self._events, _DRAIN_BATCH)
+        out = []
+        for i in range(n):
+            ev = self._events[i]
+            data = ctypes.string_at(ev.data, ev.len) if ev.len else b""
+            out.append((ev.type, ev.conn, data))
+        return out
+
+    def send(self, conn: int, data: bytes) -> None:
+        # Stragglers (e.g. a subscription pump racing shutdown) must not
+        # pass NULL into the C ABI.
+        if self._handle is not None:
+            self._dll.rn_engine_send(self._handle, conn, data, len(data))
+
+    def close_conn(self, conn: int) -> None:
+        if self._handle is not None:
+            self._dll.rn_engine_close_conn(self._handle, conn)
+
+    def shutdown(self) -> None:
+        handle, self._handle = self._handle, None
+        if handle:
+            self._dll.rn_engine_free(handle)
+
+
+class _ConnState:
+    __slots__ = ("queue", "worker", "streaming")
+
+    def __init__(self) -> None:
+        # None is the EOF sentinel: the worker finishes in-flight requests
+        # (FIFO) and then exits, matching the asyncio path where a peer
+        # disconnect never cancels a running handler mid-mutation.
+        self.queue: asyncio.Queue[bytes | None] = asyncio.Queue()
+        self.worker: asyncio.Task | None = None
+        self.streaming = False
+
+
+class NativeServerTransport:
+    """Accept/dispatch loop over the native engine.
+
+    Mirrors the shape of ``asyncio.Server`` enough for
+    :class:`rio_tpu.server.Server` (``close()`` + ``wait_closed()``).
+    """
+
+    def __init__(self, service_factory: Callable[[], "Service"], host: str, port: int) -> None:
+        lib = get()
+        if lib is None:
+            raise RuntimeError("native library unavailable (build native/ first)")
+        self._lib = lib
+        self._service_factory = service_factory
+        if host in ("", "::"):
+            host = "0.0.0.0"
+        else:
+            # The engine only takes dotted quads; resolve names here so
+            # "localhost" binds loopback instead of erroring (or widening).
+            import socket
+
+            host = socket.gethostbyname(host)
+        self._engine = Engine(lib, host, port)
+        self.port = self._engine.port
+        self._conns: dict[int, _ConnState] = {}
+        self._workers: set[asyncio.Task] = set()
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._closed = asyncio.Event()
+        self._started = False
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._loop.add_reader(self._engine.notify_fd, self._on_ready)
+        self._engine.start()
+        self._started = True
+
+    def _on_ready(self) -> None:
+        # One batch per callback: the engine re-arms the eventfd when more
+        # events are pending, so the loop gets a chance to run conn workers
+        # between batches instead of queueing unboundedly.
+        events = self._engine.drain()
+        for ev_type, conn, data in events:
+            if ev_type == EV_OPENED:
+                state = _ConnState()
+                state.worker = asyncio.ensure_future(self._conn_worker(conn, state))
+                self._workers.add(state.worker)
+                state.worker.add_done_callback(self._workers.discard)
+                self._conns[conn] = state
+            elif ev_type == EV_FRAME:
+                state = self._conns.get(conn)
+                if state is not None:
+                    if state.queue.qsize() >= _MAX_PENDING_FRAMES:
+                        # The asyncio path gets TCP backpressure for free
+                        # (one frame read per response written); the engine
+                        # reads greedily, so an unbounded pipeliner must be
+                        # cut off rather than allowed to grow server memory.
+                        log.warning("conn %d exceeded pending-frame cap", conn)
+                        self._engine.close_conn(conn)
+                    else:
+                        state.queue.put_nowait(data)
+            elif ev_type == EV_CLOSED:
+                state = self._conns.pop(conn, None)
+                if state is not None and state.worker is not None:
+                    if state.streaming:
+                        # Subscription pumps block on the router queue, not
+                        # on inbound frames; cancellation is the only (and
+                        # safe — no actor state) way to stop them.
+                        state.worker.cancel()
+                    else:
+                        state.queue.put_nowait(None)
+
+    # ------------------------------------------------------------------
+
+    async def _conn_worker(self, conn: int, state: _ConnState) -> None:
+        """Ordered dispatch for one connection (service.rs:370-459 shape)."""
+        service = self._service_factory()
+        try:
+            while True:
+                payload = await state.queue.get()
+                if payload is None:  # peer closed; in-flight work already done
+                    return
+                try:
+                    inbound = decode_inbound(payload)
+                except Exception as e:  # malformed frame → error response
+                    resp = ResponseEnvelope.err(ResponseError.unknown(f"bad frame: {e}"))
+                    self._engine.send(conn, encode_response_frame(resp))
+                    continue
+                if isinstance(inbound, RequestEnvelope):
+                    resp = await service.call(inbound)
+                    self._engine.send(conn, encode_response_frame(resp))
+                else:
+                    if conn not in self._conns:
+                        # Peer already disconnected (CLOSED was drained while
+                        # this frame sat in the queue): entering streaming
+                        # mode now would leak the router subscription — no
+                        # EV_CLOSED will ever cancel us again.
+                        return
+                    state.streaming = True
+                    await self._stream_subscription(conn, service, inbound)
+                    return
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("native conn worker error (conn=%d)", conn)
+        finally:
+            # Mirror Service.run's `writer.close()`: whatever ends the
+            # worker, the engine should close the socket — after pending
+            # responses flush (close_pending semantics in the engine).
+            self._conns.pop(conn, None)
+            self._engine.close_conn(conn)
+
+    async def _stream_subscription(
+        self, conn: int, service: "Service", req: SubscriptionRequest
+    ) -> None:
+        from ..protocol import SubscriptionResponse
+
+        result = await service.subscribe(req)
+        if isinstance(result, ResponseError):
+            self._engine.send(
+                conn, encode_subresponse_frame(SubscriptionResponse(error=result))
+            )
+            self._engine.close_conn(conn)
+            return
+        queue = result
+        router = service.app_data.get(MessageRouter)
+        try:
+            while True:
+                item = await queue.get()
+                self._engine.send(conn, encode_subresponse_frame(item))
+        finally:
+            router.drop_subscription(req.handler_type, req.handler_id, queue)
+
+    # ------------------------------------------------------------------
+
+    def close(self) -> None:
+        if self._loop is not None and self._started:
+            self._loop.remove_reader(self._engine.notify_fd)
+        # Cancel every worker ever started (not just those still in _conns:
+        # a worker whose conn closed mid-dispatch may still be draining).
+        for worker in list(self._workers):
+            worker.cancel()
+        self._workers.clear()
+        self._conns.clear()
+        self._engine.shutdown()
+        self._closed.set()
+
+    async def wait_closed(self) -> None:
+        await self._closed.wait()

@@ -62,20 +62,32 @@ class Server:
         object_placement_provider: ObjectPlacement,
         app_data: AppData | None = None,
         http_members_address: str | None = None,
+        transport: str = "asyncio",
     ) -> None:
+        if transport not in ("asyncio", "native", "auto"):
+            raise ValueError(f"unknown transport {transport!r}")
         self.requested_address = address
         self.registry = registry
         self.cluster_provider = cluster_provider
         self.object_placement = object_placement_provider
         self.app_data = app_data or AppData()
         self.http_members_address = http_members_address
+        self.transport = transport
 
         self._listener: asyncio.Server | None = None
+        self._native_transport = None
         self._local_addr: str | None = None
         self._admin = AdminSender()
         self._internal = InternalClientSender()
         self._stopped = asyncio.Event()
         self._conn_tasks: set[asyncio.Task] = set()
+
+        # Resolve (building if stale) the native codec now, off the request
+        # path: the first encode otherwise triggers a synchronous compile
+        # inside the event loop.
+        from . import native as _native
+
+        _native.get()
 
         # Inject framework handles (reference server.rs wiring of AppData).
         self.app_data.set(self._admin)
@@ -105,15 +117,31 @@ class Server:
         await self.members_storage.prepare()
         await self.object_placement.prepare()
 
+    def _resolve_transport(self) -> str:
+        if self.transport == "auto":
+            from . import native
+
+            return "native" if native.get() is not None else "asyncio"
+        return self.transport
+
     async def bind(self) -> str:
         host, _, port = self.requested_address.rpartition(":")
-        handler = self._accept
-        self._listener = await asyncio.start_server(handler, host or "0.0.0.0", int(port))
-        sock = self._listener.sockets[0]
-        bound_host, bound_port = sock.getsockname()[:2]
-        if bound_host in ("0.0.0.0", "::"):
-            bound_host = "127.0.0.1"
-        self._local_addr = f"{bound_host}:{bound_port}"
+        host = host or "0.0.0.0"
+        if self._resolve_transport() == "native":
+            from .native.transport import NativeServerTransport
+
+            self._native_transport = NativeServerTransport(
+                self._service, host, int(port)
+            )
+            bound_host = "127.0.0.1" if host in ("0.0.0.0", "::") else host
+            self._local_addr = f"{bound_host}:{self._native_transport.port}"
+        else:
+            self._listener = await asyncio.start_server(self._accept, host, int(port))
+            sock = self._listener.sockets[0]
+            bound_host, bound_port = sock.getsockname()[:2]
+            if bound_host in ("0.0.0.0", "::"):
+                bound_host = "127.0.0.1"
+            self._local_addr = f"{bound_host}:{bound_port}"
         self.app_data.set(ServerInfo(self._local_addr))
         return self._local_addr
 
@@ -199,9 +227,10 @@ class Server:
         Reference ``server.rs:178-283``: all loops race under one select;
         any loop finishing tears the node down.
         """
-        if self._listener is None:
+        if self._listener is None and self._native_transport is None:
             await self.bind()
-        assert self._listener is not None
+        if self._native_transport is not None:
+            self._native_transport.start()
         tasks = [
             asyncio.ensure_future(self.cluster_provider.serve(self.local_address)),
             asyncio.ensure_future(self._consume_internal_commands()),
@@ -222,11 +251,16 @@ class Server:
             for t in tasks:
                 t.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)
-            self._listener.close()
+            if self._native_transport is not None:
+                self._native_transport.close()
+                await self._native_transport.wait_closed()
+            if self._listener is not None:
+                self._listener.close()
             for t in list(self._conn_tasks):
                 t.cancel()
             await asyncio.gather(*self._conn_tasks, return_exceptions=True)
-            await self._listener.wait_closed()
+            if self._listener is not None:
+                await self._listener.wait_closed()
             # Leaving the cluster: mark self inactive so peers stop routing here.
             with contextlib.suppress(Exception):
                 host, _, port = self.local_address.rpartition(":")

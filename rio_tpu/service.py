@@ -23,7 +23,7 @@ from typing import Any
 
 from .app_data import AppData
 from .cluster.storage import MembershipStorage
-from .codec import frame, read_frame
+from .codec import read_frame
 from .errors import HandlerNotFound, ObjectNotFound, SerializationError, TypeNotFound
 from .message_router import MessageRouter
 from .object_placement import ObjectPlacement, ObjectPlacementItem
@@ -34,6 +34,8 @@ from .protocol import (
     ResponseError,
     SubscriptionRequest,
     decode_inbound,
+    encode_response_frame,
+    encode_subresponse_frame,
 )
 from .registry import ApplicationRaised, ObjectId, Registry
 from .service_object import LifecycleMessage
@@ -212,12 +214,12 @@ class Service:
                     inbound = decode_inbound(payload)
                 except Exception as e:  # malformed frame → error response
                     resp = ResponseEnvelope.err(ResponseError.unknown(f"bad frame: {e}"))
-                    writer.write(frame(resp.to_bytes()))
+                    writer.write(encode_response_frame(resp))
                     await writer.drain()
                     continue
                 if isinstance(inbound, RequestEnvelope):
                     resp = await self.call(inbound)
-                    writer.write(frame(resp.to_bytes()))
+                    writer.write(encode_response_frame(resp))
                     await writer.drain()
                 else:
                     await self._stream_subscription(inbound, writer)
@@ -244,7 +246,7 @@ class Service:
 
         result = await self.subscribe(req)
         if isinstance(result, ResponseError):
-            writer.write(frame(SubscriptionResponse(error=result).to_bytes()))
+            writer.write(encode_subresponse_frame(SubscriptionResponse(error=result)))
             await writer.drain()
             return
         queue = result
@@ -252,7 +254,7 @@ class Service:
         try:
             while True:
                 item = await queue.get()
-                writer.write(frame(item.to_bytes()))
+                writer.write(encode_subresponse_frame(item))
                 await writer.drain()
         except (ConnectionError, OSError):
             pass

@@ -86,6 +86,7 @@ async def run_integration_test(
     placement: ObjectPlacement | None = None,
     gossip: bool = False,
     provider_builder: Callable[[LocalStorage], ClusterProvider] | None = None,
+    transport: str = "asyncio",
 ) -> None:
     members = members if members is not None else LocalStorage()
     placement = placement if placement is not None else LocalObjectPlacement()
@@ -103,6 +104,7 @@ async def run_integration_test(
             registry=registry_builder(),
             cluster_provider=provider,
             object_placement_provider=placement,
+            transport=transport,
         )
         await server.prepare()
         await server.bind()

@@ -1,0 +1,293 @@
+"""C++ data plane tests: codec wire parity + epoll transport integration.
+
+The native library must be byte-identical to the Python codec on every
+envelope type (the two are interchangeable on the wire), and a server
+running on the native epoll transport must pass the same integration
+shapes as the asyncio transport (request/response, typed errors,
+redirects, pub/sub)."""
+
+import asyncio
+
+import pytest
+
+from rio_tpu import AppData, Registry, ServiceObject, handler, message, wire_error
+from rio_tpu import codec, native, protocol
+from rio_tpu.message_router import MessageRouter
+
+from .server_utils import Cluster, run_integration_test
+
+lib = native.get()
+pytestmark = pytest.mark.skipif(lib is None, reason="native library unavailable")
+
+
+# ---------------------------------------------------------------------------
+# Codec parity
+# ---------------------------------------------------------------------------
+
+
+def test_request_frame_parity():
+    for ht, hid, mt, payload in [
+        ("Svc", "obj-1", "Msg", b"\x01\x02payload"),
+        ("", "", "", b""),
+        ("x" * 40, "y" * 300, "z" * 70000, b"p" * 70000),
+    ]:
+        env = protocol.RequestEnvelope(ht, hid, mt, payload)
+        assert protocol.encode_request_frame(env) == lib.encode_request_frame(
+            ht.encode(), hid.encode(), mt.encode(), payload
+        )
+        # Python reference path must produce the same bytes.
+        assert codec.frame(protocol.KIND_REQUEST + env.to_bytes()) == (
+            lib.encode_request_frame(ht.encode(), hid.encode(), mt.encode(), payload)
+        )
+
+
+def test_response_frame_parity():
+    ok = protocol.ResponseEnvelope.ok(b"hello")
+    assert codec.frame(ok.to_bytes()) == lib.encode_response_ok_frame(b"hello")
+    err = protocol.ResponseEnvelope.err(
+        protocol.ResponseError.application(b"errbytes", "MyErr")
+    )
+    assert codec.frame(err.to_bytes()) == lib.encode_response_err_frame(
+        5, b"MyErr", b"errbytes"
+    )
+    # Decoders agree with the Python ones.
+    assert lib.decode_response(ok.to_bytes()) == (True, b"hello")
+    assert lib.decode_response(err.to_bytes()) == (False, 5, b"MyErr", b"errbytes")
+    assert lib.decode_response(b"\x00garbage") is None
+
+
+def test_subscription_frame_parity():
+    sub = protocol.SubscriptionRequest("Svc", "id9")
+    assert protocol.encode_subscribe_frame(sub) == lib.encode_subscribe_frame(
+        b"Svc", b"id9"
+    )
+    ok = protocol.SubscriptionResponse(body=b"bb", message_type="T")
+    assert codec.frame(ok.to_bytes()) == lib.encode_subresponse_ok_frame(b"T", b"bb")
+    assert lib.decode_subresponse(ok.to_bytes()) == (True, b"T", b"bb")
+    err = protocol.SubscriptionResponse(
+        error=protocol.ResponseError.redirect("1.2.3.4:5")
+    )
+    assert codec.frame(err.to_bytes()) == lib.encode_subresponse_err_frame(
+        1, b"1.2.3.4:5", b""
+    )
+    assert lib.decode_subresponse(err.to_bytes()) == (False, 1, b"1.2.3.4:5", b"")
+
+
+def test_decode_inbound_parity():
+    env = protocol.RequestEnvelope("Svc", "i", "M", b"xyz")
+    framed = protocol.encode_request_frame(env)
+    assert lib.decode_inbound(framed[4:]) == (0, b"Svc", b"i", b"M", b"xyz")
+    sub = protocol.SubscriptionRequest("Svc", "j")
+    framed = protocol.encode_subscribe_frame(sub)
+    assert lib.decode_inbound(framed[4:]) == (1, b"Svc", b"j")
+    assert lib.decode_inbound(b"\x07nope") is None
+    # protocol.decode_inbound (native fast path) returns the typed envelopes
+    back = protocol.decode_inbound(protocol.encode_request_frame(env)[4:])
+    assert back == env
+
+
+def test_native_frame_reader_parity():
+    frames_in = [
+        protocol.encode_request_frame(protocol.RequestEnvelope("A", "b", "C", b"d")),
+        codec.frame(b""),
+        codec.frame(b"x" * 100_000),
+    ]
+    stream = b"".join(frames_in)
+    for chunk in (1, 3, 7, 4096):
+        nat = native.NativeFrameReader(lib)
+        py = codec.FrameReader()
+        got_nat, got_py = [], []
+        for i in range(0, len(stream), chunk):
+            got_nat += nat.feed(stream[i : i + chunk])
+            got_py += py.feed(stream[i : i + chunk])
+        assert got_nat == got_py
+        assert got_nat == [f[4:] for f in frames_in]
+
+
+def test_native_frame_reader_oversize():
+    from rio_tpu.errors import SerializationError
+
+    nat = native.NativeFrameReader(lib)
+    with pytest.raises(SerializationError):
+        nat.feed(b"\xff\xff\xff\xff")
+
+
+# ---------------------------------------------------------------------------
+# Native transport integration (mirrors test_client_server shapes)
+# ---------------------------------------------------------------------------
+
+
+@message
+class Ask:
+    text: str = ""
+
+
+@message
+class Answer:
+    text: str = ""
+    times: int = 0
+
+
+@message
+class Publish:
+    text: str = ""
+
+
+@wire_error
+class NativeUnanswerable(Exception):
+    pass
+
+
+class NativeOracle(ServiceObject):
+    def __init__(self):
+        self.times = 0
+
+    @handler
+    async def ask(self, msg: Ask, ctx: AppData) -> Answer:
+        if msg.text == "unanswerable":
+            raise NativeUnanswerable(msg.text, 42)
+        if msg.text == "panic":
+            raise RuntimeError("boom")
+        self.times += 1
+        return Answer(text=f"echo:{msg.text}", times=self.times)
+
+    @handler
+    async def publish(self, msg: Publish, ctx: AppData) -> Answer:
+        from rio_tpu.registry import type_id
+
+        router = ctx.get(MessageRouter)
+        router.publish(type_id(NativeOracle), self.id, Publish(text=f"pub:{msg.text}"))
+        return Answer(text="published")
+
+
+def build_registry() -> Registry:
+    r = Registry()
+    r.add_type(NativeOracle)
+    return r
+
+
+def test_native_request_response():
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        out = await client.send(NativeOracle, "o1", Ask(text="hi"), returns=Answer)
+        assert out == Answer(text="echo:hi", times=1)
+        out = await client.send(NativeOracle, "o1", Ask(text="again"), returns=Answer)
+        assert out.times == 2
+        client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body, registry_builder=build_registry, num_servers=2, transport="native"
+        )
+    )
+
+
+def test_native_typed_error_and_panic_isolation():
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        with pytest.raises(NativeUnanswerable) as ei:
+            await client.send(NativeOracle, "o", Ask(text="unanswerable"), returns=Answer)
+        assert ei.value.args == ("unanswerable", 42)
+        out = await client.send(NativeOracle, "o", Ask(text="ok"), returns=Answer)
+        assert out.times == 1  # object survived the typed error
+        client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body, registry_builder=build_registry, num_servers=2, transport="native"
+        )
+    )
+
+
+def test_native_redirect_across_servers():
+    async def body(cluster: Cluster):
+        c1 = cluster.client()
+        for i in range(12):
+            await c1.send(NativeOracle, f"o{i}", Ask(text="seed"), returns=Answer)
+        # Fresh client, cold cache: random picks must get redirected.
+        c2 = cluster.client()
+        for i in range(12):
+            out = await c2.send(NativeOracle, f"o{i}", Ask(text="q"), returns=Answer)
+            assert out.times == 2
+        c1.close()
+        c2.close()
+
+    asyncio.run(
+        run_integration_test(
+            body, registry_builder=build_registry, num_servers=5, transport="native"
+        )
+    )
+
+
+def test_native_pubsub():
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        # Allocate first so the subscription lands on the host.
+        await client.send(NativeOracle, "caster", Ask(text="warm"), returns=Answer)
+        stream = await client.subscribe(NativeOracle, "caster")
+        got: list[str] = []
+        ready = asyncio.Event()
+
+        async def consume():
+            async for item in stream:
+                got.append(item.text)
+                ready.set()
+                if len(got) >= 2:
+                    return
+
+        task = asyncio.create_task(consume())
+        await asyncio.sleep(0.2)  # let the subscription attach
+        await client.send(NativeOracle, "caster", Publish(text="a"), returns=Answer)
+        await asyncio.wait_for(ready.wait(), 5)
+        await client.send(NativeOracle, "caster", Publish(text="b"), returns=Answer)
+        await asyncio.wait_for(task, 5)
+        assert got == ["pub:a", "pub:b"]
+        client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body, registry_builder=build_registry, num_servers=2, transport="native"
+        )
+    )
+
+
+def test_native_mixed_transports_interop():
+    """A cluster of one native + one asyncio node serves the same traffic."""
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        for i in range(8):
+            out = await client.send(NativeOracle, f"m{i}", Ask(text="x"), returns=Answer)
+            assert out.times == 1
+        client.close()
+
+    async def run():
+        from rio_tpu import LocalObjectPlacement, LocalStorage, Server
+        from rio_tpu.cluster.membership_protocol import LocalClusterProvider
+
+        members = LocalStorage()
+        placement = LocalObjectPlacement()
+        servers = []
+        for transport in ("native", "asyncio"):
+            server = Server(
+                address="127.0.0.1:0",
+                registry=build_registry(),
+                cluster_provider=LocalClusterProvider(members),
+                object_placement_provider=placement,
+                transport=transport,
+            )
+            await server.prepare()
+            await server.bind()
+            servers.append(server)
+        tasks = [asyncio.create_task(s.run()) for s in servers]
+        try:
+            from .server_utils import wait_for_active_members
+
+            await wait_for_active_members(members, 2)
+            await body(Cluster(servers=servers, members=members, placement=placement))
+        finally:
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    asyncio.run(run())
