@@ -505,6 +505,7 @@ struct Engine {
   std::vector<EngineEvent> drained;   // alive until next drain
   std::vector<std::pair<uint64_t, std::vector<uint8_t>>> outq;
   std::vector<uint64_t> closeq;
+  std::unordered_map<uint64_t, long long> backlog;  // unsent bytes per conn
 
   std::unordered_map<uint64_t, Conn> conns;  // IO-thread only
   uint64_t next_id = 1;
@@ -534,6 +535,10 @@ void engine_close_conn(Engine* e, uint64_t id, bool emit) {
   epoll_ctl(e->epfd, EPOLL_CTL_DEL, it->second.fd, nullptr);
   close(it->second.fd);
   e->conns.erase(it);
+  {
+    std::lock_guard<std::mutex> lk(e->mu);
+    e->backlog.erase(id);
+  }
   if (emit) e->push_event(RN_EV_CLOSED, id, {});
 }
 
@@ -546,6 +551,12 @@ bool engine_flush(Engine* e, uint64_t id, Conn& c) {
                      MSG_NOSIGNAL);
     if (n > 0) {
       c.woff += static_cast<size_t>(n);
+      {
+        std::lock_guard<std::mutex> lk(e->mu);
+        auto b = e->backlog.find(id);
+        if (b != e->backlog.end() && (b->second -= n) <= 0)
+          e->backlog.erase(b);
+      }
       if (c.woff == front.size()) {
         c.wq.pop_front();
         c.woff = 0;
@@ -807,10 +818,21 @@ void rn_engine_send(void* ep, uint64_t conn, const uint8_t* data, uint32_t len) 
   {
     std::lock_guard<std::mutex> lk(e->mu);
     e->outq.emplace_back(conn, std::vector<uint8_t>(data, data + len));
+    e->backlog[conn] += len;
   }
   uint64_t one = 1;
   ssize_t rc = write(e->wake_fd, &one, 8);
   (void)rc;
+}
+
+// Unsent bytes queued for conn — the write-backpressure signal the Python
+// subscription pump polls (the asyncio transport gets this for free from
+// `await writer.drain()`).
+long long rn_engine_backlog(void* ep, uint64_t conn) {
+  auto* e = static_cast<Engine*>(ep);
+  std::lock_guard<std::mutex> lk(e->mu);
+  auto it = e->backlog.find(conn);
+  return it == e->backlog.end() ? 0 : it->second;
 }
 
 void rn_engine_close_conn(void* ep, uint64_t conn) {

@@ -39,6 +39,7 @@ log = logging.getLogger("rio_tpu.native.transport")
 
 _DRAIN_BATCH = 256
 _MAX_PENDING_FRAMES = 1024  # per-conn cap (reference relies on TCP backpressure)
+_MAX_WRITE_BACKLOG = 1 << 20  # pause subscription pumps past 1 MiB unsent
 
 
 class Engine:
@@ -74,6 +75,11 @@ class Engine:
         # pass NULL into the C ABI.
         if self._handle is not None:
             self._dll.rn_engine_send(self._handle, conn, data, len(data))
+
+    def backlog(self, conn: int) -> int:
+        if self._handle is None:
+            return 0
+        return int(self._dll.rn_engine_backlog(self._handle, conn))
 
     def close_conn(self, conn: int) -> None:
         if self._handle is not None:
@@ -154,7 +160,13 @@ class NativeServerTransport:
                         # (one frame read per response written); the engine
                         # reads greedily, so an unbounded pipeliner must be
                         # cut off rather than allowed to grow server memory.
+                        # Dropping the state + EOF sentinel here (Python-
+                        # initiated closes emit no EV_CLOSED) lets the
+                        # worker finish in-flight frames and exit instead of
+                        # leaking.
                         log.warning("conn %d exceeded pending-frame cap", conn)
+                        self._conns.pop(conn, None)
+                        state.queue.put_nowait(None)
                         self._engine.close_conn(conn)
                     else:
                         state.queue.put_nowait(data)
@@ -226,6 +238,12 @@ class NativeServerTransport:
         try:
             while True:
                 item = await queue.get()
+                # Write backpressure: the asyncio path blocks in
+                # writer.drain(); here we poll the engine's per-conn unsent
+                # byte count so a stalled subscriber can't grow the write
+                # queue without bound.
+                while self._engine.backlog(conn) > _MAX_WRITE_BACKLOG:
+                    await asyncio.sleep(0.005)
                 self._engine.send(conn, encode_subresponse_frame(item))
         finally:
             router.drop_subscription(req.handler_type, req.handler_id, queue)

@@ -19,10 +19,21 @@ from .assignment import (
     build_cost_matrix,
     greedy_balanced_assign,
 )
+from .pallas_sinkhorn import fused_iteration, pallas_sinkhorn
+from .scaling import (
+    fused_scaling_iteration,
+    pallas_scaling_sinkhorn,
+    scaling_sinkhorn,
+)
 from .sinkhorn import SinkhornResult, plan_rounded_assign, sinkhorn, sinkhorn_assign
 
 __all__ = [
     "SinkhornResult",
+    "fused_iteration",
+    "fused_scaling_iteration",
+    "pallas_scaling_sinkhorn",
+    "pallas_sinkhorn",
+    "scaling_sinkhorn",
     "assign_from_potentials",
     "build_cost_matrix",
     "greedy_balanced_assign",

@@ -37,8 +37,37 @@ class SinkhornResult(NamedTuple):
     err: jax.Array  # scalar: final L1 column-marginal violation
 
 
+_NEG_INF = float("-inf")
+
+
 def _safe_log(x: jax.Array) -> jax.Array:
     return jnp.log(jnp.maximum(x, 1e-30))
+
+
+def normalize_marginals(row_mass: jax.Array, col_capacity: jax.Array):
+    """Scale both marginals to unit total mass (float32)."""
+    a = row_mass.astype(jnp.float32)
+    b = col_capacity.astype(jnp.float32)
+    a = a / jnp.maximum(jnp.sum(a), 1e-30)
+    b = b / jnp.maximum(jnp.sum(b), 1e-30)
+    return a, b
+
+
+def marginal_err(cost: jax.Array, f: jax.Array, g: jax.Array, b: jax.Array, eps: float) -> jax.Array:
+    """L1 column-marginal violation of the implied plan (diagnostic)."""
+    log_p = (f[:, None] + g[None, :] - cost.astype(jnp.float32)) / eps
+    col = jnp.sum(jnp.exp(jnp.where(jnp.isfinite(log_p), log_p, -jnp.inf)), axis=0)
+    return jnp.sum(jnp.abs(col - b))
+
+
+def pad_axis_to(x: jax.Array, size: int, axis: int, fill: float) -> jax.Array:
+    """Pad ``x`` along ``axis`` up to ``size`` with ``fill`` (no-op if equal)."""
+    pad = size - x.shape[axis]
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=fill)
 
 
 def sinkhorn(
@@ -62,13 +91,7 @@ def sinkhorn(
       n_iters: fixed iteration count (static for ``lax.scan``).
     """
     cost = cost.astype(jnp.float32)
-    a = row_mass.astype(jnp.float32)
-    b = col_capacity.astype(jnp.float32)
-    # Normalize both marginals to the same total mass (live mass only).
-    total = jnp.maximum(jnp.sum(a), 1e-30)
-    a = a / total
-    b = b / jnp.maximum(jnp.sum(b), 1e-30)
-
+    a, b = normalize_marginals(row_mass, col_capacity)
     log_a = jnp.where(a > 0, _safe_log(a), -jnp.inf)
     log_b = jnp.where(b > 0, _safe_log(b), -jnp.inf)
 
@@ -85,12 +108,7 @@ def sinkhorn(
     f0 = jnp.zeros(cost.shape[0], jnp.float32)
     g0 = jnp.zeros(cost.shape[1], jnp.float32)
     (f, g), _ = lax.scan(body, (f0, g0), None, length=n_iters)
-
-    # Column-marginal violation of the implied plan (diagnostic only).
-    log_p = (f[:, None] + g[None, :] - cost) / eps
-    col = jnp.sum(jnp.exp(jnp.where(jnp.isfinite(log_p), log_p, -jnp.inf)), axis=0)
-    err = jnp.sum(jnp.abs(col - b))
-    return SinkhornResult(f=f, g=g, err=err)
+    return SinkhornResult(f=f, g=g, err=marginal_err(cost, f, g, b, eps))
 
 
 @jax.jit

@@ -22,7 +22,25 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["make_mesh", "sharded_sinkhorn", "sharded_sinkhorn_assign", "shard_cost"]
+__all__ = [
+    "HierarchicalResult",
+    "hierarchical_assign",
+    "make_mesh",
+    "shard_cost",
+    "sharded_hierarchical_assign",
+    "sharded_sinkhorn",
+    "sharded_sinkhorn_assign",
+]
+
+
+def __getattr__(name):
+    # Lazy: hierarchical pulls in the ops stack; keep `import rio_tpu.parallel`
+    # light for users who only need the mesh helpers.
+    if name in ("HierarchicalResult", "hierarchical_assign", "sharded_hierarchical_assign"):
+        from . import hierarchical
+
+        return getattr(hierarchical, name)
+    raise AttributeError(name)
 
 
 def make_mesh(devices=None, *, obj_axis: int | None = None) -> Mesh:

@@ -1,0 +1,92 @@
+"""Parity: scaling-form (Sinkhorn-Knopp) solvers vs the log-domain solve.
+
+The scaling iterations are mathematically identical to the log-domain
+updates, so with a float32 kernel the potentials must agree tightly; the
+fused Pallas version (interpret mode on the CPU test mesh) must agree with
+the XLA scaling version bit-for-mathematically."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rio_tpu.ops.scaling import (
+    fused_scaling_iteration,
+    pallas_scaling_sinkhorn,
+    scaling_sinkhorn,
+)
+from rio_tpu.ops.sinkhorn import plan_rounded_assign, sinkhorn
+
+
+def _problem(key, n, m, dead_nodes=0, padded_rows=0):
+    k1, k2, k3 = jax.random.split(key, 3)
+    cost = jax.random.uniform(k1, (n, m), jnp.float32)
+    mass = jax.random.uniform(k2, (n,), jnp.float32) + 0.1
+    if padded_rows:
+        mass = mass.at[-padded_rows:].set(0.0)
+    cap = jax.random.uniform(k3, (m,), jnp.float32) + 0.5
+    if dead_nodes:
+        cap = cap.at[:dead_nodes].set(0.0)
+    return cost, mass, cap
+
+
+@pytest.mark.parametrize("n,m", [(64, 128), (96, 130)])
+def test_scaling_matches_log_domain(n, m):
+    cost, mass, cap = _problem(jax.random.PRNGKey(0), n, m)
+    ref = sinkhorn(cost, mass, cap, eps=0.08, n_iters=25)
+    out = scaling_sinkhorn(
+        cost, mass, cap, eps=0.08, n_iters=25, kernel_dtype=jnp.float32
+    )
+    np.testing.assert_allclose(np.asarray(out.f), np.asarray(ref.f), rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(out.g), np.asarray(ref.g), rtol=1e-3, atol=1e-3)
+
+
+def test_scaling_dead_nodes_and_padding():
+    cost, mass, cap = _problem(jax.random.PRNGKey(1), 48, 96, dead_nodes=3, padded_rows=5)
+    ref = sinkhorn(cost, mass, cap, eps=0.06, n_iters=30)
+    out = scaling_sinkhorn(cost, mass, cap, eps=0.06, n_iters=30, kernel_dtype=jnp.float32)
+    assert np.all(np.isneginf(np.asarray(out.g[:3])))
+    assert np.all(np.isneginf(np.asarray(out.f[-5:])))
+    np.testing.assert_allclose(np.asarray(out.g[3:]), np.asarray(ref.g[3:]), rtol=1e-3, atol=1e-3)
+    a1 = plan_rounded_assign(cost, out.f, out.g, 0.06)
+    a2 = plan_rounded_assign(cost, ref.f, ref.g, 0.06)
+    assert np.mean(np.asarray(a1) == np.asarray(a2)) > 0.95
+
+
+@pytest.mark.parametrize("n,m,block", [(64, 128, 8), (96, 130, 32), (40, 100, 16)])
+def test_pallas_scaling_matches_xla_scaling(n, m, block):
+    cost, mass, cap = _problem(jax.random.PRNGKey(2), n, m)
+    ref = scaling_sinkhorn(cost, mass, cap, eps=0.07, n_iters=25, kernel_dtype=jnp.float32)
+    out = pallas_scaling_sinkhorn(
+        cost, mass, cap, eps=0.07, n_iters=25,
+        kernel_dtype=jnp.float32, block_rows=block, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(out.f), np.asarray(ref.f), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(out.g), np.asarray(ref.g), rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_scaling_bf16_close_enough_for_assignment():
+    cost, mass, cap = _problem(jax.random.PRNGKey(3), 128, 128)
+    ref = sinkhorn(cost, mass, cap, eps=0.08, n_iters=25)
+    out = pallas_scaling_sinkhorn(
+        cost, mass, cap, eps=0.08, n_iters=25,
+        kernel_dtype=jnp.bfloat16, block_rows=32, interpret=True,
+    )
+    a1 = plan_rounded_assign(cost, out.f, out.g, 0.08)
+    a2 = plan_rounded_assign(cost, ref.f, ref.g, 0.08)
+    # bf16 kernel may flip near-ties; the bulk of the assignment must agree.
+    assert np.mean(np.asarray(a1) == np.asarray(a2)) > 0.9
+
+
+def test_fused_scaling_iteration_single_step():
+    n, m = 32, 128
+    key = jax.random.PRNGKey(4)
+    K = jax.random.uniform(key, (n, m), jnp.float32) + 0.01
+    a = jnp.full((n,), 1.0 / n)
+    b = jnp.full((m,), 1.0 / m)
+    v_prev = jax.random.uniform(jax.random.PRNGKey(5), (m,)) + 0.5
+    u, v = fused_scaling_iteration(K, a, b, v_prev, block_rows=8, interpret=True)
+    u_ref = a / (K @ v_prev)
+    v_ref = b / (K.T @ u_ref)
+    np.testing.assert_allclose(np.asarray(u), np.asarray(u_ref), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(v), np.asarray(v_ref), rtol=1e-5)

@@ -1,0 +1,207 @@
+"""Scale/concurrency stress tests.
+
+Mirrors the reference's concurrency regression test: 1M actors dispatching
+through one shared registry, with re-entrant proxy sends, proving dispatch
+never holds a map-wide lock across an ``await``
+(``rio-rs/src/registry/mod.rs:561-625`` ``test_proxy_deadlock``). Plus the
+server-path variant through the internal-client queue
+(``rio-rs/src/server.rs:309-332``), and a node-churn re-solve exercise for
+the placement provider (BASELINE.md row 4)."""
+
+import asyncio
+import os
+
+import pytest
+
+from rio_tpu import AppData, Registry, ServiceObject, handler, message
+
+N_ACTORS = 1_000_000 if os.environ.get("RIO_TPU_STRESS_FULL") else 200_000
+N_CONCURRENT = 5_000
+
+
+@message
+class SHop:
+    target: str = ""
+
+
+@message
+class SDone:
+    hops: int = 0
+
+
+class StressProxy(ServiceObject):
+    @handler
+    async def hop(self, msg: SHop, ctx: AppData) -> SDone:
+        if msg.target:
+            # Re-entrant dispatch through the SAME registry while this
+            # object's lock is held — deadlocks if dispatch serializes on a
+            # map-wide lock across await.
+            reg: Registry = ctx.get(Registry)
+            out = await reg.send(
+                "StressProxy", msg.target, SHop(), ctx, returns=SDone
+            )
+            return SDone(hops=out.hops + 1)
+        return SDone(hops=1)
+
+
+def test_million_actor_proxy_dispatch_no_deadlock():
+    reg = Registry()
+    reg.add_type(StressProxy)
+    for i in range(N_ACTORS):
+        reg.insert("StressProxy", str(i), reg.new_from_type("StressProxy", str(i)))
+    assert reg.count_objects() == N_ACTORS
+
+    app = AppData()
+    app.set(reg)
+
+    async def run():
+        # Every task proxies through actor i to a distinct hub actor; with a
+        # map-wide lock this collapses to a deadlock (outer dispatch holds
+        # the map while the inner needs it) or full serialization.
+        hub_base = N_ACTORS // 2
+        outs = await asyncio.gather(*[
+            reg.send(
+                "StressProxy",
+                str(i),
+                SHop(target=str(hub_base + (i % 1000))),
+                app,
+                returns=SDone,
+            )
+            for i in range(N_CONCURRENT)
+        ])
+        assert all(o.hops == 2 for o in outs)
+
+    asyncio.run(asyncio.wait_for(run(), 120))
+
+
+def test_proxy_to_single_hub_is_serialized_not_deadlocked():
+    """All proxies target ONE hub: contention on the hub's per-object lock
+    must serialize cleanly, never deadlock."""
+    reg = Registry()
+    reg.add_type(StressProxy)
+    for i in range(1001):
+        reg.insert("StressProxy", str(i), reg.new_from_type("StressProxy", str(i)))
+    app = AppData()
+    app.set(reg)
+
+    async def run():
+        outs = await asyncio.gather(*[
+            reg.send("StressProxy", str(i), SHop(target="1000"), app, returns=SDone)
+            for i in range(1000)
+        ])
+        assert all(o.hops == 2 for o in outs)
+
+    asyncio.run(asyncio.wait_for(run(), 60))
+
+
+@message
+class FanHop:
+    next_id: str = ""
+
+
+@message
+class FanDone:
+    ok: bool = True
+
+
+class FanProxy(ServiceObject):
+    @handler
+    async def hop(self, msg: FanHop, ctx: AppData) -> FanDone:
+        if msg.next_id:
+            # Actor→actor through the server's internal-client queue; the
+            # consumer MUST spawn dispatches (server.rs:309-332) or this
+            # nests a queue wait under a held lock.
+            return await ServiceObject.send(
+                ctx, FanProxy, msg.next_id, FanHop(), returns=FanDone
+            )
+        return FanDone()
+
+
+def test_internal_client_fanout_no_queue_deadlock():
+    from rio_tpu import LocalObjectPlacement, LocalStorage, Server
+    from rio_tpu.cluster.membership_protocol import LocalClusterProvider
+
+    def reg():
+        r = Registry()
+        r.add_type(FanProxy)
+        return r
+
+    async def run():
+        members = LocalStorage()
+        server = Server(
+            address="127.0.0.1:0",
+            registry=reg(),
+            cluster_provider=LocalClusterProvider(members),
+            object_placement_provider=LocalObjectPlacement(),
+        )
+        await server.prepare()
+        await server.bind()
+        task = asyncio.create_task(server.run())
+        while not await members.active_members():
+            await asyncio.sleep(0.02)
+        from rio_tpu import Client
+
+        client = Client(members)
+        outs = await asyncio.gather(*[
+            client.send(FanProxy, f"p{i}", FanHop(next_id=f"q{i % 50}"), returns=FanDone)
+            for i in range(500)
+        ])
+        assert all(o.ok for o in outs)
+        client.close()
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+
+    asyncio.run(asyncio.wait_for(run(), 120))
+
+
+# ---------------------------------------------------------------------------
+# Churn re-solve (BASELINE.md row 4: 10% node churn with warm restarts)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_churn_resolve_moves_only_affected_objects():
+    from rio_tpu.object_placement import ObjectId
+    from rio_tpu.object_placement.jax_placement import JaxObjectPlacement
+
+    placement = JaxObjectPlacement(mode="sinkhorn")
+    n_nodes, n_objects = 20, 2000
+    for i in range(n_nodes):
+        placement.register_node(f"10.0.0.{i}:50")
+    ids = [ObjectId("Churn", str(i)) for i in range(n_objects)]
+    await placement.assign_batch(ids)
+    await placement.rebalance()
+    before = {str(i): await placement.lookup(i) for i in ids}
+
+    # 10% of nodes die; their objects must move, the rest should mostly stay.
+    dead = {f"10.0.0.{i}:50" for i in range(2)}
+    for addr in dead:
+        await placement.clean_server(addr)
+    # clean_server already unassigned the dead nodes' objects; re-place them
+    # against cached potentials (the warm-start incremental path).
+    orphans = [i for i in ids if await placement.lookup(i) is None]
+    assert 0 < len(orphans) <= n_objects
+    await placement.assign_batch(orphans)
+
+    moved = stayed = 0
+    for i in ids:
+        addr = await placement.lookup(i)
+        assert addr is not None and addr not in dead
+        if addr == before[str(i)]:
+            stayed += 1
+        else:
+            moved += 1
+    # Only the orphans (~10%) should have moved.
+    assert moved <= len(orphans)
+    assert stayed >= n_objects - len(orphans)
+
+    # A full warm re-solve after churn converges and is capacity-sane.
+    moved2 = await placement.rebalance()
+    counts: dict[str, int] = {}
+    for i in ids:
+        addr = await placement.lookup(i)
+        counts[addr] = counts.get(addr, 0) + 1
+    live = [a for a in counts if a not in dead]
+    fair = n_objects / (n_nodes - len(dead))
+    assert max(counts[a] for a in live) < 2.0 * fair
+    assert moved2 <= n_objects
