@@ -670,7 +670,17 @@ void engine_handle_wake(Engine* e) {
   }
   for (auto& [id, data] : outs) {
     auto it = e->conns.find(id);
-    if (it == e->conns.end()) continue;
+    if (it == e->conns.end()) {
+      // Send raced a close: the bytes will never be written, so the
+      // backlog they were counted into must be released (a stale entry
+      // would wedge the Python-side backpressure wait forever).
+      std::lock_guard<std::mutex> lk(e->mu);
+      auto b = e->backlog.find(id);
+      if (b != e->backlog.end() &&
+          (b->second -= static_cast<long long>(data.size())) <= 0)
+        e->backlog.erase(b);
+      continue;
+    }
     it->second.wq.push_back(std::move(data));
   }
   // Flush every connection we touched (dedup via the map walk is fine at

@@ -15,6 +15,7 @@ This module re-exports the prelude (reference ``rio-rs/src/lib.rs:220-239``).
 
 from .app_data import AppData
 from .client import Client, ClientBuilder
+from .client.pool import ClientPool
 from .cluster.membership_protocol import ClusterProvider, LocalClusterProvider
 from .cluster.storage import LocalStorage, Member, MembershipStorage
 from .commands import AdminCommand, AdminSender, InternalClientSender, ServerInfo
@@ -33,6 +34,7 @@ __all__ = [
     "AdminCommand",
     "AdminSender",
     "Client",
+    "ClientPool",
     "ClientBuilder",
     "ClusterProvider",
     "InternalClientSender",

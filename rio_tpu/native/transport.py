@@ -166,7 +166,13 @@ class NativeServerTransport:
                         # leaking.
                         log.warning("conn %d exceeded pending-frame cap", conn)
                         self._conns.pop(conn, None)
-                        state.queue.put_nowait(None)
+                        if state.streaming:
+                            # A streaming worker never reads state.queue
+                            # again; the sentinel would orphan it.
+                            if state.worker is not None:
+                                state.worker.cancel()
+                        else:
+                            state.queue.put_nowait(None)
                         self._engine.close_conn(conn)
                     else:
                         state.queue.put_nowait(data)

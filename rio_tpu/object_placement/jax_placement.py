@@ -39,9 +39,36 @@ from ..ops import (
     build_cost_matrix,
     greedy_balanced_assign,
     plan_rounded_assign,
+    scaling_sinkhorn,
     sinkhorn,
 )
 from . import ObjectPlacement, ObjectPlacementItem
+
+_FEAT_DIM = 16  # hashed-identity feature width for the hierarchical mode
+
+
+def _hash_features(keys: list[str], dim: int = _FEAT_DIM) -> jax.Array:
+    """Stable pseudo-random feature per key (identity/cache-warmth proxy).
+
+    crc32 of the key seeds a per-key PRNG; the feature is deterministic
+    across processes, so affinity survives restarts without storage.
+    """
+    import zlib
+
+    seeds = np.asarray([zlib.crc32(k.encode()) & 0x7FFFFFFF for k in keys], np.uint32)
+    return jax.vmap(lambda s: jax.random.normal(jax.random.PRNGKey(s), (dim,)))(
+        jnp.asarray(seeds)
+    )
+
+
+def _profiler_trace(name: str):
+    """jax.profiler annotation for solver steps (SURVEY §5.1); no-op off-JAX."""
+    import contextlib
+
+    try:
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # pragma: no cover - profiler unavailable
+        return contextlib.nullcontext()
 
 
 def _next_bucket(n: int, minimum: int = 256) -> int:
@@ -258,6 +285,55 @@ class JaxObjectPlacement(ObjectPlacement):
             self._nodes[self._node_order[idx]].load += 1.0
         self._epoch += 1
 
+    def _hierarchical_solve(self, keys: list[str], node_order: list[str], cap, alive):
+        """Two-level OT re-solve over hashed identity features.
+
+        The flat-cost modes materialize (bucket x node_axis); this one stays
+        O(n x (groups + group_size + feat)) so it scales past HBM limits
+        (see :mod:`rio_tpu.parallel.hierarchical`). Reads ONLY the
+        lock-snapshotted ``node_order``/``cap``/``alive`` — it runs in the
+        solver thread, concurrent with directory mutations.
+        """
+        from ..parallel.hierarchical import hierarchical_assign
+
+        # Solve over a COMPACT node axis (real nodes padded to a group
+        # multiple), not the full static axis: trailing all-dead groups
+        # would concentrate coarse quotas into the few live groups and
+        # overflow their buckets.
+        m_real = max(1, len(node_order))
+        group_size = 8
+        m = -(-m_real // group_size) * group_size
+        n_groups = m // group_size
+        cap_full = np.asarray(cap, np.float32)
+        alive_full = np.asarray(alive, np.float32)
+        cap_np = np.zeros((m,), np.float32)
+        alive_np = np.zeros((m,), np.float32)
+        cap_np[:m_real] = cap_full[:m_real]
+        alive_np[:m_real] = alive_full[:m_real]
+        # Bucket from the fullest group's capacity share (host-side, static
+        # per solve): uniform N/G sizing under-provisions skewed clusters.
+        live_cap = (cap_np * alive_np).reshape(n_groups, group_size).sum(axis=1)
+        share = live_cap.max() / max(live_cap.sum(), 1e-9)
+        n = len(keys)
+        bucket_sz = max(8, -(-int(1.3 * n * float(share)) // 8) * 8)
+
+        obj_feat = _hash_features(keys)
+        node_feat = np.zeros((_FEAT_DIM, m), np.float32)
+        if node_order:
+            node_feat[:, : len(node_order)] = np.asarray(_hash_features(node_order)).T
+        res = hierarchical_assign(
+            obj_feat,
+            jnp.asarray(node_feat),
+            jnp.asarray(cap_np),
+            jnp.asarray(alive_np),
+            n_groups=n_groups,
+            bucket=min(bucket_sz, n),
+            eps=self._eps,
+            coarse_iters=self._n_iters,
+            fine_iters=self._n_iters,
+        )
+        return res.assignment, None
+
     async def rebalance(self, *, mode: str | None = None) -> int:
         """Full re-solve of every tracked object; returns number of moves.
 
@@ -272,40 +348,59 @@ class JaxObjectPlacement(ObjectPlacement):
             snapshot_epoch = self._epoch
             self._recount_loads()
             load, cap, alive = self._node_vectors()
+            node_order = list(self._node_order)  # snapshot for off-lock use
         if not keys:
             return 0
 
         n = len(keys)
         bucket = _next_bucket(n)
+        if mode == "scaling" and self._mesh is not None:
+            import logging
+
+            logging.getLogger("rio_tpu.placement").warning(
+                "mode='scaling' with a mesh falls back to the log-domain "
+                "sharded solver (no sharded scaling-form implementation yet)"
+            )
 
         def _solve() -> tuple[np.ndarray, jax.Array | None, float]:
             """Device solve off the event loop: np.asarray blocks until the
             TPU finishes, so running it in a thread keeps lookups/gossip/RPCs
-            live — and makes the epoch-discard check below load-bearing."""
-            base_cost = build_cost_matrix(jnp.zeros_like(load), cap, alive)
-            cost = jnp.broadcast_to(base_cost, (bucket, base_cost.shape[1]))
-            mass = jnp.concatenate(
-                [jnp.ones((n,), jnp.float32), jnp.zeros((bucket - n,), jnp.float32)]
-            )
+            live — and makes the epoch-discard check below load-bearing.
+            Only the snapshots taken under the lock are read here."""
             t0 = time.perf_counter()
-            if mode == "sinkhorn":
-                if self._mesh is not None:
-                    from ..parallel import shard_cost, sharded_sinkhorn
+            from ..tracing import span
 
-                    cost = shard_cost(self._mesh, cost)
-                    f, g = sharded_sinkhorn(
-                        self._mesh, cost, mass, cap * alive,
-                        eps=self._eps, n_iters=self._n_iters,
-                    )
+            with span("placement_solve", mode=mode, n=n), _profiler_trace(
+                f"rio_tpu.solve.{mode}"
+            ):
+                if mode == "hierarchical":
+                    # Never materializes the flat (bucket x node_axis) cost.
+                    assignment, g = self._hierarchical_solve(keys, node_order, cap, alive)
                 else:
-                    res = sinkhorn(
-                        cost, mass, cap * alive, eps=self._eps, n_iters=self._n_iters
+                    base_cost = build_cost_matrix(jnp.zeros_like(load), cap, alive)
+                    cost = jnp.broadcast_to(base_cost, (bucket, base_cost.shape[1]))
+                    mass = jnp.concatenate(
+                        [jnp.ones((n,), jnp.float32), jnp.zeros((bucket - n,), jnp.float32)]
                     )
-                    f, g = res.f, res.g
-                assignment = plan_rounded_assign(cost, f, g, self._eps)
-            else:
-                assignment = greedy_balanced_assign(cost, mass, cap * alive)
-                g = None
+                    if mode in ("sinkhorn", "scaling"):
+                        if self._mesh is not None:
+                            from ..parallel import shard_cost, sharded_sinkhorn
+
+                            cost = shard_cost(self._mesh, cost)
+                            f, g = sharded_sinkhorn(
+                                self._mesh, cost, mass, cap * alive,
+                                eps=self._eps, n_iters=self._n_iters,
+                            )
+                        else:
+                            solver = scaling_sinkhorn if mode == "scaling" else sinkhorn
+                            res = solver(
+                                cost, mass, cap * alive, eps=self._eps, n_iters=self._n_iters
+                            )
+                            f, g = res.f, res.g
+                        assignment = plan_rounded_assign(cost, f, g, self._eps)
+                    else:
+                        assignment = greedy_balanced_assign(cost, mass, cap * alive)
+                        g = None
             out = np.asarray(assignment)[:n]
             return out, g, (time.perf_counter() - t0) * 1e3
 

@@ -88,7 +88,10 @@ def hierarchical_assign(
       alive: (M,) liveness in {0.0, 1.0}; dead nodes attract nothing.
       n_groups: number of node groups; M must be divisible by it.
       bucket: per-group object bucket size (static). Defaults to
-        ``ceil(1.25 * N / G)`` rounded up to a multiple of 8.
+        ``ceil(1.25 * N / G)`` rounded up to a multiple of 8 — sized for
+        roughly uniform group capacity. With skewed capacity (or mostly-dead
+        groups) pass an explicit bucket ~ ``1.3 * N * max_group_cap_share``
+        or quotas overflow into the fallback path.
     """
     n, d = obj_feat.shape
     d2, m = node_feat.shape

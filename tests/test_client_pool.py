@@ -1,0 +1,102 @@
+"""Client pool: bounded size, reuse, discard, close (reference client/pool.rs)."""
+
+import asyncio
+
+import pytest
+
+from rio_tpu import AppData, Registry, ServiceObject, handler, message
+from rio_tpu.client.pool import ClientPool
+
+from .server_utils import Cluster, run_integration_test
+
+
+@message
+class PoolPing:
+    pass
+
+
+@message
+class PoolPong:
+    n: int = 0
+
+
+class PoolSvc(ServiceObject):
+    def __init__(self):
+        self.n = 0
+
+    @handler
+    async def ping(self, msg: PoolPing, ctx: AppData) -> PoolPong:
+        self.n += 1
+        await asyncio.sleep(0.01)
+        return PoolPong(n=self.n)
+
+
+def build_registry() -> Registry:
+    r = Registry()
+    r.add_type(PoolSvc)
+    return r
+
+
+def test_pool_bounds_and_reuses_clients():
+    async def body(cluster: Cluster):
+        pool = ClientPool(cluster.members, max_size=3)
+
+        async def one(i: int):
+            async with pool.client() as c:
+                assert pool.size <= 3
+                return await c.send(PoolSvc, f"p{i % 5}", PoolPing(), returns=PoolPong)
+
+        outs = await asyncio.gather(*[one(i) for i in range(30)])
+        assert len(outs) == 30
+        assert pool.size <= 3  # never exceeded the bound
+        assert pool.idle == pool.size  # everything returned
+        pool.close()
+        assert pool.size == 0
+
+    asyncio.run(run_integration_test(body, registry_builder=build_registry, num_servers=2))
+
+
+def test_pool_discard_replaces_client():
+    async def body(cluster: Cluster):
+        pool = ClientPool(cluster.members, max_size=2)
+        async with pool.client() as c:
+            await c.send(PoolSvc, "d", PoolPing(), returns=PoolPong)
+            c.discard()
+        assert pool.size == 0  # the discarded client is gone
+        async with pool.client() as c2:
+            out = await c2.send(PoolSvc, "d", PoolPing(), returns=PoolPong)
+            assert out.n == 2
+        pool.close()
+
+    asyncio.run(run_integration_test(body, registry_builder=build_registry, num_servers=2))
+
+
+def test_pool_waiters_queue_until_release():
+    async def body(cluster: Cluster):
+        pool = ClientPool(cluster.members, max_size=1)
+        order: list[int] = []
+
+        async def task(i: int):
+            async with pool.client() as c:
+                order.append(i)
+                await c.send(PoolSvc, "w", PoolPing(), returns=PoolPong)
+
+        await asyncio.gather(task(1), task(2), task(3))
+        assert sorted(order) == [1, 2, 3]
+        assert pool.size == 1
+        pool.close()
+
+    asyncio.run(run_integration_test(body, registry_builder=build_registry, num_servers=2))
+
+
+def test_pool_closed_rejects_acquire():
+    async def run():
+        from rio_tpu import LocalStorage
+
+        pool = ClientPool(LocalStorage(), max_size=2)
+        pool.close()
+        with pytest.raises(RuntimeError):
+            async with pool.client():
+                pass
+
+    asyncio.run(run())

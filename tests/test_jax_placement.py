@@ -127,3 +127,25 @@ async def test_sync_members_with_real_member_objects():
     addrs = await p.assign_batch([ObjectId("T", str(i)) for i in range(40)])
     assert "10.1.0.1:5000" not in addrs
     assert set(addrs) == {"10.1.0.0:5000", "10.1.0.2:5000"}
+
+
+async def test_rebalance_hierarchical_mode():
+    """Two-level OT mode: valid, live-only, reasonably balanced placements."""
+    placement = JaxObjectPlacement(mode="hierarchical", n_iters=15)
+    for i in range(16):
+        placement.register_node(f"10.1.0.{i}:70")
+    ids = [ObjectId("H", str(i)) for i in range(800)]
+    await placement.assign_batch(ids)
+    await placement.clean_server("10.1.0.3:70")
+    orphans = [i for i in ids if await placement.lookup(i) is None]
+    await placement.assign_batch(orphans)
+    moved = await placement.rebalance()
+    assert moved >= 0
+    counts: dict[str, int] = {}
+    for oid in ids:
+        addr = await placement.lookup(oid)
+        assert addr is not None and addr != "10.1.0.3:70"
+        counts[addr] = counts.get(addr, 0) + 1
+    fair = len(ids) / 15
+    assert max(counts.values()) < 2.5 * fair
+    assert placement.stats.mode == "hierarchical"
