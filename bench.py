@@ -94,8 +94,23 @@ def tpu_solve_rate(n_obj: int) -> tuple[float, int]:
     return n_obj / min(times), n_obj
 
 
+def route_hop_summary() -> str:
+    """p99 route hops, simulated for both client policies (BASELINE metric)."""
+    from rio_tpu.utils.routing_sim import simulate_route_hops
+
+    stats = simulate_route_hops(n_requests=100_000)
+    ref, ours = stats["reference"], stats["rio_tpu"]
+    print(
+        f"# route hops @1M obj/1k nodes: ours p99={ours.p99} mean={ours.mean:.2f}"
+        f" | reference-policy p99={ref.p99} mean={ref.mean:.2f}",
+        file=sys.stderr,
+    )
+    return f"p99 hops {ours.p99:.0f} vs {ref.p99:.0f}"
+
+
 def main() -> None:
     baseline = sqlite_baseline_rate()
+    hops = route_hop_summary()
     rate = None
     for n_obj in (1_048_576, 524_288, 262_144):
         try:
@@ -108,7 +123,10 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": f"placements/sec (OT solve, {n_used} objects x {N_NODES} nodes)",
+                "metric": (
+                    f"placements/sec (OT solve, {n_used} objects x {N_NODES} nodes; "
+                    f"{hops})"
+                ),
                 "value": round(rate, 1),
                 "unit": "placements/sec",
                 "vs_baseline": round(rate / baseline, 2),

@@ -483,6 +483,7 @@ struct Conn {
   bool epollout = false;
   bool read_eof = false;       // peer half-closed; write side may still flow
   bool close_pending = false;  // close requested; waiting for wq to flush
+  bool connecting = false;     // outbound connect in flight (await EPOLLOUT)
 };
 
 struct EngineEvent {
@@ -506,9 +507,15 @@ struct Engine {
   std::vector<std::pair<uint64_t, std::vector<uint8_t>>> outq;
   std::vector<uint64_t> closeq;
   std::unordered_map<uint64_t, long long> backlog;  // unsent bytes per conn
+  struct ConnectReq {
+    uint64_t id;
+    uint32_t addr_be;  // IPv4, network order
+    uint16_t port;
+  };
+  std::vector<ConnectReq> connectq;
 
   std::unordered_map<uint64_t, Conn> conns;  // IO-thread only
-  uint64_t next_id = 1;
+  std::atomic<uint64_t> next_id{1};
 
   void notify() {
     uint64_t one = 1;
@@ -641,7 +648,7 @@ void engine_accept_all(Engine* e) {
     if (fd < 0) return;
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    uint64_t id = e->next_id++;
+    uint64_t id = e->next_id.fetch_add(1);
     Conn c;
     c.fd = fd;
     e->conns.emplace(id, std::move(c));
@@ -657,17 +664,51 @@ void engine_accept_all(Engine* e) {
   }
 }
 
+// Initiate one queued outbound connect on the IO thread.
+void engine_start_connect(Engine* e, const Engine::ConnectReq& req) {
+  int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+  if (fd < 0) {
+    e->push_event(RN_EV_CLOSED, req.id, {});
+    return;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(req.port);
+  addr.sin_addr.s_addr = req.addr_be;
+  int rc = connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+  if (rc < 0 && errno != EINPROGRESS) {
+    close(fd);
+    e->push_event(RN_EV_CLOSED, req.id, {});
+    return;
+  }
+  bool in_progress = (rc < 0);
+  Conn c;
+  c.fd = fd;
+  c.connecting = in_progress;
+  e->conns.emplace(req.id, std::move(c));
+  epoll_event ev{};
+  ev.events = in_progress ? EPOLLOUT : EPOLLIN;
+  ev.data.u64 = req.id;
+  epoll_ctl(e->epfd, EPOLL_CTL_ADD, fd, &ev);
+  if (!in_progress) e->push_event(RN_EV_OPENED, req.id, {});
+}
+
 void engine_handle_wake(Engine* e) {
   uint64_t buf;
   while (read(e->wake_fd, &buf, 8) == 8) {
   }
   std::vector<std::pair<uint64_t, std::vector<uint8_t>>> outs;
   std::vector<uint64_t> closes;
+  std::vector<Engine::ConnectReq> connects;
   {
     std::lock_guard<std::mutex> lk(e->mu);
     outs.swap(e->outq);
     closes.swap(e->closeq);
+    connects.swap(e->connectq);
   }
+  for (auto& req : connects) engine_start_connect(e, req);
   for (auto& [id, data] : outs) {
     auto it = e->conns.find(id);
     if (it == e->conns.end()) {
@@ -722,6 +763,27 @@ void engine_loop(Engine* e) {
       }
       auto it = e->conns.find(tag);
       if (it == e->conns.end()) continue;
+      if (it->second.connecting) {
+        // Outbound connect resolved (EPOLLOUT) or failed (HUP/ERR).
+        int err = 0;
+        socklen_t elen = sizeof(err);
+        getsockopt(it->second.fd, SOL_SOCKET, SO_ERROR, &err, &elen);
+        if (err != 0 || (evs[i].events & (EPOLLHUP | EPOLLERR))) {
+          engine_close_conn(e, tag, true);
+          continue;
+        }
+        it->second.connecting = false;
+        // Reset write-interest tracking so engine_flush re-arms EPOLLOUT
+        // for bytes queued while the connect was in flight.
+        it->second.epollout = false;
+        epoll_event ev{};
+        ev.events = EPOLLIN;
+        ev.data.u64 = tag;
+        epoll_ctl(e->epfd, EPOLL_CTL_MOD, it->second.fd, &ev);
+        e->push_event(RN_EV_OPENED, tag, {});
+        engine_flush(e, tag, it->second);
+        continue;
+      }
       if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
         engine_close_conn(e, tag, true);
         continue;
@@ -738,54 +800,79 @@ void engine_loop(Engine* e) {
 
 }  // namespace
 
-// Creates the engine and binds the listening socket. host is a dotted quad
-// ("0.0.0.0" for any); *port_inout carries the requested port in and the
-// actually-bound port out. Returns nullptr on failure.
+// Creates the engine and (when host is non-empty) binds the listening
+// socket. host is a dotted quad ("0.0.0.0" for any); an empty host makes a
+// client-only engine with no listener. *port_inout carries the requested
+// port in and the actually-bound port out (0 for client-only). Returns
+// nullptr on failure.
 void* rn_engine_create(const char* host, uint16_t* port_inout) {
   auto* e = new Engine();
+  bool want_listener = host != nullptr && host[0] != '\0';
   e->epfd = epoll_create1(EPOLL_CLOEXEC);
   e->notify_fd = eventfd(0, EFD_CLOEXEC | EFD_NONBLOCK);
   e->wake_fd = eventfd(0, EFD_CLOEXEC | EFD_NONBLOCK);
-  e->listen_fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
-  if (e->epfd < 0 || e->notify_fd < 0 || e->wake_fd < 0 || e->listen_fd < 0) {
+  if (want_listener)
+    e->listen_fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+  if (e->epfd < 0 || e->notify_fd < 0 || e->wake_fd < 0 ||
+      (want_listener && e->listen_fd < 0)) {
     for (int fd : {e->epfd, e->notify_fd, e->wake_fd, e->listen_fd})
       if (fd >= 0) close(fd);
     delete e;
     return nullptr;
   }
-  int one = 1;
-  setsockopt(e->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
-  sockaddr_in addr{};
-  addr.sin_family = AF_INET;
-  addr.sin_port = htons(*port_inout);
-  // Only dotted quads: the Python caller resolves hostnames. Refusing here
-  // (rather than widening to INADDR_ANY) keeps "localhost" from silently
-  // binding every interface.
-  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
-      bind(e->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
-      listen(e->listen_fd, 512) < 0) {
-    close(e->listen_fd);
-    close(e->epfd);
-    close(e->notify_fd);
-    close(e->wake_fd);
-    delete e;
-    return nullptr;
+  if (want_listener) {
+    int one = 1;
+    setsockopt(e->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(*port_inout);
+    // Only dotted quads: the Python caller resolves hostnames. Refusing here
+    // (rather than widening to INADDR_ANY) keeps "localhost" from silently
+    // binding every interface.
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
+        bind(e->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+        listen(e->listen_fd, 512) < 0) {
+      close(e->listen_fd);
+      close(e->epfd);
+      close(e->notify_fd);
+      close(e->wake_fd);
+      delete e;
+      return nullptr;
+    }
+    sockaddr_in bound{};
+    socklen_t blen = sizeof(bound);
+    getsockname(e->listen_fd, reinterpret_cast<sockaddr*>(&bound), &blen);
+    e->port = ntohs(bound.sin_port);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u64 = 0;  // listen tag
+    epoll_ctl(e->epfd, EPOLL_CTL_ADD, e->listen_fd, &ev);
   }
-  sockaddr_in bound{};
-  socklen_t blen = sizeof(bound);
-  getsockname(e->listen_fd, reinterpret_cast<sockaddr*>(&bound), &blen);
-  e->port = ntohs(bound.sin_port);
   *port_inout = e->port;
-
-  epoll_event ev{};
-  ev.events = EPOLLIN;
-  ev.data.u64 = 0;  // listen tag
-  epoll_ctl(e->epfd, EPOLL_CTL_ADD, e->listen_fd, &ev);
   epoll_event wev{};
   wev.events = EPOLLIN;
   wev.data.u64 = UINT64_MAX;  // wake tag
   epoll_ctl(e->epfd, EPOLL_CTL_ADD, e->wake_fd, &wev);
   return e;
+}
+
+// Queue an outbound connect; returns the pre-assigned conn id. The IO
+// thread emits RN_EV_OPENED on success or RN_EV_CLOSED on failure. host
+// must be a dotted quad (caller resolves names); returns 0 on bad input.
+uint64_t rn_engine_connect(void* ep, const char* host, uint16_t port) {
+  auto* e = static_cast<Engine*>(ep);
+  Engine::ConnectReq req{};
+  if (inet_pton(AF_INET, host, &req.addr_be) != 1) return 0;
+  req.id = e->next_id.fetch_add(1);
+  req.port = port;
+  {
+    std::lock_guard<std::mutex> lk(e->mu);
+    e->connectq.push_back(req);
+  }
+  uint64_t one = 1;
+  ssize_t rc = write(e->wake_fd, &one, 8);
+  (void)rc;
+  return req.id;
 }
 
 int rn_engine_notify_fd(void* ep) { return static_cast<Engine*>(ep)->notify_fd; }

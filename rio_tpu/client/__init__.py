@@ -64,17 +64,24 @@ class _Conn:
 
 
 class _ServerConns:
-    """Bounded pool of framed connections to one server address."""
+    """Bounded pool of framed connections to one server address.
 
-    def __init__(self, address: str, limit: int, timeout: float) -> None:
+    With a native :class:`rio_tpu.native.transport.ClientEngine`, sockets
+    and framing live on the engine's IO thread; otherwise asyncio streams.
+    """
+
+    def __init__(self, address: str, limit: int, timeout: float, engine=None) -> None:
         self.address = address
         self.limit = limit
         self.timeout = timeout
-        self.idle: list[_Conn] = []
+        self.engine = engine
+        self.idle: list = []
         self.sem = asyncio.Semaphore(limit)
 
-    async def _connect(self) -> _Conn:
+    async def _connect(self):
         host, _, port = self.address.rpartition(":")
+        if self.engine is not None:
+            return await self.engine.connect(host, int(port), self.timeout)
         try:
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(host, int(port)), self.timeout
@@ -117,7 +124,10 @@ class Client:
         pool_per_server: int = DEFAULT_POOL_PER_SERVER,
         connect_timeout: float = DEFAULT_PING_TIMEOUT,
         backoff: ExponentialBackoff | None = None,
+        transport: str = "asyncio",
     ) -> None:
+        if transport not in ("asyncio", "native", "auto"):
+            raise ValueError(f"unknown transport {transport!r}")
         self.members_storage = members_storage
         self._placement: LruCache[tuple[str, str], str] = LruCache(placement_cache_size)
         self._conns: dict[str, _ServerConns] = {}
@@ -129,7 +139,14 @@ class Client:
         # send() doesn't do it inside the event loop.
         from .. import native as _native
 
-        _native.get()
+        lib = _native.get()
+        self._client_engine = None
+        if transport == "native" or (transport == "auto" and lib is not None):
+            from ..native.transport import ClientEngine
+
+            # Request and subscription connections ride the engine's IO
+            # thread; pings keep asyncio streams (cold path, gossip-rate).
+            self._client_engine = ClientEngine()
 
     # -- server/membership view (reference client/mod.rs:153-220) -----------
 
@@ -142,7 +159,10 @@ class Client:
     def _pool(self, address: str) -> _ServerConns:
         pool = self._conns.get(address)
         if pool is None:
-            pool = _ServerConns(address, self._pool_per_server, self._connect_timeout)
+            pool = _ServerConns(
+                address, self._pool_per_server, self._connect_timeout,
+                engine=self._client_engine,
+            )
             self._conns[address] = pool
         return pool
 
@@ -241,9 +261,28 @@ class Client:
                 try:
                     address = await self._pick_address(tname, handler_id)
                     host, _, port = address.rpartition(":")
-                    reader, writer = await asyncio.wait_for(
-                        asyncio.open_connection(host, int(port)), self._connect_timeout
-                    )
+                    if self._client_engine is not None:
+                        conn = await self._client_engine.connect(
+                            host, int(port), self._connect_timeout
+                        )
+                        write_frame = conn.write
+                        next_frame = conn.read_frame
+                        close = conn.close
+                    else:
+                        reader, writer = await asyncio.wait_for(
+                            asyncio.open_connection(host, int(port)),
+                            self._connect_timeout,
+                        )
+
+                        def write_frame(b, _w=writer):
+                            _w.write(b)
+
+                        def next_frame(_r=reader):
+                            return codec.read_frame(_r)
+
+                        def close(_w=writer):
+                            _w.close()
+
                 except (OSError, asyncio.TimeoutError, ServerNotAvailable) as e:
                     attempt += 1
                     if attempt > self._backoff.max_retries:
@@ -253,10 +292,9 @@ class Client:
                     await self._backoff.sleep(attempt)
                     continue
                 try:
-                    writer.write(frame_bytes)
-                    await writer.drain()
+                    write_frame(frame_bytes)
                     while True:
-                        payload = await codec.read_frame(reader)
+                        payload = await next_frame()
                         if payload is None:
                             break  # server went away: resubscribe
                         resp = decode_subresponse(payload)
@@ -276,7 +314,7 @@ class Client:
                             yield resp
                 finally:
                     with contextlib.suppress(Exception):
-                        writer.close()
+                        close()
                 attempt += 1
                 if attempt > self._backoff.max_retries:
                     raise RetryExhausted(attempt, Disconnect("subscription dropped"))
@@ -304,6 +342,8 @@ class Client:
         for pool in self._conns.values():
             pool.close()
         self._conns.clear()
+        if self._client_engine is not None:
+            self._client_engine.close()
 
 
 class ClientBuilder:
@@ -331,6 +371,13 @@ class ClientBuilder:
         self._timeout = seconds
         return self
 
+    def transport(self, transport: str) -> "ClientBuilder":
+        """Socket/framing backend: "asyncio" (default), "native", or "auto"."""
+        if transport not in ("asyncio", "native", "auto"):
+            raise ClientBuilderError(f"unknown transport {transport!r}")
+        self._transport = transport
+        return self
+
     def build(self) -> Client:
         if self._storage is None:
             raise ClientBuilderError("members_storage is required")
@@ -339,4 +386,5 @@ class ClientBuilder:
             placement_cache_size=self._lru,
             pool_per_server=self._pool,
             connect_timeout=self._timeout,
+            transport=getattr(self, "_transport", "asyncio"),
         )

@@ -57,6 +57,11 @@ class ClientPool:
         if self._closed:
             raise RuntimeError("pool is closed")
         await self._sem.acquire()
+        # Re-check: close() may have run while we were parked on the
+        # semaphore — constructing a fresh client now would outlive the pool.
+        if self._closed:
+            self._sem.release()
+            raise RuntimeError("pool is closed")
         try:
             c = self._idle.pop() if self._idle else self._make()
         except BaseException:

@@ -137,6 +137,8 @@ class NativeLib:
         dll.rn_engine_send.restype = None
         dll.rn_engine_backlog.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         dll.rn_engine_backlog.restype = ctypes.c_longlong
+        dll.rn_engine_connect.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint16]
+        dll.rn_engine_connect.restype = ctypes.c_uint64
         dll.rn_engine_close_conn.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         dll.rn_engine_close_conn.restype = None
         dll.rn_engine_stop.argtypes = [ctypes.c_void_p]

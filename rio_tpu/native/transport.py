@@ -91,6 +91,148 @@ class Engine:
             self._dll.rn_engine_free(handle)
 
 
+class NativeClientConn:
+    """One outbound connection managed by a :class:`ClientEngine`.
+
+    Exposes the same surface the asyncio client connection offers
+    (``roundtrip``/``read_frame``/``close``); requests are strictly
+    sequential per connection (the client's per-server pool hands a
+    connection to one request at a time), so inbound frames map to the
+    in-flight request FIFO-style with no correlation ids — exactly the
+    reference's wire contract.
+    """
+
+    def __init__(self, engine: "ClientEngine", conn_id: int) -> None:
+        self._engine = engine
+        self._id = conn_id
+        self._frames: asyncio.Queue[bytes | None] = asyncio.Queue()
+        self.opened: asyncio.Future[bool] = asyncio.get_running_loop().create_future()
+        self.closed = False
+
+    async def roundtrip(self, frame_bytes: bytes) -> bytes:
+        from ..errors import Disconnect
+
+        if self.closed:
+            raise Disconnect("native connection closed")
+        self._engine._engine.send(self._id, frame_bytes)
+        payload = await self._frames.get()
+        if payload is None:
+            raise Disconnect("connection closed mid-request")
+        return payload
+
+    async def read_frame(self) -> bytes | None:
+        """Next inbound frame; None at EOF (subscription streaming)."""
+        if self.closed and self._frames.empty():
+            return None
+        return await self._frames.get()
+
+    def write(self, frame_bytes: bytes) -> None:
+        self._engine._engine.send(self._id, frame_bytes)
+
+    def close(self) -> None:
+        # Always drop: the C++ Conn/fd must be released even when the close
+        # was peer-initiated (closed=True set by EV_CLOSED).
+        self.closed = True
+        self._engine._drop(self._id)
+
+
+class ClientEngine:
+    """Client-side connection manager over a listener-less engine.
+
+    One engine (one native IO thread) serves every outbound connection of
+    a :class:`rio_tpu.Client`; frames and connect results come back
+    through the same eventfd/drain bridge the server transport uses.
+    """
+
+    def __init__(self) -> None:
+        lib = get()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._engine = Engine(lib, "", 0)
+        self._conns: dict[int, NativeClientConn] = {}
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._started = False
+
+    def _ensure_started(self) -> None:
+        if self._started:
+            return
+        self._loop = asyncio.get_running_loop()
+        self._loop.add_reader(self._engine.notify_fd, self._on_ready)
+        self._engine.start()
+        self._started = True
+
+    def _on_ready(self) -> None:
+        for ev_type, conn, data in self._engine.drain():
+            c = self._conns.get(conn)
+            if c is None:
+                continue
+            if ev_type == EV_OPENED:
+                if not c.opened.done():
+                    c.opened.set_result(True)
+            elif ev_type == EV_FRAME:
+                c._frames.put_nowait(data)
+            elif ev_type == EV_CLOSED:
+                c.closed = True
+                if not c.opened.done():
+                    c.opened.set_result(False)
+                c._frames.put_nowait(None)  # wake any reader
+                self._conns.pop(conn, None)
+                # Free the C++ side: a peer FIN takes the engine's soft-EOF
+                # path, which keeps the fd open for writes until told
+                # otherwise (server semantics); clients have no reply to
+                # flush, so release it now.
+                self._engine.close_conn(conn)
+
+    async def connect(self, host: str, port: int, timeout: float) -> NativeClientConn:
+        import socket as _socket
+
+        from ..errors import ServerNotAvailable
+
+        self._ensure_started()
+        try:
+            # Async resolution inside the timeout — a stuck resolver must
+            # not stall the event loop (the asyncio path gets this from
+            # open_connection).
+            infos = await asyncio.wait_for(
+                asyncio.get_running_loop().getaddrinfo(
+                    host, port, family=_socket.AF_INET, type=_socket.SOCK_STREAM
+                ),
+                timeout,
+            )
+            quad = infos[0][4][0]
+        except (OSError, asyncio.TimeoutError) as e:
+            raise ServerNotAvailable(f"{host}:{port}: resolve failed: {e}") from e
+        conn_id = self._lib._dll.rn_engine_connect(
+            self._engine._handle, quad.encode(), port
+        )
+        if conn_id == 0:
+            raise ServerNotAvailable(f"{host}:{port}: bad address")
+        conn = NativeClientConn(self, conn_id)
+        self._conns[conn_id] = conn
+        try:
+            ok = await asyncio.wait_for(conn.opened, timeout)
+        except asyncio.TimeoutError:
+            conn.close()
+            raise ServerNotAvailable(f"{host}:{port}: connect timeout") from None
+        if not ok:
+            raise ServerNotAvailable(f"{host}:{port}: connection refused")
+        return conn
+
+    def _drop(self, conn_id: int) -> None:
+        self._conns.pop(conn_id, None)
+        self._engine.close_conn(conn_id)
+
+    def close(self) -> None:
+        if self._loop is not None and self._started:
+            self._loop.remove_reader(self._engine.notify_fd)
+        for c in list(self._conns.values()):
+            c.closed = True
+            c._frames.put_nowait(None)
+        self._conns.clear()
+        self._engine.shutdown()
+
+
 class _ConnState:
     __slots__ = ("queue", "worker", "streaming")
 

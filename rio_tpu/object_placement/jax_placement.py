@@ -321,17 +321,34 @@ class JaxObjectPlacement(ObjectPlacement):
         node_feat = np.zeros((_FEAT_DIM, m), np.float32)
         if node_order:
             node_feat[:, : len(node_order)] = np.asarray(_hash_features(node_order)).T
-        res = hierarchical_assign(
-            obj_feat,
-            jnp.asarray(node_feat),
-            jnp.asarray(cap_np),
-            jnp.asarray(alive_np),
+        kw = dict(
             n_groups=n_groups,
             bucket=min(bucket_sz, n),
             eps=self._eps,
             coarse_iters=self._n_iters,
             fine_iters=self._n_iters,
         )
+        if self._mesh is not None:
+            # Shard the object axis across the mesh (the tier this mode is
+            # for); pad to a shard multiple with zero-feature rows and let
+            # the caller's [:n] slice drop them.
+            from ..parallel.hierarchical import sharded_hierarchical_assign
+
+            n_shards = int(self._mesh.devices.size)
+            n_pad = -(-n // n_shards) * n_shards
+            if n_pad != n:
+                obj_feat = jnp.concatenate(
+                    [obj_feat, jnp.zeros((n_pad - n, _FEAT_DIM), jnp.float32)]
+                )
+            res = sharded_hierarchical_assign(
+                self._mesh, obj_feat, jnp.asarray(node_feat),
+                jnp.asarray(cap_np), jnp.asarray(alive_np), **kw,
+            )
+        else:
+            res = hierarchical_assign(
+                obj_feat, jnp.asarray(node_feat),
+                jnp.asarray(cap_np), jnp.asarray(alive_np), **kw,
+            )
         return res.assignment, None
 
     async def rebalance(self, *, mode: str | None = None) -> int:
@@ -354,14 +371,6 @@ class JaxObjectPlacement(ObjectPlacement):
 
         n = len(keys)
         bucket = _next_bucket(n)
-        if mode == "scaling" and self._mesh is not None:
-            import logging
-
-            logging.getLogger("rio_tpu.placement").warning(
-                "mode='scaling' with a mesh falls back to the log-domain "
-                "sharded solver (no sharded scaling-form implementation yet)"
-            )
-
         def _solve() -> tuple[np.ndarray, jax.Array | None, float]:
             """Device solve off the event loop: np.asarray blocks until the
             TPU finishes, so running it in a thread keeps lookups/gossip/RPCs
@@ -384,10 +393,19 @@ class JaxObjectPlacement(ObjectPlacement):
                     )
                     if mode in ("sinkhorn", "scaling"):
                         if self._mesh is not None:
-                            from ..parallel import shard_cost, sharded_sinkhorn
+                            from ..parallel import (
+                                shard_cost,
+                                sharded_scaling_sinkhorn,
+                                sharded_sinkhorn,
+                            )
 
                             cost = shard_cost(self._mesh, cost)
-                            f, g = sharded_sinkhorn(
+                            sharded = (
+                                sharded_scaling_sinkhorn
+                                if mode == "scaling"
+                                else sharded_sinkhorn
+                            )
+                            f, g = sharded(
                                 self._mesh, cost, mass, cap * alive,
                                 eps=self._eps, n_iters=self._n_iters,
                             )

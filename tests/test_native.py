@@ -291,3 +291,76 @@ def test_native_mixed_transports_interop():
             await asyncio.gather(*tasks, return_exceptions=True)
 
     asyncio.run(run())
+
+
+def test_native_client_engine_roundtrips():
+    """Client with transport='native': sockets + framing on the C++ engine."""
+
+    async def body(cluster: Cluster):
+        client = cluster.client(transport="native")
+        assert client._client_engine is not None
+        for i in range(10):
+            out = await client.send(NativeOracle, "ne", Ask(text=f"m{i}"), returns=Answer)
+            assert out.times == i + 1
+        client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body, registry_builder=build_registry, num_servers=2, transport="native"
+        )
+    )
+
+
+def test_native_client_redirects_and_connect_failure():
+    async def body(cluster: Cluster):
+        c1 = cluster.client(transport="native")
+        for i in range(8):
+            await c1.send(NativeOracle, f"r{i}", Ask(text="seed"), returns=Answer)
+        c2 = cluster.client(transport="native")
+        for i in range(8):
+            out = await c2.send(NativeOracle, f"r{i}", Ask(text="q"), returns=Answer)
+            assert out.times == 2
+        # Connect to a dead port must raise cleanly through the engine.
+        from rio_tpu.errors import ServerNotAvailable
+        import pytest as _pytest
+
+        with _pytest.raises(ServerNotAvailable):
+            await c1._client_engine.connect("127.0.0.1", 9, 0.5)
+        c1.close()
+        c2.close()
+
+    asyncio.run(
+        run_integration_test(
+            body, registry_builder=build_registry, num_servers=4, transport="asyncio"
+        )
+    )
+
+
+def test_native_client_subscription():
+    """Subscriptions ride the client engine end-to-end."""
+
+    async def body(cluster: Cluster):
+        client = cluster.client(transport="native")
+        await client.send(NativeOracle, "nsub", Ask(text="warm"), returns=Answer)
+        stream = await client.subscribe(NativeOracle, "nsub")
+        got: list[str] = []
+
+        async def consume():
+            async for item in stream:
+                got.append(item.text)
+                if len(got) >= 3:
+                    return
+
+        task = asyncio.create_task(consume())
+        await asyncio.sleep(0.2)
+        for i in range(3):
+            await client.send(NativeOracle, "nsub", Publish(text=f"s{i}"), returns=Answer)
+        await asyncio.wait_for(task, 5)
+        assert got == ["pub:s0", "pub:s1", "pub:s2"]
+        client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body, registry_builder=build_registry, num_servers=2, transport="native"
+        )
+    )

@@ -90,3 +90,24 @@ def test_fused_scaling_iteration_single_step():
     v_ref = b / (K.T @ u_ref)
     np.testing.assert_allclose(np.asarray(u), np.asarray(u_ref), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(v), np.asarray(v_ref), rtol=1e-5)
+
+
+def test_sharded_scaling_matches_single_device():
+    import pytest
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8-device mesh")
+    from rio_tpu.parallel import make_mesh, shard_cost, sharded_scaling_sinkhorn
+
+    n, m = 128, 64
+    cost, mass, cap = _problem(jax.random.PRNGKey(6), n, m, dead_nodes=2)
+    single = scaling_sinkhorn(
+        cost, mass, cap, eps=0.07, n_iters=25, kernel_dtype=jnp.float32
+    )
+    mesh = make_mesh(jax.devices()[:8])
+    f, g = sharded_scaling_sinkhorn(
+        mesh, shard_cost(mesh, cost), mass, cap,
+        eps=0.07, n_iters=25, kernel_dtype=jnp.float32,
+    )
+    np.testing.assert_allclose(np.asarray(g), np.asarray(single.g), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(f), np.asarray(single.f), rtol=1e-4, atol=1e-4)
