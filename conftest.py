@@ -22,6 +22,20 @@ import jax  # noqa: E402  (must come after the env setup above)
 
 jax.config.update("jax_platforms", "cpu")
 
+# Defensive: deregister the axon TPU-tunnel PJRT plugin entirely. Even with
+# jax_platforms=cpu its factory can be initialized during backend discovery,
+# and a wedged tunnel (e.g. a stale chip grant) then hangs the whole test
+# session on the first jax op.
+try:  # pragma: no cover - environment-specific
+    from jax._src import xla_bridge as _xb
+
+    for _reg in ("_backend_factories", "backend_factories"):
+        _factories = getattr(_xb, _reg, None)
+        if isinstance(_factories, dict):
+            _factories.pop("axon", None)
+except Exception:
+    pass
+
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: run async test on a fresh event loop")

@@ -59,7 +59,23 @@ def _to_wire(value: Any) -> Any:
 
 
 def serialize(value: Any) -> bytes:
-    """Encode ``value`` (dataclass, primitive, or container) to bytes."""
+    """Encode ``value`` (dataclass, primitive, or container) to bytes.
+
+    Dataclasses encode positionally (bincode-like — no field names on the
+    wire)::
+
+        >>> import dataclasses
+        >>> from rio_tpu import codec
+        >>> @dataclasses.dataclass
+        ... class Point:
+        ...     x: int = 0
+        ...     y: int = 0
+        >>> data = codec.serialize(Point(x=3, y=4))
+        >>> codec.deserialize(data, Point)
+        Point(x=3, y=4)
+        >>> codec.deserialize(codec.serialize([1, "two", b"3"]), list)
+        [1, 'two', b'3']
+    """
     try:
         return msgpack.packb(_to_wire(value), use_bin_type=True)
     except (TypeError, ValueError, msgpack.exceptions.PackException) as e:
@@ -285,7 +301,15 @@ class FrameReader:
     """Incremental length-delimited frame decoder (sans-io).
 
     Feed raw bytes with :meth:`feed`; completed frames come back as a list.
-    Usable both from asyncio protocols and the test harness.
+    Usable both from asyncio protocols and the test harness::
+
+        >>> from rio_tpu.codec import FrameReader, frame
+        >>> r = FrameReader()
+        >>> stream = frame(b"one") + frame(b"two")
+        >>> r.feed(stream[:5])      # a partial frame yields nothing yet
+        []
+        >>> r.feed(stream[5:])      # completion flushes everything ready
+        [b'one', b'two']
     """
 
     def __init__(self) -> None:

@@ -163,7 +163,14 @@ class ClientEngine:
         self._started = True
 
     def _on_ready(self) -> None:
-        for ev_type, conn, data in self._engine.drain():
+        for _ in range(8):
+            events = self._engine.drain()
+            if not events:
+                return
+            self._dispatch_events(events)
+
+    def _dispatch_events(self, events) -> None:
+        for ev_type, conn, data in events:
             c = self._conns.get(conn)
             if c is None:
                 continue
@@ -283,10 +290,16 @@ class NativeServerTransport:
         self._started = True
 
     def _on_ready(self) -> None:
-        # One batch per callback: the engine re-arms the eventfd when more
-        # events are pending, so the loop gets a chance to run conn workers
-        # between batches instead of queueing unboundedly.
-        events = self._engine.drain()
+        # Bounded batches per callback: enough to amortize the eventfd
+        # round trip, small enough that conn workers still run between
+        # wakeups (the engine re-arms the eventfd when more is pending).
+        for _ in range(8):
+            events = self._engine.drain()
+            if not events:
+                return
+            self._dispatch_events(events)
+
+    def _dispatch_events(self, events) -> None:
         for ev_type, conn, data in events:
             if ev_type == EV_OPENED:
                 state = _ConnState()

@@ -1,0 +1,29 @@
+"""Doctest runner: docstring examples are a first-class test surface
+(mirroring the reference's ``cargo test --doc`` in its justfile)."""
+
+import doctest
+
+import pytest
+
+import rio_tpu.codec
+import rio_tpu.utils.backoff
+import rio_tpu.utils.lru
+
+MODULES = [
+    rio_tpu.codec,
+    rio_tpu.utils.backoff,
+    rio_tpu.utils.lru,
+]
+
+
+@pytest.mark.parametrize("module", MODULES, ids=lambda m: m.__name__)
+def test_module_doctests(module):
+    results = doctest.testmod(module, verbose=False)
+    assert results.failed == 0, f"{module.__name__}: {results.failed} doctest failures"
+
+
+def test_codec_has_doctests():
+    # Guard against silently losing the examples (testmod passes trivially
+    # on a module with zero doctests).
+    results = doctest.testmod(rio_tpu.codec, verbose=False)
+    assert results.attempted >= 4
