@@ -1,28 +1,49 @@
-"""rio-tpu headline benchmark: placements/sec @ 1M objects x 1k nodes.
+"""rio-tpu headline benchmark: placements/sec @ up to 1M objects x 1k nodes.
 
 Compares the TPU placement solve (entropic OT + capacity-aware rounding,
 ``rio_tpu/ops``) against the reference architecture's per-object SQL round
 trip (one SELECT + one INSERT per placement, exactly the queries in
 ``rio-rs/src/object_placement/sqlite.rs:68-100``), measured here through
-Python's C sqlite3 module on the same schema.
+Python's C sqlite3 module on the same schema. Route hops are MEASURED on a
+live 8-server loopback cluster (``rio_tpu/utils/routing_live.py``), not
+simulated.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Robustness design (the round-1 artifact died in backend init, rc=124):
+
+* every jax-touching tier runs in a CHILD process armed with a watchdog
+  thread that ``os._exit``s at a hard deadline — a hung PJRT init through
+  the axon tunnel cannot stall the orchestrator;
+* the child probes ``jax.devices()`` exactly once (its own 120 s timer);
+  an init failure aborts ALL remaining TPU tiers immediately — jax would
+  otherwise re-attempt backend setup per tier, ~25 min each against a
+  wedged relay;
+* if no TPU tier survives, a CPU child (``JAX_PLATFORMS=cpu`` +
+  ``PYTHONPATH=`` to bypass the axon sitecustomize) still produces a
+  number, so the JSON line is printed in every outcome.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sqlite3
+import subprocess
 import sys
+import threading
 import time
-
-import jax
-import jax.numpy as jnp
-from jax import lax
 
 N_NODES = 1024
 CHUNK = 8192  # rows per rounding chunk (bounds rounding memory)
+
+EXIT_INIT_FAIL = 97  # jax backend never came up — do not try more TPU tiers
+EXIT_SOLVE_FAIL = 98  # tier failed (e.g. OOM) — a smaller tier may fit
+EXIT_WATCHDOG = 99  # deadline hit during backend init — treat as wedged
+EXIT_TIER_TIMEOUT = 96  # deadline hit after a healthy probe — smaller tier may fit
+
+PROBE_DEADLINE_S = 120.0
 
 
 def sqlite_baseline_rate(n_samples: int = 5000) -> float:
@@ -52,17 +73,54 @@ def sqlite_baseline_rate(n_samples: int = 5000) -> float:
     return n_samples / (time.perf_counter() - t0)
 
 
-def tpu_solve_rate(n_obj: int) -> tuple[float, int]:
-    """Placements/sec for the on-device OT solve; returns (rate, n_obj used).
+def live_route_hops() -> dict:
+    """p99 route hops measured across real TCP round trips (8 servers)."""
+    import asyncio
+
+    from rio_tpu.utils.routing_live import measure_route_hops_live
+
+    stats = asyncio.run(measure_route_hops_live(n_servers=8, n_objects=2048))
+    ref, ours = stats["reference"], stats["rio_tpu"]
+    print(
+        f"# measured route hops (live 8-server cluster, 2048 objects): "
+        f"ours p99={ours.p99:.0f} mean={ours.mean:.2f} | "
+        f"reference-policy p99={ref.p99:.0f} mean={ref.mean:.2f}",
+        file=sys.stderr,
+    )
+    return {"ours": ours.as_dict(), "reference": ref.as_dict()}
+
+
+# ---------------------------------------------------------------------------
+# Child: one solve tier under a hard watchdog
+# ---------------------------------------------------------------------------
+
+
+def _arm_watchdog(seconds: float, code: int) -> threading.Timer:
+    """Hard in-process deadline: fires even if the main thread is stuck in C."""
+
+    t = threading.Timer(seconds, lambda: os._exit(code))
+    t.daemon = True
+    t.start()
+    return t
+
+
+def _solve_rate(n_obj: int, kernel_dtype) -> tuple[float, float]:
+    """Placements/sec for the on-device OT solve; returns (rate, compile_s).
 
     Uses the scaling-form solver (``rio_tpu/ops/scaling.py``): K = exp(-C/eps)
     is built once and each iteration is two matrix-vector products — no
     per-iteration transcendentals, bandwidth-bound on reading K.
     """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
     from rio_tpu.ops import plan_rounded_assign, scaling_sinkhorn
 
     def step(cost, mass, cap):
-        res = scaling_sinkhorn(cost, mass, cap, eps=0.05, n_iters=30)
+        res = scaling_sinkhorn(
+            cost, mass, cap, eps=0.05, n_iters=30, kernel_dtype=kernel_dtype
+        )
         # Chunk the rounding pass so its softmax/cumsum temps stay bounded.
         n_chunks = cost.shape[0] // CHUNK
         cost_c = cost.reshape(n_chunks, CHUNK, cost.shape[1])
@@ -83,57 +141,244 @@ def tpu_solve_rate(n_obj: int) -> tuple[float, int]:
     cap = jnp.ones((N_NODES,), jnp.float32)
 
     fn = jax.jit(step)
+    t0 = time.perf_counter()
     _, chk = fn(cost, mass, cap)
     float(chk)  # compile + warm
+    compile_s = time.perf_counter() - t0
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
         _, chk = fn(cost, mass, cap)
         float(chk)
         times.append(time.perf_counter() - t0)
-    return n_obj / min(times), n_obj
+    return n_obj / min(times), compile_s
 
 
-def route_hop_summary() -> str:
-    """p99 route hops, simulated for both client policies (BASELINE metric)."""
-    from rio_tpu.utils.routing_sim import simulate_route_hops
+def _pallas_smoke(n_obj: int = 65536) -> dict:
+    """Compile + run the fused Pallas solvers on the real chip.
 
-    stats = simulate_route_hops(n_requests=100_000)
-    ref, ours = stats["reference"], stats["rio_tpu"]
-    print(
-        f"# route hops @1M obj/1k nodes: ours p99={ours.p99} mean={ours.mean:.2f}"
-        f" | reference-policy p99={ref.p99} mean={ref.mean:.2f}",
-        file=sys.stderr,
-    )
-    return f"p99 hops {ours.p99:.0f} vs {ref.p99:.0f}"
+    Returns timings and max |Δ| vs the plain-XLA scaling solver — the
+    on-hardware validation VERDICT flagged (Mosaic lowering failures are
+    invisible in interpret-mode tests).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rio_tpu.ops import scaling_sinkhorn
+    from rio_tpu.ops.pallas_sinkhorn import pallas_sinkhorn
+    from rio_tpu.ops.scaling import pallas_scaling_sinkhorn
+
+    key = jax.random.PRNGKey(7)
+    cost = jax.random.uniform(key, (n_obj, N_NODES), jnp.float32)
+    mass = jnp.ones((n_obj,), jnp.float32)
+    cap = jnp.ones((N_NODES,), jnp.float32)
+    kw = dict(eps=0.05, n_iters=20)
+
+    def timed(fn):
+        res = fn()  # compile + warm
+        jax.block_until_ready((res.f, res.g))
+        t0 = time.perf_counter()
+        res = fn()
+        jax.block_until_ready((res.f, res.g))
+        return res, (time.perf_counter() - t0) * 1e3
+
+    ref, xla_ms = timed(lambda: scaling_sinkhorn(cost, mass, cap, **kw))
+    out: dict = {"n_obj": n_obj, "xla_scaling_ms": round(xla_ms, 2)}
+    for label, fn in (
+        ("pallas_scaling", lambda: pallas_scaling_sinkhorn(
+            cost, mass, cap, interpret=False, **kw)),
+        ("pallas_logdomain", lambda: pallas_sinkhorn(
+            cost, mass, cap, interpret=False, **kw)),
+    ):
+        try:
+            res, ms = timed(fn)
+            g_ref, g = np.asarray(ref.g), np.asarray(res.g)
+            finite = np.isfinite(g_ref) & np.isfinite(g)
+            out[label] = {
+                "ms": round(ms, 2),
+                "max_dg": float(np.max(np.abs(g_ref[finite] - g[finite]))),
+            }
+        except Exception as e:  # record, never fail the tier
+            out[label] = {"error": f"{type(e).__name__}: {e}"}
+    return out
+
+
+def run_tier(n_obj: int, platform: str, deadline: float, pallas_smoke: bool) -> None:
+    """Child entry: probe backend once, run one tier, print JSON result lines.
+
+    The tier result is printed (and flushed) the moment it exists — before
+    the optional pallas smoke — so a hang later in the child can never
+    destroy an already-successful measurement; the parent takes the last
+    parseable line.
+    """
+    start = time.monotonic()
+    init_watchdog = _arm_watchdog(deadline, EXIT_WATCHDOG)
+    probe_timer = _arm_watchdog(min(PROBE_DEADLINE_S, deadline), EXIT_INIT_FAIL)
+
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        devices = jax.devices()
+    except Exception as e:
+        print(f"# backend init failed: {type(e).__name__}: {e}", file=sys.stderr)
+        sys.exit(EXIT_INIT_FAIL)
+    probe_timer.cancel()
+    print(f"# devices: {devices}", file=sys.stderr)
+    if platform == "tpu" and devices[0].platform != "tpu":
+        # The ambient env fell back to CPU silently (e.g. sitecustomize
+        # absent); never record a host run as a TPU number.
+        print(f"# expected tpu, got platform={devices[0].platform}", file=sys.stderr)
+        sys.exit(EXIT_INIT_FAIL)
+    # Probe was healthy: a deadline from here on means "tier too big/slow",
+    # not "backend wedged" — the parent may still try a smaller tier.
+    init_watchdog.cancel()
+    _arm_watchdog(deadline - (time.monotonic() - start), EXIT_TIER_TIMEOUT)
+
+    kernel_dtype = jnp.bfloat16 if platform == "tpu" else jnp.float32
+    try:
+        rate, compile_s = _solve_rate(n_obj, kernel_dtype)
+    except Exception as e:
+        print(f"# tier {n_obj} failed: {type(e).__name__}: {e}", file=sys.stderr)
+        sys.exit(EXIT_SOLVE_FAIL)
+
+    result = {
+        "ok": True,
+        "rate": rate,
+        "n_obj": n_obj,
+        "platform": platform,
+        "device": str(devices[0]),
+        "compile_s": round(compile_s, 2),
+    }
+    print(json.dumps(result), flush=True)
+    remaining = deadline - (time.monotonic() - start)
+    if pallas_smoke and platform == "tpu" and remaining > 150:
+        try:
+            result["pallas"] = _pallas_smoke()
+            print(f"# pallas smoke: {result['pallas']}", file=sys.stderr)
+        except Exception as e:
+            result["pallas"] = {"error": f"{type(e).__name__}: {e}"}
+        print(json.dumps(result), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Orchestrator
+# ---------------------------------------------------------------------------
+
+
+def _run_child(n_obj: int, platform: str, deadline: float, pallas: bool):
+    """Run one tier child; returns (rc, parsed_json_or_None)."""
+    env = os.environ.copy()
+    if platform == "cpu":
+        # Bypass the axon sitecustomize entirely (CLAUDE.md: works even
+        # while the TPU relay is wedged by a killed claim).
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = ""
+    cmd = [
+        sys.executable, os.path.abspath(__file__),
+        "--tier", str(n_obj), "--platform", platform, "--deadline", str(deadline),
+    ]
+    if pallas:
+        cmd.append("--pallas-smoke")
+    try:
+        proc = subprocess.run(
+            cmd, stdout=subprocess.PIPE, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            timeout=deadline + 60,  # backstop; the child's watchdog fires first
+        )
+    except subprocess.TimeoutExpired:
+        print(f"# tier {n_obj}/{platform}: parent backstop timeout", file=sys.stderr)
+        return EXIT_WATCHDOG, None
+    # Take the last parseable result line regardless of exit code: the child
+    # prints the tier result before the pallas smoke, so a smoke hang
+    # (rc=EXIT_TIER_TIMEOUT) still yields a valid measurement.
+    parsed = None
+    for line in proc.stdout.decode(errors="replace").strip().splitlines():
+        try:
+            candidate = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(candidate, dict) and candidate.get("ok"):
+            parsed = candidate
+    return proc.returncode, parsed
 
 
 def main() -> None:
     baseline = sqlite_baseline_rate()
-    hops = route_hop_summary()
-    rate = None
-    for n_obj in (1_048_576, 524_288, 262_144):
-        try:
-            rate, n_used = tpu_solve_rate(n_obj)
+    try:
+        hops = live_route_hops()
+        hop_str = (
+            f"measured p99 hops {hops['ours']['p99']:.0f} "
+            f"vs {hops['reference']['p99']:.0f}"
+        )
+    except Exception as e:
+        print(f"# live hop measurement failed: {e!r}", file=sys.stderr)
+        hops, hop_str = None, "hops unmeasured"
+
+    result = None
+    # TPU tiers, largest first. An init failure or watchdog exit means the
+    # tunnel is down/wedged — retrying would burn ~25 min per attempt in
+    # backend setup (the round-1 failure mode), so abort TPU entirely.
+    for n_obj, deadline in ((1_048_576, 420.0), (524_288, 300.0), (262_144, 240.0)):
+        rc, parsed = _run_child(n_obj, "tpu", deadline, pallas=True)
+        if parsed:
+            result = parsed
             break
-        except Exception as e:  # OOM tier fallback
-            print(f"# {n_obj} failed: {type(e).__name__}: {e}", file=sys.stderr)
-    if rate is None:
-        raise SystemExit("all problem sizes failed")
+        if rc in (EXIT_INIT_FAIL, EXIT_WATCHDOG):
+            print("# TPU backend unavailable; falling back to CPU", file=sys.stderr)
+            break
+        # EXIT_SOLVE_FAIL (OOM) or EXIT_TIER_TIMEOUT (healthy probe, tier
+        # too slow): a smaller tier may still fit the deadline.
+        print(f"# tier {n_obj} rc={rc}; trying smaller tier", file=sys.stderr)
+    if result is None:
+        rc, parsed = _run_child(131_072, "cpu", 300.0, pallas=False)
+        if parsed:
+            result = parsed
+
+    if result is None:
+        # Solve tiers all failed: still emit a real measured number so the
+        # artifact parses — the live hop metric stands on its own.
+        if hops is not None:
+            print(
+                json.dumps(
+                    {
+                        "metric": "p99 route hops (live 8-server cluster, "
+                        "directory policy; solve tiers failed)",
+                        "value": hops["ours"]["p99"],
+                        "unit": "hops",
+                        "vs_baseline": round(
+                            hops["reference"]["p99"] / max(hops["ours"]["p99"], 1e-9), 2
+                        ),
+                    }
+                )
+            )
+            return
+        raise SystemExit("all benchmark tiers failed")
+
     print(
         json.dumps(
             {
                 "metric": (
-                    f"placements/sec (OT solve, {n_used} objects x {N_NODES} nodes; "
-                    f"{hops})"
+                    f"placements/sec (OT solve, {result['n_obj']} objects x "
+                    f"{N_NODES} nodes, {result['platform']}; {hop_str})"
                 ),
-                "value": round(rate, 1),
+                "value": round(result["rate"], 1),
                 "unit": "placements/sec",
-                "vs_baseline": round(rate / baseline, 2),
+                "vs_baseline": round(result["rate"] / baseline, 2),
             }
         )
     )
 
 
 if __name__ == "__main__":
-    main()
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--tier", type=int, default=None)
+    parser.add_argument("--platform", choices=("tpu", "cpu"), default="tpu")
+    parser.add_argument("--deadline", type=float, default=300.0)
+    parser.add_argument("--pallas-smoke", action="store_true")
+    args = parser.parse_args()
+    if args.tier is not None:
+        run_tier(args.tier, args.platform, args.deadline, args.pallas_smoke)
+    else:
+        main()

@@ -13,7 +13,8 @@ import asyncio
 import contextlib
 import logging
 import random
-from typing import Any, AsyncIterator
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Awaitable, Callable
 
 from .. import codec
 from ..cluster.storage import MembershipStorage
@@ -110,10 +111,32 @@ class _ServerConns:
         self.idle.clear()
 
 
+@dataclass
+class ClientStats:
+    """Network-level counters (feeds the measured route-hop metric).
+
+    ``roundtrips`` counts completed request/response exchanges with a
+    server — the "hops" of BASELINE.md's p99-route-hops headline; a
+    redirect costs one extra roundtrip, exactly as in the reference's
+    retry middleware (``client/tower_services.rs:158-209``).
+    """
+
+    requests: int = 0
+    roundtrips: int = 0
+    redirects: int = 0
+
+
 class Client:
     """Send requests to any object in the cluster, from anywhere.
 
     Usually built via :class:`ClientBuilder` or ``Client(members_storage)``.
+
+    ``placement_resolver`` is the rio-tpu routing policy: an async
+    ``(handler_type, handler_id) -> address | None`` consulted on a
+    placement-cache miss *before* falling back to the reference's
+    random-server pick (``client/mod.rs:255-262``). Point it at a shared
+    directory (e.g. ``JaxObjectPlacement.lookup``) and cache-miss requests
+    dial the owner directly — 1 hop instead of a redirect round trip.
     """
 
     def __init__(
@@ -125,10 +148,16 @@ class Client:
         connect_timeout: float = DEFAULT_PING_TIMEOUT,
         backoff: ExponentialBackoff | None = None,
         transport: str = "asyncio",
+        placement_resolver: Callable[[str, str], Awaitable[str | None]] | None = None,
+        membership_view_ttl: float = 1.0,
     ) -> None:
         if transport not in ("asyncio", "native", "auto"):
             raise ValueError(f"unknown transport {transport!r}")
         self.members_storage = members_storage
+        self.stats = ClientStats()
+        self._placement_resolver = placement_resolver
+        self._view_ttl = membership_view_ttl
+        self._view_ts = float("-inf")
         self._placement: LruCache[tuple[str, str], str] = LruCache(placement_cache_size)
         self._conns: dict[str, _ServerConns] = {}
         self._active_servers: list[str] = []
@@ -151,9 +180,16 @@ class Client:
     # -- server/membership view (reference client/mod.rs:153-220) -----------
 
     async def fetch_active_servers(self, refresh: bool = False) -> list[str]:
-        if refresh or not self._active_servers:
+        # TTL'd view: the reference refetches per request and relies on
+        # storage-side caching (client/mod.rs:153-172); we refetch when the
+        # view is older than the TTL so a client that only ever hits one
+        # healthy server still learns about new nodes.
+        loop = asyncio.get_event_loop()
+        stale = (loop.time() - self._view_ts) > self._view_ttl
+        if refresh or stale or not self._active_servers:
             members = await self.members_storage.active_members()
             self._active_servers = [m.address for m in members]
+            self._view_ts = loop.time()
         return self._active_servers
 
     def _pool(self, address: str) -> _ServerConns:
@@ -177,6 +213,13 @@ class Client:
         cached = self._placement.get((handler_type, handler_id))
         if cached is not None:
             return cached
+        if self._placement_resolver is not None:
+            # Directory policy: ask the shared placement directory for the
+            # owner before dialing anyone. A stale/None answer falls through
+            # to the reference policy below; a wrong one costs one redirect.
+            resolved = await self._placement_resolver(handler_type, handler_id)
+            if resolved is not None:
+                return resolved
         servers = await self.fetch_active_servers()
         if not servers:
             servers = await self.fetch_active_servers(refresh=True)
@@ -194,6 +237,7 @@ class Client:
         env = RequestEnvelope(handler_type, handler_id, message_type, payload)
         frame_bytes = encode_request_frame(env)
         key = (handler_type, handler_id)
+        self.stats.requests += 1
         last: BaseException | None = None
         attempts = 0
         for delay in self._backoff.delays():
@@ -202,6 +246,7 @@ class Client:
                 address = await self._pick_address(handler_type, handler_id)
                 async with self._pool(address).acquire() as conn:
                     raw = await conn.roundtrip(frame_bytes)
+                self.stats.roundtrips += 1
             except (ServerNotAvailable, Disconnect, OSError) as e:
                 last = e
                 self._placement.pop(key)
@@ -217,6 +262,7 @@ class Client:
             if err.kind == ErrorKind.REDIRECT:
                 # Authoritative owner elsewhere: note it and retry there
                 # immediately (no backoff — reference tower_services.rs:158-167).
+                self.stats.redirects += 1
                 self._placement.put(key, err.detail)
                 continue
             if err.kind in (ErrorKind.DEALLOCATE, ErrorKind.ALLOCATE):
@@ -378,6 +424,13 @@ class ClientBuilder:
         self._transport = transport
         return self
 
+    def placement_resolver(
+        self, resolver: Callable[[str, str], Awaitable[str | None]]
+    ) -> "ClientBuilder":
+        """Directory routing policy (see :class:`Client`)."""
+        self._resolver = resolver
+        return self
+
     def build(self) -> Client:
         if self._storage is None:
             raise ClientBuilderError("members_storage is required")
@@ -387,4 +440,5 @@ class ClientBuilder:
             pool_per_server=self._pool,
             connect_timeout=self._timeout,
             transport=getattr(self, "_transport", "asyncio"),
+            placement_resolver=getattr(self, "_resolver", None),
         )

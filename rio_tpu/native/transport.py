@@ -268,11 +268,15 @@ class NativeServerTransport:
         if host in ("", "::"):
             host = "0.0.0.0"
         else:
-            # The engine only takes dotted quads; resolve names here so
-            # "localhost" binds loopback instead of erroring (or widening).
+            # The engine only takes dotted quads. ``Server.bind()`` resolves
+            # names asynchronously before constructing us; this fallback only
+            # runs for direct construction off the event loop.
             import socket
 
-            host = socket.gethostbyname(host)
+            try:
+                socket.inet_aton(host)
+            except OSError:
+                host = socket.gethostbyname(host)
         self._engine = Engine(lib, host, port)
         self.port = self._engine.port
         self._conns: dict[int, _ConnState] = {}
@@ -327,6 +331,12 @@ class NativeServerTransport:
                             if state.worker is not None:
                                 state.worker.cancel()
                         else:
+                            # Drop the unserved backlog: the engine closes as
+                            # soon as its write queue drains, so responses for
+                            # these frames would be thrown away — don't burn
+                            # the worker executing them into a dead socket.
+                            while not state.queue.empty():
+                                state.queue.get_nowait()
                             state.queue.put_nowait(None)
                         self._engine.close_conn(conn)
                     else:

@@ -77,7 +77,10 @@ def scaling_sinkhorn(
     # exp(-C/eps) <= 1, so negative costs can't overflow. High-cost pairs
     # may underflow to 0 when (range/eps) >> 88 — acceptable (they are
     # effectively forbidden); for extreme ranges use the log-domain solver.
-    cost = cost - jnp.min(cost)
+    # The shift is folded back into f below so the returned potentials
+    # match the log-domain solver exactly, not just up to gauge.
+    cmin = jnp.min(cost)
+    cost = cost - cmin
     K = jnp.exp(-cost / eps).astype(kernel_dtype)
 
     def body(carry, _):
@@ -94,7 +97,9 @@ def scaling_sinkhorn(
     v0 = jnp.ones_like(b)
     (u, v), _ = lax.scan(body, (u0, v0), None, length=n_iters)
     f, g = _potentials(u, v, eps)
-    return SinkhornResult(f=f, g=g, err=marginal_err(cost, f, g, b, eps))
+    err = marginal_err(cost, f, g, b, eps)  # shifted-cost/shifted-f pair
+    f = jnp.where(jnp.isfinite(f), f + cmin, f)  # undo the gauge shift
+    return SinkhornResult(f=f, g=g, err=err)
 
 
 # ---------------------------------------------------------------------------
@@ -195,7 +200,8 @@ def pallas_scaling_sinkhorn(
     n, m = cost.shape
     cost = cost.astype(jnp.float32)
     a, b = normalize_marginals(row_mass, col_capacity)
-    cost = cost - jnp.min(cost)  # gauge shift; see scaling_sinkhorn
+    cmin = jnp.min(cost)
+    cost = cost - cmin  # gauge shift, folded back into f; see scaling_sinkhorn
     K = jnp.exp(-cost / eps).astype(kernel_dtype)
 
     lane = 128
@@ -220,4 +226,6 @@ def pallas_scaling_sinkhorn(
     (u, v), _ = lax.scan(body, (u0, v0), None, length=n_iters)
 
     f, g = _potentials(u[:n], v[:m], eps)
-    return SinkhornResult(f=f, g=g, err=marginal_err(cost, f, g, b, eps))
+    err = marginal_err(cost, f, g, b, eps)
+    f = jnp.where(jnp.isfinite(f), f + cmin, f)
+    return SinkhornResult(f=f, g=g, err=err)

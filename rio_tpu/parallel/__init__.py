@@ -187,7 +187,7 @@ def sharded_scaling_sinkhorn(
         u0 = lax.pcast(jnp.zeros(c.shape[0], jnp.float32), ("obj",), to="varying")
         v0 = lax.pcast(jnp.ones(c.shape[1], jnp.float32), ("node",), to="varying")
         (u, v), _ = lax.scan(body, (u0, v0), None, length=n_iters)
-        f = jnp.where(u > 0, eps * jnp.log(jnp.maximum(u, 1e-30)), -jnp.inf)
+        f = jnp.where(u > 0, eps * jnp.log(jnp.maximum(u, 1e-30)) + cmin, -jnp.inf)
         g = jnp.where(v > 0, eps * jnp.log(jnp.maximum(v, 1e-30)), -jnp.inf)
         return f, g
 

@@ -114,7 +114,10 @@ class ResponseEnvelope:
 
     def to_bytes(self) -> bytes:
         if self.error is None:
-            return codec.serialize([True, self.body])
+            # None normalizes to bin0 (not nil) so asyncio and native servers
+            # emit byte-identical frames (native has no nil entry point; both
+            # decoders already normalize to b"").
+            return codec.serialize([True, self.body or b""])
         return codec.serialize(
             [False, [int(self.error.kind), self.error.detail, self.error.payload]]
         )

@@ -34,6 +34,27 @@ from .service_object import LifecycleKind, LifecycleMessage
 log = logging.getLogger("rio_tpu.server")
 
 
+def _routable_host() -> str:
+    """Discover the host's outbound-routable IPv4 address.
+
+    The UDP-connect trick (the reference resolves its advertised address via
+    netwatch, ``server.rs:155-168``): ``connect`` on a datagram socket makes
+    the kernel pick the egress interface without sending a packet, and
+    ``getsockname`` reads the chosen source address. Falls back to loopback
+    when the host has no route at all.
+    """
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
 class Server:
     """A node hosting service objects.
 
@@ -63,10 +84,16 @@ class Server:
         app_data: AppData | None = None,
         http_members_address: str | None = None,
         transport: str = "asyncio",
+        advertise_address: str | None = None,
     ) -> None:
         if transport not in ("asyncio", "native", "auto"):
             raise ValueError(f"unknown transport {transport!r}")
         self.requested_address = address
+        # Explicit override for what goes into membership storage —
+        # "host" or "host:port" (port 0/absent keeps the bound port). NAT'd
+        # and multi-homed deployments set this; everyone else gets the
+        # discovered routable address (reference server.rs:155-168).
+        self.advertise_address = advertise_address
         self.registry = registry
         self.cluster_provider = cluster_provider
         self.object_placement = object_placement_provider
@@ -128,22 +155,49 @@ class Server:
         host, _, port = self.requested_address.rpartition(":")
         host = host or "0.0.0.0"
         if self._resolve_transport() == "native":
+            import socket as _socket
+
             from .native.transport import NativeServerTransport
 
+            if host not in ("", "::", "0.0.0.0"):
+                # The engine takes dotted quads only; resolve names here,
+                # asynchronously — a blocking gethostbyname inside the
+                # transport ctor would stall every coroutine on a slow
+                # resolver (the asyncio path resolves async in start_server).
+                try:
+                    _socket.inet_aton(host)
+                except OSError:
+                    infos = await asyncio.get_running_loop().getaddrinfo(
+                        host, None, family=_socket.AF_INET, type=_socket.SOCK_STREAM
+                    )
+                    host = infos[0][4][0]
             self._native_transport = NativeServerTransport(
                 self._service, host, int(port)
             )
-            bound_host = "127.0.0.1" if host in ("0.0.0.0", "::") else host
-            self._local_addr = f"{bound_host}:{self._native_transport.port}"
+            bound_host, bound_port = host, self._native_transport.port
         else:
             self._listener = await asyncio.start_server(self._accept, host, int(port))
             sock = self._listener.sockets[0]
             bound_host, bound_port = sock.getsockname()[:2]
-            if bound_host in ("0.0.0.0", "::"):
-                bound_host = "127.0.0.1"
-            self._local_addr = f"{bound_host}:{bound_port}"
+        self._local_addr = self._advertised(bound_host, bound_port)
         self.app_data.set(ServerInfo(self._local_addr))
         return self._local_addr
+
+    def _advertised(self, bound_host: str, bound_port: int) -> str:
+        """The address written to membership storage and used for redirects.
+
+        A wildcard bind advertises the discovered routable address — never
+        ``0.0.0.0`` (unconnectable) and never a blind ``127.0.0.1`` rewrite
+        (which would advertise loopback into a multi-host cluster).
+        """
+        if self.advertise_address:
+            h, sep, p = self.advertise_address.rpartition(":")
+            if not sep:
+                h, p = self.advertise_address, "0"
+            return f"{h}:{int(p) or bound_port}"
+        if bound_host in ("0.0.0.0", "::", ""):
+            bound_host = _routable_host()
+        return f"{bound_host}:{bound_port}"
 
     def _service(self) -> Service:
         return Service(

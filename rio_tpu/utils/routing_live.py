@@ -1,0 +1,140 @@
+"""Measured route hops on a live in-process cluster.
+
+The numpy model in :mod:`rio_tpu.utils.routing_sim` *estimates* the
+BASELINE route-hop headline; this module *measures* it: boot N real
+servers on ephemeral loopback ports inside one event loop (the reference's
+integration harness shape, ``rio-rs/tests/client_server_integration_test.rs:
+153-180`` / ``tests/server_utils.rs:49-139``), pre-allocate a population of
+objects, then drive one cold-cache request per object under each routing
+policy and count actual network round trips via :class:`rio_tpu.client.
+ClientStats`:
+
+* **reference policy** — random active server on placement-cache miss
+  (``client/mod.rs:255-262``); a wrong pick costs a real ``Redirect``
+  response plus a second round trip.
+* **rio-tpu policy** — ``placement_resolver`` pointed at the shared
+  placement directory (the :class:`JaxObjectPlacement` host mirror in
+  production); the owner is dialed directly.
+
+Every hop counted here crossed a real TCP socket and the full
+encode/dispatch/decode path — no simulation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random as _random
+from dataclasses import dataclass
+
+from .. import AppData, Client, LocalObjectPlacement, LocalStorage, Registry, Server
+from .. import ServiceObject, handler, message
+from ..cluster.membership_protocol import LocalClusterProvider
+from ..registry import ObjectId
+
+
+@message(name="routing_live.Echo")
+class Echo:
+    value: int = 0
+
+
+class EchoActor(ServiceObject):
+    """Minimal actor: the request path is the thing under test."""
+
+    @handler
+    async def echo(self, msg: Echo, ctx: AppData) -> Echo:
+        return msg
+
+
+@dataclass
+class LiveHopStats:
+    mean: float
+    p50: float
+    p99: float
+    n_requests: int
+
+    def as_dict(self) -> dict:
+        return {
+            "mean": round(self.mean, 3),
+            "p50": self.p50,
+            "p99": self.p99,
+            "n": self.n_requests,
+        }
+
+
+def _stats(hops: list[int]) -> LiveHopStats:
+    s = sorted(hops)
+    n = len(s)
+    return LiveHopStats(
+        mean=sum(s) / n,
+        p50=float(s[n // 2]),
+        p99=float(s[min(n - 1, (n * 99) // 100)]),
+        n_requests=n,
+    )
+
+
+async def measure_route_hops_live(
+    *,
+    n_servers: int = 8,
+    n_objects: int = 1024,
+    seed: int = 0,
+    transport: str = "asyncio",
+) -> dict[str, LiveHopStats]:
+    """Boot a cluster, measure per-request hops under both client policies.
+
+    Returns ``{"reference": LiveHopStats, "rio_tpu": LiveHopStats}``. Each
+    object is requested exactly once per policy with a cold placement LRU,
+    so every request exercises the cache-miss routing decision — the case
+    the policies differ on.
+    """
+    members = LocalStorage()
+    placement = LocalObjectPlacement()
+    servers: list[Server] = []
+    for _ in range(n_servers):
+        s = Server(
+            address="127.0.0.1:0",
+            registry=Registry().add_type(EchoActor),
+            cluster_provider=LocalClusterProvider(members),
+            object_placement_provider=placement,
+            transport=transport,
+        )
+        await s.prepare()
+        await s.bind()
+        servers.append(s)
+    tasks = [asyncio.create_task(s.run()) for s in servers]
+    try:
+        deadline = asyncio.get_event_loop().time() + 10.0
+        while asyncio.get_event_loop().time() < deadline:
+            if len(await members.active_members()) >= n_servers:
+                break
+            await asyncio.sleep(0.02)
+
+        ids = [f"obj-{i}" for i in range(n_objects)]
+        # Warm-up pass: allocate every object somewhere (random landing →
+        # near-uniform spread, like organic traffic would produce).
+        setup = Client(members)
+        for oid in ids:
+            await setup.send(EchoActor, oid, Echo(value=1), returns=Echo)
+        setup.close()
+
+        async def directory_resolver(handler_type: str, handler_id: str) -> str | None:
+            return await placement.lookup(ObjectId(handler_type, handler_id))
+
+        async def run_policy(resolver) -> LiveHopStats:
+            client = Client(members, placement_resolver=resolver)
+            order = list(ids)
+            _random.Random(seed).shuffle(order)
+            hops: list[int] = []
+            for oid in order:
+                before = client.stats.roundtrips
+                await client.send(EchoActor, oid, Echo(value=2), returns=Echo)
+                hops.append(client.stats.roundtrips - before)
+            client.close()
+            return _stats(hops)
+
+        reference = await run_policy(None)
+        ours = await run_policy(directory_resolver)
+        return {"reference": reference, "rio_tpu": ours}
+    finally:
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)

@@ -50,6 +50,10 @@ def test_response_frame_parity():
     assert codec.frame(err.to_bytes()) == lib.encode_response_err_frame(
         5, b"MyErr", b"errbytes"
     )
+    # body=None normalizes to bin0 so both encoders emit identical bytes.
+    none_body = protocol.ResponseEnvelope.ok(None)
+    assert codec.frame(none_body.to_bytes()) == lib.encode_response_ok_frame(b"")
+    assert protocol.ResponseEnvelope.from_bytes(none_body.to_bytes()).body == b""
     # Decoders agree with the Python ones.
     assert lib.decode_response(ok.to_bytes()) == (True, b"hello")
     assert lib.decode_response(err.to_bytes()) == (False, 5, b"MyErr", b"errbytes")

@@ -41,6 +41,48 @@ def test_scaling_matches_log_domain(n, m):
     np.testing.assert_allclose(np.asarray(out.g), np.asarray(ref.g), rtol=1e-3, atol=1e-3)
 
 
+def test_scaling_matches_log_domain_offset_costs():
+    """f-parity must survive costs with a negative / shifted minimum.
+
+    The scaling solvers gauge-shift by min(cost) internally; the shift must
+    be folded back into f (the hierarchical mode's normalized -(feat@feat)
+    costs have a negative min, where an unshifted f would diverge from the
+    log-domain reference by -min(cost))."""
+    cost, mass, cap = _problem(jax.random.PRNGKey(7), 64, 96)
+    cost = cost * 2.0 - 1.7  # min well below zero
+    ref = sinkhorn(cost, mass, cap, eps=0.08, n_iters=25)
+    for solver in (
+        lambda: scaling_sinkhorn(
+            cost, mass, cap, eps=0.08, n_iters=25, kernel_dtype=jnp.float32
+        ),
+        lambda: pallas_scaling_sinkhorn(
+            cost, mass, cap, eps=0.08, n_iters=25,
+            kernel_dtype=jnp.float32, block_rows=16,
+        ),
+    ):
+        out = solver()
+        np.testing.assert_allclose(
+            np.asarray(out.f), np.asarray(ref.f), rtol=1e-3, atol=1e-3
+        )
+        np.testing.assert_allclose(
+            np.asarray(out.g), np.asarray(ref.g), rtol=1e-3, atol=1e-3
+        )
+
+
+def test_sharded_scaling_offset_costs_f_parity():
+    from rio_tpu.parallel import make_mesh, sharded_scaling_sinkhorn
+
+    mesh = make_mesh(jax.devices()[:8])
+    cost, mass, cap = _problem(jax.random.PRNGKey(8), 64, 96)
+    cost = cost - 0.9
+    ref = sinkhorn(cost, mass, cap, eps=0.08, n_iters=25)
+    f, g = sharded_scaling_sinkhorn(
+        mesh, cost, mass, cap, eps=0.08, n_iters=25, kernel_dtype=jnp.float32
+    )
+    np.testing.assert_allclose(np.asarray(f), np.asarray(ref.f), rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(ref.g), rtol=1e-3, atol=1e-3)
+
+
 def test_scaling_dead_nodes_and_padding():
     cost, mass, cap = _problem(jax.random.PRNGKey(1), 48, 96, dead_nodes=3, padded_rows=5)
     ref = sinkhorn(cost, mass, cap, eps=0.06, n_iters=30)
