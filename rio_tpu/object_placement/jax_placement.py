@@ -113,13 +113,25 @@ class JaxObjectPlacement(ObjectPlacement):
         mode: str = "sinkhorn",
         mesh=None,
         node_axis_size: int = 64,
+        move_cost: float = 0.5,
     ) -> None:
         self._eps = eps
         self._n_iters = n_iters
         self._mode = mode
         self._mesh = mesh
+        # Stay-put discount applied to each object's CURRENT seat during a
+        # full re-solve: a move costs a state reload + cold cache at the
+        # application layer, so the objective must price it. With
+        # move_cost/eps >> 1 the soft plan concentrates on the current seat
+        # unless capacity (dead nodes, skew) forces a move — a churn
+        # re-solve then moves ~the displaced share, not a global reshuffle.
+        self._move_cost = move_cost
         # Host-mirrored directory: "{type}.{id}" -> node index.
         self._placements: dict[str, int] = {}
+        # Per-node key index (node index -> keys): keeps clean_server and
+        # load recounts O(objects-on-node), the same reason the Redis
+        # backend keeps a per-server set (object_placement/redis.py).
+        self._by_node: dict[int, set[str]] = {}
         self._nodes: dict[str, _NodeSlot] = {}
         self._node_order: list[str] = []  # index -> address (never shrinks)
         self._node_axis = node_axis_size  # static node axis (padded)
@@ -127,6 +139,27 @@ class JaxObjectPlacement(ObjectPlacement):
         self._g: jax.Array | None = None  # cached node potentials (padded axis)
         self._lock = asyncio.Lock()
         self.stats = SolveStats()
+
+    # ------------------------------------------------- directory internals
+    def _set_placement(self, key: str, idx: int) -> bool:
+        """Point ``key`` at node ``idx`` keeping the per-node index in sync.
+
+        Returns True when the placement actually changed (lock held).
+        """
+        old = self._placements.get(key)
+        if old == idx:
+            return False
+        if old is not None:
+            self._by_node.get(old, set()).discard(key)
+        self._placements[key] = idx
+        self._by_node.setdefault(idx, set()).add(key)
+        return True
+
+    def _drop_placement(self, key: str) -> int | None:
+        idx = self._placements.pop(key, None)
+        if idx is not None:
+            self._by_node.get(idx, set()).discard(key)
+        return idx
 
     # ---------------------------------------------------------------- nodes
     def _node_index(self, address: str) -> int:
@@ -196,19 +229,16 @@ class JaxObjectPlacement(ObjectPlacement):
 
     def _recount_loads(self) -> None:
         for s in self._nodes.values():
-            s.load = 0.0
-        for idx in self._placements.values():
-            if idx < len(self._node_order):
-                self._nodes[self._node_order[idx]].load += 1.0
+            s.load = float(len(self._by_node.get(s.index, ())))
 
     # ------------------------------------------------------ trait: lookups
     async def update(self, item: ObjectPlacementItem) -> None:
         key = str(item.object_id)
         async with self._lock:
             if item.server_address is None:
-                self._placements.pop(key, None)
+                self._drop_placement(key)
             else:
-                self._placements[key] = self._node_index(item.server_address)
+                self._set_placement(key, self._node_index(item.server_address))
             self._epoch += 1
 
     async def lookup(self, object_id: ObjectId) -> str | None:
@@ -225,15 +255,16 @@ class JaxObjectPlacement(ObjectPlacement):
                 return
             slot.alive = False
             slot.load = 0.0  # its placements are gone; keep fair-share math honest
-            stale = [k for k, v in self._placements.items() if v == slot.index]
-            for k in stale:
-                del self._placements[k]
+            # O(objects-on-node) via the per-node index — a full-directory
+            # scan here would be a multi-second GIL stall at the 10M tier.
+            for k in self._by_node.pop(slot.index, set()):
+                self._placements.pop(k, None)
             self._epoch += 1
             self._g = None
 
     async def remove(self, object_id: ObjectId) -> None:
         async with self._lock:
-            if self._placements.pop(str(object_id), None) is not None:
+            if self._drop_placement(str(object_id)) is not None:
                 self._epoch += 1
 
     def count(self) -> int:
@@ -281,7 +312,7 @@ class JaxObjectPlacement(ObjectPlacement):
             greedy_balanced_assign(rows, mass, cap * alive, load)
         )[:n]
         for k, idx in zip(keys, assignment.tolist()):
-            self._placements[k] = int(idx)
+            self._set_placement(k, int(idx))
             self._nodes[self._node_order[idx]].load += 1.0
         self._epoch += 1
 
@@ -362,6 +393,9 @@ class JaxObjectPlacement(ObjectPlacement):
         mode = mode or self._mode
         async with self._lock:
             keys = list(self._placements.keys())
+            cur_idx = np.fromiter(
+                (self._placements[k] for k in keys), np.int32, count=len(keys)
+            )
             snapshot_epoch = self._epoch
             self._recount_loads()
             load, cap, alive = self._node_vectors()
@@ -388,6 +422,15 @@ class JaxObjectPlacement(ObjectPlacement):
                 else:
                     base_cost = build_cost_matrix(jnp.zeros_like(load), cap, alive)
                     cost = jnp.broadcast_to(base_cost, (bucket, base_cost.shape[1]))
+                    if self._move_cost > 0:
+                        # Stay-put discount on each object's current seat: a
+                        # re-solve must pay move_cost to relocate an object,
+                        # so only capacity pressure (dead nodes, skew) moves
+                        # anything. Discounts on dead seats are inert — the
+                        # dead column is already priced at DEAD_NODE_COST.
+                        cost = cost.at[jnp.arange(n), jnp.asarray(cur_idx)].add(
+                            -self._move_cost
+                        )
                     mass = jnp.concatenate(
                         [jnp.ones((n,), jnp.float32), jnp.zeros((bucket - n,), jnp.float32)]
                     )
@@ -430,8 +473,7 @@ class JaxObjectPlacement(ObjectPlacement):
                 return 0
             moved = 0
             for k, idx in zip(keys, assignment.tolist()):
-                if self._placements.get(k) != int(idx):
-                    self._placements[k] = int(idx)
+                if self._set_placement(k, int(idx)):
                     moved += 1
             if g is not None:
                 self._g = g

@@ -1,0 +1,133 @@
+"""In-process DBAPI fake standing in for ``psycopg`` (cf. fake_redis.py).
+
+The environment ships no PostgreSQL driver or server, but dead code is
+worse than a fake: this module lets the REAL Postgres backends
+(``rio_tpu/{cluster/storage,object_placement,state}/postgres.py``) and the
+REAL ``PgDb`` helper execute their full logic in the default suite — DSN
+connection handling, the ``?``→``%s`` paramstyle translation (translated
+back to qmark here, so a broken translation produces broken SQL and fails
+loudly), the cursor context-manager protocol, ``description``-gated
+fetches, commit/rollback, and the thread bridge — everything above the PG
+wire protocol itself. The SQL dialect the backends use (``ON CONFLICT …
+DO UPDATE``, ``DOUBLE PRECISION``) is executed by sqlite, which accepts
+both.
+
+Usage::
+
+    from tests.fake_pg import install
+    install()           # registers this module as `psycopg`
+    PgDb("postgresql://fake/db")   # resolves the fake driver
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import sys
+import threading
+
+# One shared sqlite engine per DSN, so multiple "connections" to the same
+# DSN see the same data (the backend matrix shares one DSN across the
+# membership/placement/state providers, like a real database would).
+_ENGINES: dict[str, sqlite3.Connection] = {}
+_ENGINES_LOCK = threading.Lock()
+_EXEC_LOCK = threading.RLock()  # serialize all statements on the shared engine
+
+
+class Error(Exception):
+    """DBAPI base error (psycopg.Error stand-in)."""
+
+
+def _qmark(sql: str) -> str:
+    """``%s`` placeholders → ``?`` (outside string literals) for sqlite."""
+    out: list[str] = []
+    in_str = False
+    i = 0
+    while i < len(sql):
+        ch = sql[i]
+        if ch == "'":
+            in_str = not in_str
+        if not in_str and ch == "%" and sql[i + 1 : i + 2] == "s":
+            out.append("?")
+            i += 2
+            continue
+        out.append(ch)
+        i += 1
+    return "".join(out)
+
+
+class FakeCursor:
+    def __init__(self, engine: sqlite3.Connection) -> None:
+        self._cur = engine.cursor()
+
+    def __enter__(self) -> "FakeCursor":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._cur.close()
+
+    def execute(self, sql: str, params=()) -> None:
+        with _EXEC_LOCK:
+            try:
+                self._cur.execute(_qmark(sql), tuple(params or ()))
+            except sqlite3.Error as e:
+                raise Error(str(e)) from e
+
+    @property
+    def description(self):
+        return self._cur.description
+
+    def fetchall(self):
+        with _EXEC_LOCK:
+            return self._cur.fetchall()
+
+
+class FakeConnection:
+    def __init__(self, dsn: str) -> None:
+        with _ENGINES_LOCK:
+            engine = _ENGINES.get(dsn)
+            if engine is None:
+                # check_same_thread=False: PgDb drives us via
+                # asyncio.to_thread, and the default executor rotates threads.
+                engine = sqlite3.connect(":memory:", check_same_thread=False)
+                _ENGINES[dsn] = engine
+        self._engine = engine
+        self.closed = False
+
+    def cursor(self) -> FakeCursor:
+        if self.closed:
+            raise Error("connection is closed")
+        return FakeCursor(self._engine)
+
+    def commit(self) -> None:
+        with _EXEC_LOCK:
+            self._engine.commit()
+
+    def rollback(self) -> None:
+        with _EXEC_LOCK:
+            self._engine.rollback()
+
+    def close(self) -> None:
+        # Keep the shared engine alive for other connections to the DSN.
+        self.closed = True
+
+
+def connect(dsn: str) -> FakeConnection:
+    return FakeConnection(dsn)
+
+
+def reset() -> None:
+    """Drop all fake databases (test isolation)."""
+    with _ENGINES_LOCK:
+        for engine in _ENGINES.values():
+            engine.close()
+        _ENGINES.clear()
+
+
+def install() -> None:
+    """Register this module as ``psycopg`` so ``PgDb`` discovers it.
+
+    Overwrites any previously-imported real driver: the caller only
+    installs the fake when it wants the fake (e.g. a real psycopg exists
+    but no server DSN is configured — resolving the real driver would dial
+    the bogus fake DSN and error instead of running the fake)."""
+    sys.modules["psycopg"] = sys.modules[__name__]

@@ -207,17 +207,50 @@ PG_DSN = os.environ.get("RIO_TPU_PG_DSN", "")
 
 @pytest.mark.asyncio
 async def test_postgres_backends():
+    """Full backend matrix against a real server when RIO_TPU_PG_DSN is set,
+    otherwise against the in-process DBAPI fake (tests/fake_pg.py) — the
+    Postgres query logic, paramstyle translation, and thread bridge execute
+    either way (reference rigor bar: .config/nextest.toml runs real PG in CI).
+    """
     from rio_tpu.utils.pg import driver_available
 
+    dsn = PG_DSN
     if not driver_available() or not PG_DSN:
-        pytest.skip("no PostgreSQL driver/server (set RIO_TPU_PG_DSN)")
+        from tests import fake_pg
+
+        fake_pg.install()
+        fake_pg.reset()
+        dsn = "postgresql://fake-pg/backends"
     from rio_tpu.cluster.storage.postgres import PostgresMembershipStorage
     from rio_tpu.object_placement.postgres import PostgresObjectPlacement
     from rio_tpu.state.postgres import PostgresState
 
-    await check_membership(PostgresMembershipStorage(PG_DSN))
-    await check_placement(PostgresObjectPlacement(PG_DSN))
-    await check_state(PostgresState(PG_DSN))
+    await check_membership(PostgresMembershipStorage(dsn))
+    await check_placement(PostgresObjectPlacement(dsn))
+    await check_state(PostgresState(dsn))
+
+
+@pytest.mark.asyncio
+async def test_pg_db_recovers_from_failed_statement():
+    """A failed statement must roll back and leave the connection usable
+    (PgDb._recover — psycopg otherwise raises InFailedSqlTransaction on
+    every later query)."""
+    from tests import fake_pg
+
+    fake_pg.install()
+    fake_pg.reset()
+    from rio_tpu.utils.pg import PgDb
+
+    db = PgDb("postgresql://fake-pg/recovery")
+    await db.migrate(["CREATE TABLE t (a INTEGER PRIMARY KEY)"])
+    await db.execute("INSERT INTO t (a) VALUES (?)", 1)
+    with pytest.raises(Exception):
+        await db.execute("INSERT INTO nonexistent (a) VALUES (?)", 2)
+    # Connection still works after the failure.
+    await db.execute("INSERT INTO t (a) VALUES (?)", 3)
+    rows = await db.execute("SELECT a FROM t ORDER BY a")
+    assert rows == [(1,), (3,)]
+    db.close()
 
 
 def test_pg_paramstyle_translation():

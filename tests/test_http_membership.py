@@ -1,0 +1,88 @@
+"""HTTP membership end-to-end: credential-less cluster join.
+
+Reference: ``rio-rs/src/cluster/storage/http.rs:35-150`` — a server exposes
+the read-only members API (wired via ``http_members_address``,
+``server.rs:205-229``) and a client joins the cluster through
+``HttpMembershipStorage`` with no database credentials; every write op on
+that storage fails with the read-only error.
+"""
+
+import asyncio
+import socket
+
+import pytest
+
+from rio_tpu import (
+    Client,
+    LocalObjectPlacement,
+    LocalStorage,
+    Registry,
+    Server,
+)
+from rio_tpu.cluster.membership_protocol import LocalClusterProvider
+from rio_tpu.cluster.storage import Member
+from rio_tpu.cluster.storage.http import HttpMembershipStorage
+from rio_tpu.errors import MembershipReadOnly
+from rio_tpu.utils.routing_live import Echo, EchoActor
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.asyncio
+async def test_http_membership_end_to_end():
+    members = LocalStorage()
+    http_port = _free_port()
+    server = Server(
+        address="127.0.0.1:0",
+        registry=Registry().add_type(EchoActor),
+        cluster_provider=LocalClusterProvider(members),
+        object_placement_provider=LocalObjectPlacement(),
+        http_members_address=f"127.0.0.1:{http_port}",
+    )
+    await server.prepare()
+    await server.bind()
+    task = asyncio.create_task(server.run())
+    try:
+        http_members = HttpMembershipStorage(f"127.0.0.1:{http_port}")
+        # Wait until the API is up AND the node registered itself active.
+        deadline = asyncio.get_event_loop().time() + 10.0
+        while asyncio.get_event_loop().time() < deadline:
+            try:
+                if await http_members.active_members():
+                    break
+            except Exception:
+                pass
+            await asyncio.sleep(0.05)
+        listed = await http_members.members()
+        assert [m.address for m in listed] == [server.local_address]
+
+        # A client built purely on the HTTP view completes a round trip.
+        client = Client(http_members)
+        out = await client.send(EchoActor, "h1", Echo(value=41), returns=Echo)
+        assert out.value == 41
+        client.close()
+
+        # Single-member endpoint (GET /members/{ip}/{port}).
+        ip, _, port = server.local_address.rpartition(":")
+        one = await http_members._get(f"/members/{ip}/{port}")
+        assert one is not None and one["ip"] == ip and one["port"] == int(port)
+        assert await http_members._get("/members/10.9.9.9/1") is None  # 404
+
+        # Write surface is read-only by design (reference http.rs:85-150).
+        with pytest.raises(MembershipReadOnly):
+            await http_members.push(Member.from_address("10.0.0.9:1"))
+        with pytest.raises(MembershipReadOnly):
+            await http_members.remove("10.0.0.9", 1)
+        with pytest.raises(MembershipReadOnly):
+            await http_members.set_is_active("10.0.0.9", 1, True)
+        with pytest.raises(MembershipReadOnly):
+            await http_members.notify_failure("10.0.0.9", 1)
+    finally:
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
