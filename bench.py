@@ -304,8 +304,29 @@ def _run_child(n_obj: int, platform: str, deadline: float, pallas: bool):
     return proc.returncode, parsed
 
 
+def rpc_throughput() -> None:
+    """Actor data-plane msgs/sec, asyncio vs native transport (stderr only)."""
+    import asyncio
+
+    from rio_tpu import native
+    from rio_tpu.utils.routing_live import measure_rpc_throughput
+
+    transports = ["asyncio"] + (["native"] if native.get() is not None else [])
+    for transport in transports:
+        rate = asyncio.run(measure_rpc_throughput(transport=transport))
+        print(
+            f"# rpc throughput ({transport}, 2 servers, 64 workers): "
+            f"{rate:,.0f} msgs/sec",
+            file=sys.stderr,
+        )
+
+
 def main() -> None:
     baseline = sqlite_baseline_rate()
+    try:
+        rpc_throughput()
+    except Exception as e:
+        print(f"# rpc throughput failed: {e!r}", file=sys.stderr)
     try:
         hops = live_route_hops()
         hop_str = (

@@ -41,10 +41,40 @@ MAX_FRAME = 8 * 1024 * 1024  # tokio LengthDelimitedCodec default max frame
 # ---------------------------------------------------------------------------
 
 
+# Per-dataclass schema cache: (field name, resolved type hint) in declaration
+# order. ``typing.get_type_hints`` re-compiles stringified annotations on
+# EVERY call (PEP 563 + ``from __future__ import annotations``) — uncached it
+# was ~25% of the request path's CPU.
+_DC_SCHEMA: dict[type, tuple[tuple[str, Any], ...]] = {}
+
+
+def _dc_schema(ty: type) -> tuple[tuple[str, Any], ...]:
+    schema = _DC_SCHEMA.get(ty)
+    if schema is None:
+        hints = get_type_hints(ty)
+        schema = tuple((f.name, hints.get(f.name, Any)) for f in dataclasses.fields(ty))
+        _DC_SCHEMA[ty] = schema
+    return schema
+
+
+# Encode-side cache: field NAMES only. Encoding never needs resolved hints,
+# and get_type_hints raises on annotations that only resolve under
+# TYPE_CHECKING — a dataclass like that must still serialize fine.
+_DC_FIELD_NAMES: dict[type, tuple[str, ...]] = {}
+
+
+def _dc_field_names(ty: type) -> tuple[str, ...]:
+    names = _DC_FIELD_NAMES.get(ty)
+    if names is None:
+        names = tuple(f.name for f in dataclasses.fields(ty))
+        _DC_FIELD_NAMES[ty] = names
+    return names
+
+
 def _to_wire(value: Any) -> Any:
     """Lower a Python value to msgpack-encodable primitives."""
     if dataclasses.is_dataclass(value) and not isinstance(value, type):
-        return [_to_wire(getattr(value, f.name)) for f in dataclasses.fields(value)]
+        return [_to_wire(getattr(value, name)) for name in _dc_field_names(type(value))]
     if isinstance(value, Enum):
         return value.value
     if isinstance(value, (list, tuple)):
@@ -115,15 +145,13 @@ def _from_wire(wire: Any, ty: Any) -> Any:
     if dataclasses.is_dataclass(ty):
         if not isinstance(wire, (list, tuple)):
             raise SerializationError(f"expected array for dataclass {ty.__name__}")
-        hints = get_type_hints(ty)
-        fields = dataclasses.fields(ty)
-        if len(wire) > len(fields):
+        schema = _dc_schema(ty)
+        if len(wire) > len(schema):
             raise SerializationError(
-                f"{ty.__name__}: wire has {len(wire)} fields, schema has {len(fields)}"
+                f"{ty.__name__}: wire has {len(wire)} fields, schema has {len(schema)}"
             )
         kwargs = {
-            f.name: _from_wire(v, hints.get(f.name, Any))
-            for f, v in zip(fields, wire)
+            name: _from_wire(v, hint) for (name, hint), v in zip(schema, wire)
         }
         return ty(**kwargs)
     if ty is float and isinstance(wire, int):
@@ -166,7 +194,7 @@ def _json_key(key: Any) -> str:
 
 def _to_json(value: Any) -> Any:
     if dataclasses.is_dataclass(value) and not isinstance(value, type):
-        return {f.name: _to_json(getattr(value, f.name)) for f in dataclasses.fields(value)}
+        return {name: _to_json(getattr(value, name)) for name in _dc_field_names(type(value))}
     if isinstance(value, Enum):
         return value.value
     if isinstance(value, (list, tuple, set, frozenset)):
@@ -226,9 +254,8 @@ def _from_json(wire: Any, ty: Any) -> Any:
                 continue
         raise SerializationError(f"no Union arm of {ty} matched JSON value")
     if dataclasses.is_dataclass(ty) and isinstance(wire, dict):
-        hints = get_type_hints(ty)
-        fields = {f.name for f in dataclasses.fields(ty)}
-        unknown = set(wire) - fields
+        hints = dict(_dc_schema(ty))
+        unknown = set(wire) - set(hints)
         if unknown:
             raise SerializationError(f"{ty.__name__}: unknown state fields {unknown}")
         try:

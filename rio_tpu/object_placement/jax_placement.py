@@ -61,6 +61,86 @@ def _hash_features(keys: list[str], dim: int = _FEAT_DIM) -> jax.Array:
     )
 
 
+class AffinityTracker:
+    """Turns observed traffic into placement features for hierarchical mode.
+
+    The two-level solver scores ``affinity[i, j] = obj_feat[i] @ node_feat
+    [:, j]``; this tracker makes that product mean something: each node gets
+    a stable embedding, and each object's feature is a request-weighted EMA
+    of the embeddings of nodes that served it (cache warmth / state
+    locality), so the OT objective pulls an object toward where its state
+    is hot — while the capacity marginals still enforce balance. The
+    reference has no counterpart (placement there is a random pick,
+    ``client/mod.rs:255-262``); this is the hook VERDICT flagged as missing
+    from the hierarchical mode.
+
+    Wire it up::
+
+        tracker = AffinityTracker()
+        placement = JaxObjectPlacement(
+            mode="hierarchical",
+            obj_features=tracker.obj_features,
+            node_features=tracker.node_features,
+        )
+        ...
+        tracker.observe(str(object_id), serving_address, weight=1.0)
+    """
+
+    def __init__(self, dim: int = _FEAT_DIM, stickiness: float = 0.75) -> None:
+        self.dim = dim
+        # EMA coefficient toward the serving node's embedding; 1.0 pins an
+        # object to its last server, 0.0 disables learning.
+        self.stickiness = stickiness
+        self._obj: dict[str, np.ndarray] = {}
+        self._node_cache: dict[str, np.ndarray] = {}
+
+    def _node_vec(self, address: str) -> np.ndarray:
+        vec = self._node_cache.get(address)
+        if vec is None:
+            vec = np.asarray(_hash_features([address], self.dim))[0]
+            vec = vec / max(float(np.linalg.norm(vec)), 1e-9)
+            self._node_cache[address] = vec
+        return vec
+
+    def observe(self, key: str, node_address: str, weight: float = 1.0) -> None:
+        """Record that ``key`` was served by ``node_address``.
+
+        ``weight`` scales the pull (e.g. request count since last observe,
+        or bytes of state touched)."""
+        alpha = min(1.0, self.stickiness * weight)
+        if alpha <= 0.0:
+            return
+        target = self._node_vec(node_address)
+        cur = self._obj.get(key)
+        if cur is None:
+            # Cold object: blend from the same weak hashed-identity base
+            # obj_features() would have used, so a low-weight stray request
+            # nudges rather than fully re-homes it.
+            cur = np.asarray(_hash_features([key], self.dim), np.float32)[0] * 0.1
+        # Atomic swap (never mutate in place): the solver thread reads
+        # self._obj concurrently via obj_features() during a rebalance.
+        new = (1.0 - alpha) * cur + alpha * target
+        norm = float(np.linalg.norm(new))
+        if norm > 1e-9:
+            new = new / norm
+        self._obj[key] = new
+
+    def obj_features(self, keys: list[str]) -> np.ndarray:
+        """(n, dim) features: learned EMA, hashed-identity for cold objects."""
+        out = np.asarray(_hash_features(keys, self.dim), np.float32) * 0.1
+        for i, k in enumerate(keys):
+            vec = self._obj.get(k)
+            if vec is not None:
+                out[i] = vec
+        return out
+
+    def node_features(self, addresses: list[str]) -> np.ndarray:
+        """(m, dim) embeddings matching what ``observe`` pulled toward."""
+        if not addresses:
+            return np.zeros((0, self.dim), np.float32)
+        return np.stack([self._node_vec(a) for a in addresses]).astype(np.float32)
+
+
 def _profiler_trace(name: str):
     """jax.profiler annotation for solver steps (SURVEY §5.1); no-op off-JAX."""
     import contextlib
@@ -114,6 +194,8 @@ class JaxObjectPlacement(ObjectPlacement):
         mesh=None,
         node_axis_size: int = 64,
         move_cost: float = 0.5,
+        obj_features=None,
+        node_features=None,
     ) -> None:
         self._eps = eps
         self._n_iters = n_iters
@@ -126,6 +208,13 @@ class JaxObjectPlacement(ObjectPlacement):
         # unless capacity (dead nodes, skew) forces a move — a churn
         # re-solve then moves ~the displaced share, not a global reshuffle.
         self._move_cost = move_cost
+        # Hierarchical-mode feature hooks: callables (keys/addresses ->
+        # (n, d) ndarray). Default is hashed identity — a deterministic
+        # balancing proxy; plug an AffinityTracker (or anything encoding
+        # state size / cache warmth / request rate) to make the OT affinity
+        # term carry real locality signal.
+        self._obj_features = obj_features or _hash_features
+        self._node_features = node_features or _hash_features
         # Host-mirrored directory: "{type}.{id}" -> node index.
         self._placements: dict[str, int] = {}
         # Per-node key index (node index -> keys): keeps clean_server and
@@ -348,10 +437,15 @@ class JaxObjectPlacement(ObjectPlacement):
         n = len(keys)
         bucket_sz = max(8, -(-int(1.3 * n * float(share)) // 8) * 8)
 
-        obj_feat = _hash_features(keys)
-        node_feat = np.zeros((_FEAT_DIM, m), np.float32)
+        obj_feat = np.asarray(self._obj_features(keys), np.float32)
+        d_feat = obj_feat.shape[1]
+        node_feat = np.zeros((d_feat, m), np.float32)
         if node_order:
-            node_feat[:, : len(node_order)] = np.asarray(_hash_features(node_order)).T
+            nf = np.asarray(self._node_features(node_order), np.float32)
+            assert nf.shape[1] == d_feat, (
+                f"node feature dim {nf.shape[1]} != object feature dim {d_feat}"
+            )
+            node_feat[:, : len(node_order)] = nf.T
         kw = dict(
             n_groups=n_groups,
             bucket=min(bucket_sz, n),
@@ -369,7 +463,7 @@ class JaxObjectPlacement(ObjectPlacement):
             n_pad = -(-n // n_shards) * n_shards
             if n_pad != n:
                 obj_feat = jnp.concatenate(
-                    [obj_feat, jnp.zeros((n_pad - n, _FEAT_DIM), jnp.float32)]
+                    [obj_feat, jnp.zeros((n_pad - n, d_feat), jnp.float32)]
                 )
             res = sharded_hierarchical_assign(
                 self._mesh, obj_feat, jnp.asarray(node_feat),

@@ -72,6 +72,40 @@ def _stats(hops: list[int]) -> LiveHopStats:
     )
 
 
+async def boot_echo_cluster(
+    n_servers: int,
+    *,
+    transport: str = "asyncio",
+    placement=None,
+):
+    """Boot N echo servers on loopback; returns (members, placement, tasks).
+
+    Shared helper for the measured benchmarks (route hops, RPC throughput).
+    Callers cancel the returned tasks to tear the cluster down.
+    """
+    members = LocalStorage()
+    placement = placement if placement is not None else LocalObjectPlacement()
+    servers: list[Server] = []
+    for _ in range(n_servers):
+        s = Server(
+            address="127.0.0.1:0",
+            registry=Registry().add_type(EchoActor),
+            cluster_provider=LocalClusterProvider(members),
+            object_placement_provider=placement,
+            transport=transport,
+        )
+        await s.prepare()
+        await s.bind()
+        servers.append(s)
+    tasks = [asyncio.create_task(s.run()) for s in servers]
+    deadline = asyncio.get_event_loop().time() + 10.0
+    while asyncio.get_event_loop().time() < deadline:
+        if len(await members.active_members()) >= n_servers:
+            break
+        await asyncio.sleep(0.02)
+    return members, placement, tasks
+
+
 async def measure_route_hops_live(
     *,
     n_servers: int = 8,
@@ -86,28 +120,10 @@ async def measure_route_hops_live(
     so every request exercises the cache-miss routing decision — the case
     the policies differ on.
     """
-    members = LocalStorage()
-    placement = LocalObjectPlacement()
-    servers: list[Server] = []
-    for _ in range(n_servers):
-        s = Server(
-            address="127.0.0.1:0",
-            registry=Registry().add_type(EchoActor),
-            cluster_provider=LocalClusterProvider(members),
-            object_placement_provider=placement,
-            transport=transport,
-        )
-        await s.prepare()
-        await s.bind()
-        servers.append(s)
-    tasks = [asyncio.create_task(s.run()) for s in servers]
+    members, placement, tasks = await boot_echo_cluster(
+        n_servers, transport=transport
+    )
     try:
-        deadline = asyncio.get_event_loop().time() + 10.0
-        while asyncio.get_event_loop().time() < deadline:
-            if len(await members.active_members()) >= n_servers:
-                break
-            await asyncio.sleep(0.02)
-
         ids = [f"obj-{i}" for i in range(n_objects)]
         # Warm-up pass: allocate every object somewhere (random landing →
         # near-uniform spread, like organic traffic would produce).
@@ -135,6 +151,52 @@ async def measure_route_hops_live(
         ours = await run_policy(directory_resolver)
         return {"reference": reference, "rio_tpu": ours}
     finally:
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+
+async def measure_rpc_throughput(
+    *,
+    n_servers: int = 2,
+    n_workers: int = 64,
+    requests_per_worker: int = 400,
+    n_objects: int = 1024,
+    transport: str = "asyncio",
+) -> float:
+    """Messages/sec through the full actor data plane (real TCP loopback).
+
+    ``n_workers`` concurrent senders share one client (per-address
+    connection pool) and round-robin over ``n_objects`` actors — the shape
+    of the reference's only load artifact, the metric-aggregator 20k-send
+    driver (``metric_aggregator_loadall.rs:26-37``), but concurrent.
+    ``transport`` selects the asyncio or the native (C++ epoll) data plane
+    on both servers and client.
+    """
+    import time
+
+    members, _placement, tasks = await boot_echo_cluster(
+        n_servers, transport=transport
+    )
+    client = Client(members, transport=transport)
+    try:
+        # Warm: allocate the whole actor population (placement + activation
+        # out of the timed region) and fill the connection pools.
+        for i in range(n_objects):
+            await client.send(EchoActor, f"w{i}", Echo(value=i), returns=Echo)
+
+        total = n_workers * requests_per_worker
+
+        async def worker(w: int) -> None:
+            for r in range(requests_per_worker):
+                oid = f"w{(w * requests_per_worker + r) % n_objects}"
+                await client.send(EchoActor, oid, Echo(value=r), returns=Echo)
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*[worker(w) for w in range(n_workers)])
+        return total / (time.perf_counter() - t0)
+    finally:
+        client.close()
         for t in tasks:
             t.cancel()
         await asyncio.gather(*tasks, return_exceptions=True)
