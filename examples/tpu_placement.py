@@ -1,0 +1,144 @@
+"""tpu-placement: the flagship JaxObjectPlacement provider, live.
+
+No counterpart in the reference — its placement is a random pick plus
+row-by-row SQL (``rio-rs/src/client/mod.rs:255-262``,
+``object_placement/sqlite.rs:68-100``). This demo boots a real cluster on
+the TPU-native directory and shows the three behaviors that replace it:
+
+1. **Directory routing** — clients resolve the owner from the host-mirrored
+   directory before dialing: 1 network hop, no redirect round trip.
+2. **Churn-aware re-solve** — kill a node; a full OT re-solve moves ONLY
+   the displaced objects (stay-put discount), not a global reshuffle.
+3. **Affinity** — an AffinityTracker feeds observed traffic into the
+   hierarchical solver's feature hooks, pulling objects back to the nodes
+   that served them (cache warmth) while capacity keeps load balanced.
+
+Runs on CPU out of the box (JAX_PLATFORMS=cpu); the same code jit-compiles
+the solve onto a TPU when one is attached::
+
+    python examples/tpu_placement.py
+"""
+
+import asyncio
+import sys
+
+sys.path.insert(0, ".")  # run from repo root without installing
+
+from rio_tpu import (
+    AppData,
+    Client,
+    LocalStorage,
+    ObjectId,
+    Registry,
+    Server,
+    ServiceObject,
+    handler,
+    message,
+)
+from rio_tpu.cluster.membership_protocol import LocalClusterProvider
+from rio_tpu.commands import AdminCommand
+from rio_tpu.object_placement.jax_placement import AffinityTracker, JaxObjectPlacement
+
+N_SERVERS = 5
+N_OBJECTS = 200
+
+
+@message
+class Hit:
+    n: int = 0
+
+
+@message
+class HitCount:
+    n: int = 0
+    server: str = ""
+
+
+class CounterActor(ServiceObject):
+    def __init__(self):
+        self.hits = 0
+
+    @handler
+    async def hit(self, msg: Hit, ctx: AppData) -> HitCount:
+        from rio_tpu import ServerInfo
+
+        self.hits += msg.n
+        return HitCount(n=self.hits, server=ctx.get(ServerInfo).address)
+
+
+async def main() -> None:
+    members = LocalStorage()
+    tracker = AffinityTracker(dim=32)
+    # Hierarchical mode is the one that consumes the feature hooks — the
+    # tracker's observed-traffic affinity steers the 2-level OT solve.
+    placement = JaxObjectPlacement(
+        mode="hierarchical",
+        n_iters=20,
+        obj_features=tracker.obj_features,
+        node_features=tracker.node_features,
+    )
+
+    servers: list[Server] = []
+    for _ in range(N_SERVERS):
+        s = Server(
+            address="127.0.0.1:0",
+            registry=Registry().add_type(CounterActor),
+            cluster_provider=LocalClusterProvider(members),
+            object_placement_provider=placement,
+        )
+        await s.prepare()
+        await s.bind()
+        servers.append(s)
+    tasks = [asyncio.create_task(s.run()) for s in servers]
+    await asyncio.sleep(0.3)
+    placement.sync_members(await members.active_members())
+
+    # Directory-routing client: resolve the owner before dialing.
+    client = Client(
+        members,
+        placement_resolver=lambda t, i: placement.lookup(ObjectId(t, i)),
+    )
+
+    print(f"[demo] driving {N_OBJECTS} actors over {N_SERVERS} servers")
+    for i in range(N_OBJECTS):
+        out = await client.send(CounterActor, f"c{i}", Hit(n=1), returns=HitCount)
+        tracker.observe(f"CounterActor.c{i}", out.server)
+    print(
+        f"[demo] {client.stats.requests} requests took "
+        f"{client.stats.roundtrips} hops ({client.stats.redirects} redirects)"
+    )
+
+    # Kill a node; gossip marks it dead; re-solve moves only its objects.
+    victim = servers[0]
+    print(f"[demo] killing {victim.local_address}")
+    victim.admin_sender().queue.put_nowait(AdminCommand.server_exit())
+    await asyncio.sleep(0.3)
+    host, _, port = victim.local_address.rpartition(":")
+    await members.set_inactive(host, int(port))
+    placement.sync_members(await members.members())
+    moved = await placement.rebalance()
+    print(
+        f"[demo] re-solve in {placement.stats.solve_ms:.1f} ms: moved {moved} "
+        f"of {placement.stats.n_objects} objects (only the displaced share)"
+    )
+
+    # Every actor still answers, state intact where the node survived.
+    survivors = 0
+    for i in range(N_OBJECTS):
+        out = await client.send(CounterActor, f"c{i}", Hit(n=1), returns=HitCount)
+        if out.n == 2:
+            survivors += 1
+    print(
+        f"[demo] all {N_OBJECTS} actors reachable after churn; "
+        f"{survivors} kept in-memory state (rest re-materialized)"
+    )
+
+    client.close()
+    for t in tasks:
+        t.cancel()
+    await asyncio.gather(*tasks, return_exceptions=True)
+    print("[demo] done")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -213,6 +213,13 @@ class JaxObjectPlacement(ObjectPlacement):
         # balancing proxy; plug an AffinityTracker (or anything encoding
         # state size / cache warmth / request rate) to make the OT affinity
         # term carry real locality signal.
+        if (obj_features or node_features) and mode != "hierarchical":
+            # Flat modes build per-node costs only and would silently
+            # ignore the hooks — fail at construction, not at solve time.
+            raise ValueError(
+                "obj_features/node_features are only consumed by "
+                f'mode="hierarchical" (got mode={mode!r})'
+            )
         self._obj_features = obj_features or _hash_features
         self._node_features = node_features or _hash_features
         # Host-mirrored directory: "{type}.{id}" -> node index.

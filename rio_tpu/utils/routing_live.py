@@ -86,23 +86,32 @@ async def boot_echo_cluster(
     members = LocalStorage()
     placement = placement if placement is not None else LocalObjectPlacement()
     servers: list[Server] = []
-    for _ in range(n_servers):
-        s = Server(
-            address="127.0.0.1:0",
-            registry=Registry().add_type(EchoActor),
-            cluster_provider=LocalClusterProvider(members),
-            object_placement_provider=placement,
-            transport=transport,
-        )
-        await s.prepare()
-        await s.bind()
-        servers.append(s)
-    tasks = [asyncio.create_task(s.run()) for s in servers]
-    deadline = asyncio.get_event_loop().time() + 10.0
-    while asyncio.get_event_loop().time() < deadline:
-        if len(await members.active_members()) >= n_servers:
-            break
-        await asyncio.sleep(0.02)
+    tasks: list[asyncio.Task] = []
+    try:
+        for _ in range(n_servers):
+            s = Server(
+                address="127.0.0.1:0",
+                registry=Registry().add_type(EchoActor),
+                cluster_provider=LocalClusterProvider(members),
+                object_placement_provider=placement,
+                transport=transport,
+            )
+            await s.prepare()
+            await s.bind()
+            servers.append(s)
+        tasks = [asyncio.create_task(s.run()) for s in servers]
+        deadline = asyncio.get_event_loop().time() + 10.0
+        while asyncio.get_event_loop().time() < deadline:
+            if len(await members.active_members()) >= n_servers:
+                break
+            await asyncio.sleep(0.02)
+    except BaseException:
+        # Boot failed or was cancelled mid-wait: never leak running
+        # server tasks (the caller's finally hasn't been entered yet).
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        raise
     return members, placement, tasks
 
 
