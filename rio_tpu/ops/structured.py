@@ -1,0 +1,99 @@
+"""Class-collapsed rebalance solve: exact Sinkhorn at O(M^2), not O(N*M).
+
+The framework's own full-rebalance cost model (``JaxObjectPlacement``) is
+
+    cost[i, j] = base[j] - move_cost * [j == cur_i]
+
+— a per-node vector broadcast plus a stay-put discount on the current
+seat. Every object with the same current seat therefore has an IDENTICAL
+cost row, and Sinkhorn's row updates depend on rows only through their
+values: the (N objects x M nodes) solve collapses *exactly* to an
+(M classes x M nodes) solve with row masses equal to the per-seat object
+counts. N drops out of the device problem entirely:
+
+* solve: O(M^2) per iteration (1k x 1k is ~1M cells — microseconds on the
+  MXU, trivially within BASELINE.md's <50 ms class for ANY N);
+* apply: integer per-class quotas (largest-remainder rounding, exact row
+  sums) then an O(N) host scatter that keeps ``quota[k, k]`` objects in
+  place — objects within a class are interchangeable, so keeping any
+  ``quota_kk`` of them is the move-minimal application.
+
+The dense solvers (:mod:`rio_tpu.ops.sinkhorn`, :mod:`rio_tpu.ops.scaling`)
+remain the general path for per-object costs (hierarchical affinity
+features, external cost matrices); this module is the fast path the
+directory uses when no per-object signal exists. The reference has no
+counterpart at all — its "rebalance" is never (placement is
+write-once-until-death row-by-row SQL, ``object_placement/sqlite.rs``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .sinkhorn import sinkhorn
+
+__all__ = ["class_quotas"]
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "n_iters"))
+def class_quotas(
+    base_cost: jax.Array,
+    counts: jax.Array,
+    col_capacity: jax.Array,
+    *,
+    move_cost: float = 0.5,
+    eps: float = 0.05,
+    n_iters: int = 30,
+) -> tuple[jax.Array, jax.Array]:
+    """Integer per-class quotas for the collapsed rebalance problem.
+
+    Args:
+      base_cost: (M,) per-node cost (load/liveness pricing; dead nodes at
+        ``DEAD_NODE_COST``).
+      counts: (M,) objects currently seated on each node class (float32 or
+        int; class k = "objects whose current seat is node k").
+      col_capacity: (M,) effective capacity (0 for dead nodes).
+      move_cost: stay-put discount applied on the diagonal.
+
+    Returns:
+      (quotas, g): quotas is (M, M) int32 where ``quotas[k, j]`` objects of
+      class k should end on node j — every row sums EXACTLY to
+      ``counts[k]``; ``g`` is the (M,) node potential from the class solve
+      (seed for the incremental warm-start path).
+    """
+    m = base_cost.shape[0]
+    counts = counts.astype(jnp.float32)
+    cost = jnp.broadcast_to(base_cost.astype(jnp.float32)[None, :], (m, m))
+    cost = cost - move_cost * jnp.eye(m, dtype=jnp.float32)
+    res = sinkhorn(cost, counts, col_capacity, eps=eps, n_iters=n_iters)
+
+    # Soft plan row-conditionals: P[k, :] / a_k (finite rows only).
+    logit = (res.f[:, None] + res.g[None, :] - cost) / eps
+    live_row = jnp.isfinite(res.f)
+    logit = jnp.where(live_row[:, None], logit, -jnp.inf)
+    frac = jax.nn.softmax(logit, axis=1)
+    frac = jnp.where(live_row[:, None], frac, 0.0)
+    # Belt-and-braces: zero out dead columns (their g is already -inf, but
+    # largest-remainder must never hand a stray unit to a dead node) and
+    # renormalize live rows.
+    frac = jnp.where((col_capacity > 0)[None, :], frac, 0.0)
+    frac = frac / jnp.maximum(jnp.sum(frac, axis=1, keepdims=True), 1e-30)
+    frac = jnp.where(live_row[:, None], frac, 0.0)
+
+    # Largest-remainder rounding to exact integer row sums.
+    target = frac * counts[:, None]
+    base = jnp.floor(target)
+    short = (counts - jnp.sum(base, axis=1)).astype(jnp.int32)  # (M,)
+    remainder = target - base
+    # Rank remainders descending per row (rank[k, j] = position of column j
+    # in row k's descending-remainder order); give one extra unit to the
+    # top ``short[k]`` columns of each row.
+    order = jnp.argsort(-remainder, axis=1)
+    rank = jnp.zeros_like(order).at[
+        jnp.arange(m)[:, None], order
+    ].set(jnp.broadcast_to(jnp.arange(m)[None, :], (m, m)))
+    quotas = (base + (rank < short[:, None])).astype(jnp.int32)
+    return quotas, res.g
