@@ -314,9 +314,12 @@ def rpc_throughput() -> None:
     transports = ["asyncio"] + (["native"] if native.get() is not None else [])
     for transport in transports:
         rate = asyncio.run(measure_rpc_throughput(transport=transport))
+        note = ""
+        if transport == "native" and not native.engine_profitable():
+            note = " (engine demoted: single-core host, thread handoff is pure loss)"
         print(
             f"# rpc throughput ({transport}, 2 servers, 64 workers): "
-            f"{rate:,.0f} msgs/sec",
+            f"{rate:,.0f} msgs/sec{note}",
             file=sys.stderr,
         )
 

@@ -28,9 +28,7 @@ from ..errors import (
 from ..protocol import (
     ErrorKind,
     RequestEnvelope,
-    ResponseEnvelope,
     SubscriptionRequest,
-    SubscriptionResponse,
     decode_response,
     decode_subresponse,
     encode_request_frame,
@@ -46,69 +44,86 @@ DEFAULT_PLACEMENT_LRU = 1000  # reference client/mod.rs:137
 DEFAULT_POOL_PER_SERVER = 8
 
 
-class _Conn:
-    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
-        self.reader = reader
-        self.writer = writer
-
-    def close(self) -> None:
-        with contextlib.suppress(Exception):
-            self.writer.close()
-
-    async def roundtrip(self, frame_bytes: bytes) -> bytes:
-        self.writer.write(frame_bytes)
-        await self.writer.drain()
-        payload = await codec.read_frame(self.reader)
-        if payload is None:
-            raise Disconnect("connection closed mid-request")
-        return payload
-
-
 class _ServerConns:
-    """Bounded pool of framed connections to one server address.
+    """Multiplexed bundle of framed connections to one server address.
 
-    With a native :class:`rio_tpu.native.transport.ClientEngine`, sockets
-    and framing live on the engine's IO thread; otherwise asyncio streams.
+    Both transports (:class:`rio_tpu.aio.ClientConnProtocol` and the native
+    :class:`rio_tpu.native.transport.NativeClientConn`) support pipelining —
+    several in-flight requests per socket, responses matched FIFO (the
+    server answers each connection in order). The pool therefore keeps up to
+    ``limit`` sockets and up to ``PIPELINE_DEPTH`` in-flight requests per
+    socket; ``acquire`` prefers an idle socket, dials a new one while under
+    ``limit``, and only then stacks requests onto the least-loaded socket.
+
+    ``acquire``/``release`` are explicit methods, not a context manager —
+    the request path runs tens of thousands of times a second and an
+    ``@asynccontextmanager`` generator per request was measurable.
     """
+
+    PIPELINE_DEPTH = 16
 
     def __init__(self, address: str, limit: int, timeout: float, engine=None) -> None:
         self.address = address
-        self.limit = limit
+        self.limit = max(1, limit)
         self.timeout = timeout
         self.engine = engine
-        self.idle: list = []
-        self.sem = asyncio.Semaphore(limit)
+        self.conns: list = []
+        self.sem = asyncio.Semaphore(self.limit * self.PIPELINE_DEPTH)
+        self._dialing = 0
+        self._rr = 0
 
     async def _connect(self):
         host, _, port = self.address.rpartition(":")
         if self.engine is not None:
             return await self.engine.connect(host, int(port), self.timeout)
+        from .. import aio
+
         try:
-            reader, writer = await asyncio.wait_for(
-                asyncio.open_connection(host, int(port)), self.timeout
-            )
+            return await aio.connect(host, int(port), self.timeout)
         except (OSError, asyncio.TimeoutError) as e:
             raise ServerNotAvailable(f"{self.address}: {e}") from e
-        return _Conn(reader, writer)
 
-    @contextlib.asynccontextmanager
     async def acquire(self):
-        async with self.sem:
-            conn = self.idle.pop() if self.idle else await self._connect()
-            ok = False
+        await self.sem.acquire()
+        try:
+            conns = self.conns
+            n = len(conns)
+            if n:
+                # Round-robin over open sockets (cheaper than a least-loaded
+                # scan at tens of thousands of acquires/sec); dial a fresh
+                # socket only while under ``limit`` and the pick is busy.
+                self._rr += 1
+                conn = conns[self._rr % n]
+                if conn.closed:
+                    self.conns = conns = [c for c in conns if not c.closed]
+                    n = len(conns)
+                    conn = conns[self._rr % n] if n else None
+                if conn is not None and (
+                    conn.pending == 0 or n + self._dialing >= self.limit
+                ):
+                    return conn
+            self._dialing += 1
             try:
-                yield conn
-                ok = True
+                conn = await self._connect()
             finally:
-                if ok:
-                    self.idle.append(conn)
-                else:
-                    conn.close()
+                self._dialing -= 1
+            self.conns.append(conn)
+            return conn
+        except BaseException:
+            self.sem.release()
+            raise
+
+    def release(self, conn, *, reuse: bool) -> None:
+        if not reuse:
+            conn.close()
+            with contextlib.suppress(ValueError):
+                self.conns.remove(conn)
+        self.sem.release()
 
     def close(self) -> None:
-        for c in self.idle:
+        for c in self.conns:
             c.close()
-        self.idle.clear()
+        self.conns.clear()
 
 
 @dataclass
@@ -170,7 +185,7 @@ class Client:
 
         lib = _native.get()
         self._client_engine = None
-        if transport == "native" or (transport == "auto" and lib is not None):
+        if transport == "native" or (transport == "auto" and _native.engine_profitable()):
             from ..native.transport import ClientEngine
 
             # Request and subscription connections ride the engine's IO
@@ -244,8 +259,14 @@ class Client:
             attempts += 1
             try:
                 address = await self._pick_address(handler_type, handler_id)
-                async with self._pool(address).acquire() as conn:
+                pool = self._pool(address)
+                conn = await pool.acquire()
+                try:
                     raw = await conn.roundtrip(frame_bytes)
+                except BaseException:
+                    pool.release(conn, reuse=False)
+                    raise
+                pool.release(conn, reuse=True)
                 self.stats.roundtrips += 1
             except (ServerNotAvailable, Disconnect, OSError) as e:
                 last = e
@@ -311,24 +332,15 @@ class Client:
                         conn = await self._client_engine.connect(
                             host, int(port), self._connect_timeout
                         )
-                        write_frame = conn.write
-                        next_frame = conn.read_frame
-                        close = conn.close
                     else:
-                        reader, writer = await asyncio.wait_for(
-                            asyncio.open_connection(host, int(port)),
-                            self._connect_timeout,
+                        from .. import aio
+
+                        conn = await aio.connect(
+                            host, int(port), self._connect_timeout
                         )
-
-                        def write_frame(b, _w=writer):
-                            _w.write(b)
-
-                        def next_frame(_r=reader):
-                            return codec.read_frame(_r)
-
-                        def close(_w=writer):
-                            _w.close()
-
+                    write_frame = conn.write
+                    next_frame = conn.read_frame
+                    close = conn.close
                 except (OSError, asyncio.TimeoutError, ServerNotAvailable) as e:
                     attempt += 1
                     if attempt > self._backoff.max_retries:

@@ -88,6 +88,23 @@ def _to_wire(value: Any) -> Any:
     raise SerializationError(f"cannot serialize value of type {type(value)!r}")
 
 
+def _pack_default(value: Any) -> Any:
+    """``msgpack.packb`` hook for the node types msgpack can't pack itself.
+
+    The C packer walks primitives/lists/dicts natively and only calls back
+    here for dataclass / Enum / set nodes, so a request-sized message costs
+    one ``packb`` call instead of a Python-recursive ``_to_wire`` walk
+    (which was the top line of the request-path profile).
+    """
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        return [getattr(value, name) for name in _dc_field_names(type(value))]
+    if isinstance(value, Enum):
+        return value.value
+    if isinstance(value, (set, frozenset)):
+        return sorted(value)
+    raise SerializationError(f"cannot serialize value of type {type(value)!r}")
+
+
 def serialize(value: Any) -> bytes:
     """Encode ``value`` (dataclass, primitive, or container) to bytes.
 
@@ -107,7 +124,7 @@ def serialize(value: Any) -> bytes:
         [1, 'two', b'3']
     """
     try:
-        return msgpack.packb(_to_wire(value), use_bin_type=True)
+        return msgpack.packb(value, use_bin_type=True, default=_pack_default)
     except (TypeError, ValueError, msgpack.exceptions.PackException) as e:
         raise SerializationError(str(e)) from e
 
@@ -146,6 +163,12 @@ def _from_wire(wire: Any, ty: Any) -> Any:
         if not isinstance(wire, (list, tuple)):
             raise SerializationError(f"expected array for dataclass {ty.__name__}")
         schema = _dc_schema(ty)
+        if len(wire) == len(schema):
+            # Exact-arity case → compiled decoder (its own fallback only
+            # fires on arity mismatch, so this cannot recurse).
+            dec = _dc_decoder(ty)
+            if dec is not None:
+                return dec(wire)
         if len(wire) > len(schema):
             raise SerializationError(
                 f"{ty.__name__}: wire has {len(wire)} fields, schema has {len(schema)}"
@@ -163,12 +186,93 @@ def _from_wire(wire: Any, ty: Any) -> Any:
     return wire
 
 
+# ---------------------------------------------------------------------------
+# Compiled per-dataclass decoders.  ``_from_wire`` is a generic recursive
+# walker; for the hot path (every request deserializes its message dataclass
+# and envelope) we code-generate a flat positional decoder per dataclass —
+# the same trick the ``dataclasses`` module uses for ``__init__``.  Semantics
+# match ``_from_wire`` exactly; shape mismatches fall back to the generic
+# walker (which also carries the schema-evolution rules).
+# ---------------------------------------------------------------------------
+
+_DC_DECODERS: dict[type, Any] = {}  # type -> decoder fn, or None (ineligible)
+
+
+def _compile_dc_decoder(ty: type):
+    """Build a positional decoder for ``ty``; None when ineligible."""
+    if any(not f.init or f.kw_only for f in dataclasses.fields(ty)):
+        return None  # generic path passes kwargs; keep it for exotic shapes
+    try:
+        schema = _dc_schema(ty)
+    except Exception:  # unresolvable hints (TYPE_CHECKING-only imports)
+        return None
+    ns: dict[str, Any] = {
+        "_ty": ty,
+        "_SE": SerializationError,
+        "_fw": _from_wire,
+        "_isinstance": isinstance,
+    }
+    lines = [
+        "def _dec(w):",
+        f"    if len(w) != {len(schema)}:",
+        "        return _fw(w, _ty)",  # schema evolution / arity errors
+    ]
+    args = []
+    for i, (name, hint) in enumerate(schema):
+        v = f"v{i}"
+        args.append(v)
+        if hint is Any or hint is None or hint is _NONE_TYPE:
+            lines.append(f"    {v} = w[{i}]")
+        elif hint in (int, str, bool):
+            ns[f"_h{i}"] = hint
+            lines.append(f"    {v} = w[{i}]")
+            lines.append(
+                f"    if not _isinstance({v}, _h{i}):"
+                f" raise _SE('expected {hint.__name__}, got %s' % type({v}).__name__)"
+            )
+        elif hint is float:
+            lines.append(f"    {v} = w[{i}]")
+            lines.append(f"    if _isinstance({v}, int): {v} = float({v})")
+            lines.append(
+                f"    elif not _isinstance({v}, float):"
+                f" raise _SE('expected float, got %s' % type({v}).__name__)"
+            )
+        elif hint is bytes:
+            lines.append(f"    {v} = w[{i}]")
+            lines.append(
+                f"    if not _isinstance({v}, bytes):\n"
+                f"        if _isinstance({v}, str): {v} = {v}.encode()\n"
+                f"        else: raise _SE('expected bytes, got %s' % type({v}).__name__)"
+            )
+        else:  # nested dataclass / container / union / enum → generic walker
+            ns[f"_h{i}"] = hint
+            lines.append(f"    {v} = _fw(w[{i}], _h{i})")
+    lines.append(f"    return _ty({', '.join(args)})")
+    exec("\n".join(lines), ns)  # noqa: S102 — trusted, schema-derived source
+    return ns["_dec"]
+
+
+def _dc_decoder(ty: type):
+    try:
+        return _DC_DECODERS[ty]
+    except KeyError:
+        dec = _compile_dc_decoder(ty)
+        _DC_DECODERS[ty] = dec
+        return dec
+
+
 def deserialize(data: bytes, ty: Any) -> Any:
     """Decode bytes produced by :func:`serialize` into an instance of ``ty``."""
     try:
         wire = msgpack.unpackb(data, raw=False, strict_map_key=False)
     except (ValueError, msgpack.exceptions.UnpackException) as e:
         raise SerializationError(str(e)) from e
+    if isinstance(ty, type) and dataclasses.is_dataclass(ty):
+        dec = _dc_decoder(ty)
+        if dec is not None:
+            if not isinstance(wire, (list, tuple)):
+                raise SerializationError(f"expected array for dataclass {ty.__name__}")
+            return dec(wire)
     return _from_wire(wire, ty)
 
 

@@ -18,6 +18,7 @@ from __future__ import annotations
 import asyncio
 import ctypes
 import logging
+from collections import deque
 from typing import TYPE_CHECKING, Callable
 
 from ..message_router import MessageRouter
@@ -39,6 +40,7 @@ log = logging.getLogger("rio_tpu.native.transport")
 
 _DRAIN_BATCH = 256
 _MAX_PENDING_FRAMES = 1024  # per-conn cap (reference relies on TCP backpressure)
+_MAX_CONCURRENT = 64  # per-conn in-flight handler cap (matches aio transport)
 _MAX_WRITE_BACKLOG = 1 << 20  # pause subscription pumps past 1 MiB unsent
 
 
@@ -108,14 +110,36 @@ class NativeClientConn:
         self._frames: asyncio.Queue[bytes | None] = asyncio.Queue()
         self.opened: asyncio.Future[bool] = asyncio.get_running_loop().create_future()
         self.closed = False
+        self.pending = 0  # in-flight roundtrips (pool's least-loaded pick)
+        self._orphans = 0  # cancelled roundtrips whose response is still due
 
     async def roundtrip(self, frame_bytes: bytes) -> bytes:
+        """Send one request; await its response.
+
+        Supports pipelining: concurrent roundtrips are matched to inbound
+        frames FIFO (the queue's getters wake in call order, and there is
+        no await between ``send`` and ``get``, so registration order equals
+        send order). A roundtrip cancelled mid-flight leaves an orphan
+        marker — its response, when it arrives, is discarded rather than
+        shifting every later match.
+        """
         from ..errors import Disconnect
 
         if self.closed:
             raise Disconnect("native connection closed")
-        self._engine._engine.send(self._id, frame_bytes)
-        payload = await self._frames.get()
+        self.pending += 1
+        try:
+            self._engine._engine.send(self._id, frame_bytes)
+            try:
+                payload = await self._frames.get()
+                while self._orphans and payload is not None:
+                    self._orphans -= 1
+                    payload = await self._frames.get()
+            except asyncio.CancelledError:
+                self._orphans += 1
+                raise
+        finally:
+            self.pending -= 1
         if payload is None:
             raise Disconnect("connection closed mid-request")
         return payload
@@ -183,7 +207,11 @@ class ClientEngine:
                 c.closed = True
                 if not c.opened.done():
                     c.opened.set_result(False)
-                c._frames.put_nowait(None)  # wake any reader
+                # One EOF sentinel per in-flight roundtrip (pipelining may
+                # have several waiters parked on the queue), plus one for a
+                # subscription reader.
+                for _ in range(c.pending + 1):
+                    c._frames.put_nowait(None)
                 self._conns.pop(conn, None)
                 # Free the C++ side: a peer FIN takes the engine's soft-EOF
                 # path, which keeps the fd open for writes until told
@@ -235,21 +263,39 @@ class ClientEngine:
             self._loop.remove_reader(self._engine.notify_fd)
         for c in list(self._conns.values()):
             c.closed = True
-            c._frames.put_nowait(None)
+            for _ in range(c.pending + 1):
+                c._frames.put_nowait(None)
         self._conns.clear()
         self._engine.shutdown()
 
 
 class _ConnState:
-    __slots__ = ("queue", "worker", "streaming")
+    __slots__ = ("queue", "waiter", "eof", "worker", "streaming", "resp_q", "room", "broken")
 
     def __init__(self) -> None:
-        # None is the EOF sentinel: the worker finishes in-flight requests
-        # (FIFO) and then exits, matching the asyncio path where a peer
-        # disconnect never cancels a running handler mid-mutation.
-        self.queue: asyncio.Queue[bytes | None] = asyncio.Queue()
+        # The worker drains ``queue`` and, at EOF, finishes in-flight
+        # requests (FIFO) before exiting — matching the asyncio path where
+        # a peer disconnect never cancels a running handler mid-mutation.
+        self.queue: deque[bytes] = deque()
+        self.waiter: asyncio.Future | None = None
+        self.eof = False
         self.worker: asyncio.Task | None = None
         self.streaming = False
+        self.resp_q: deque[asyncio.Future] = deque()  # FIFO response slots
+        self.room: asyncio.Future | None = None
+        self.broken = False
+
+    def wake(self) -> None:
+        w = self.waiter
+        if w is not None and not w.done():
+            self.waiter = None
+            w.set_result(None)
+
+    def wake_room(self) -> None:
+        r = self.room
+        if r is not None and not r.done():
+            self.room = None
+            r.set_result(None)
 
 
 class NativeServerTransport:
@@ -314,20 +360,19 @@ class NativeServerTransport:
             elif ev_type == EV_FRAME:
                 state = self._conns.get(conn)
                 if state is not None:
-                    if state.queue.qsize() >= _MAX_PENDING_FRAMES:
+                    if len(state.queue) >= _MAX_PENDING_FRAMES:
                         # The asyncio path gets TCP backpressure for free
-                        # (one frame read per response written); the engine
+                        # (reads pause past the handler cap); the engine
                         # reads greedily, so an unbounded pipeliner must be
                         # cut off rather than allowed to grow server memory.
-                        # Dropping the state + EOF sentinel here (Python-
-                        # initiated closes emit no EV_CLOSED) lets the
-                        # worker finish in-flight frames and exit instead of
-                        # leaking.
+                        # Dropping the state + EOF here (Python-initiated
+                        # closes emit no EV_CLOSED) lets the worker finish
+                        # in-flight frames and exit instead of leaking.
                         log.warning("conn %d exceeded pending-frame cap", conn)
                         self._conns.pop(conn, None)
                         if state.streaming:
                             # A streaming worker never reads state.queue
-                            # again; the sentinel would orphan it.
+                            # again; EOF alone would orphan it.
                             if state.worker is not None:
                                 state.worker.cancel()
                         else:
@@ -335,12 +380,13 @@ class NativeServerTransport:
                             # soon as its write queue drains, so responses for
                             # these frames would be thrown away — don't burn
                             # the worker executing them into a dead socket.
-                            while not state.queue.empty():
-                                state.queue.get_nowait()
-                            state.queue.put_nowait(None)
+                            state.queue.clear()
+                            state.eof = True
+                            state.wake()
                         self._engine.close_conn(conn)
                     else:
-                        state.queue.put_nowait(data)
+                        state.queue.append(data)
+                        state.wake()
             elif ev_type == EV_CLOSED:
                 state = self._conns.pop(conn, None)
                 if state is not None and state.worker is not None:
@@ -350,27 +396,95 @@ class NativeServerTransport:
                         # safe — no actor state) way to stop them.
                         state.worker.cancel()
                     else:
-                        state.queue.put_nowait(None)
+                        state.eof = True
+                        state.wake()
+                        state.wake_room()
 
     # ------------------------------------------------------------------
 
+    def _push_response(self, conn: int, state: _ConnState, fut: asyncio.Future) -> None:
+        state.resp_q.append(fut)
+        if fut.done():
+            self._flush_ready(conn, state)
+        else:
+            fut.add_done_callback(lambda _f: self._flush_ready(conn, state))
+
+    def _flush_ready(self, conn: int, state: _ConnState) -> None:
+        """Write every completed head response, preserving request order.
+
+        Runs synchronously from the handler task's done-callback (the same
+        FIFO-flush design as :class:`rio_tpu.aio.ServerConnProtocol`), so
+        out-of-order completions cost nothing until their turn.
+        """
+        q = state.resp_q
+        while q and q[0].done() and not state.broken:
+            fut = q.popleft()
+            if fut.cancelled():
+                continue  # shutdown path; nothing to write
+            try:
+                self._engine.send(conn, encode_response_frame(fut.result()))
+            except Exception:
+                log.exception("response write error; dropping conn %d", conn)
+                state.broken = True
+                state.eof = True
+                state.wake()
+                self._conns.pop(conn, None)
+                self._engine.close_conn(conn)
+                break
+        state.wake_room()
+
+    async def _next_payload(self, state: _ConnState) -> bytes | None:
+        while not state.queue:
+            if state.eof:
+                return None
+            state.waiter = asyncio.get_running_loop().create_future()
+            await state.waiter
+        return state.queue.popleft()
+
     async def _conn_worker(self, conn: int, state: _ConnState) -> None:
-        """Ordered dispatch for one connection (service.rs:370-459 shape)."""
+        """Ordered-concurrent dispatch for one connection.
+
+        Same semantics as the asyncio transport: handlers run concurrently
+        per connection, responses leave strictly in request order
+        (service.rs:370-459 wire shape under pipelining).
+        """
         service = self._service_factory()
+        loop = asyncio.get_running_loop()
+        cancelled = False
         try:
             while True:
-                payload = await state.queue.get()
-                if payload is None:  # peer closed; in-flight work already done
+                payload = await self._next_payload(state)
+                if payload is None:
+                    # Peer finished sending; flush every in-flight response
+                    # before handing the fd back (the engine then closes
+                    # once its write queue drains).
+                    while state.resp_q and not state.broken:
+                        state.room = loop.create_future()
+                        await state.room
                     return
                 try:
                     inbound = decode_inbound(payload)
                 except Exception as e:  # malformed frame → error response
-                    resp = ResponseEnvelope.err(ResponseError.unknown(f"bad frame: {e}"))
-                    self._engine.send(conn, encode_response_frame(resp))
+                    fut: asyncio.Future = loop.create_future()
+                    fut.set_result(
+                        ResponseEnvelope.err(ResponseError.unknown(f"bad frame: {e}"))
+                    )
+                    self._push_response(conn, state, fut)
                     continue
-                if isinstance(inbound, RequestEnvelope):
-                    resp = await service.call(inbound)
-                    self._engine.send(conn, encode_response_frame(resp))
+                if type(inbound) is RequestEnvelope:
+                    if not state.resp_q and not state.queue:
+                        # Sole in-flight request on this connection:
+                        # dispatch inline (no task), the common case.
+                        resp = await service.call(inbound)
+                        if not state.broken:
+                            self._engine.send(conn, encode_response_frame(resp))
+                        continue
+                    while len(state.resp_q) >= _MAX_CONCURRENT and not state.eof:
+                        state.room = loop.create_future()
+                        await state.room
+                    self._push_response(
+                        conn, state, loop.create_task(service.call(inbound))
+                    )
                 else:
                     if conn not in self._conns:
                         # Peer already disconnected (CLOSED was drained while
@@ -378,15 +492,24 @@ class NativeServerTransport:
                         # mode now would leak the router subscription — no
                         # EV_CLOSED will ever cancel us again.
                         return
+                    # Flush pending responses before streaming mode.
+                    while state.resp_q and not state.eof:
+                        state.room = loop.create_future()
+                        await state.room
                     state.streaming = True
                     await self._stream_subscription(conn, service, inbound)
                     return
         except asyncio.CancelledError:
+            cancelled = True
             raise
         except Exception:
             log.exception("native conn worker error (conn=%d)", conn)
         finally:
-            # Mirror Service.run's `writer.close()`: whatever ends the
+            if cancelled:
+                for fut in state.resp_q:
+                    fut.cancel()
+                state.resp_q.clear()
+            # Mirror the asyncio transport's close: whatever ends the
             # worker, the engine should close the socket — after pending
             # responses flush (close_pending semantics in the engine).
             self._conns.pop(conn, None)

@@ -17,7 +17,7 @@ array ``[ok: bool, value]`` where the error arm is ``[tag, detail]``.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from enum import IntEnum
 from typing import Any
 
@@ -192,117 +192,46 @@ class SubscriptionResponse:
 KIND_REQUEST = b"\x00"
 KIND_SUBSCRIBE = b"\x01"
 
-_native: Any = False  # False = not resolved yet; None = unavailable
-
-
-def _nat():
-    """Lazily resolve the C++ codec (rio_tpu.native); None when absent."""
-    global _native
-    if _native is False:
-        from . import native
-
-        _native = native.get()
-    return _native
+# These helpers are deliberately pure Python.  The C++ codec
+# (``rio_tpu.native``) produces byte-identical frames (parity-locked by
+# ``tests/test_native.py``) and is used where C++ already owns the buffer
+# (the epoll engine's reply fast path); calling it per-frame from Python was
+# MEASURED SLOWER than the msgpack C extension — one ctypes round trip costs
+# more than packing a request-sized envelope — so the hot path stays here.
 
 
 def encode_request_frame(env: RequestEnvelope) -> bytes:
-    lib = _nat()
-    if lib is not None:
-        return lib.encode_request_frame(
-            env.handler_type.encode(),
-            env.handler_id.encode(),
-            env.message_type.encode(),
-            env.payload,
-        )
     return codec.frame(KIND_REQUEST + env.to_bytes())
 
 
 def encode_subscribe_frame(req: SubscriptionRequest) -> bytes:
-    lib = _nat()
-    if lib is not None:
-        return lib.encode_subscribe_frame(
-            req.handler_type.encode(), req.handler_id.encode()
-        )
     return codec.frame(KIND_SUBSCRIBE + req.to_bytes())
 
 
 def encode_response_frame(resp: ResponseEnvelope) -> bytes:
     """Complete response frame (server→client hot path)."""
-    lib = _nat()
-    if lib is not None:
-        if resp.error is None:
-            return lib.encode_response_ok_frame(resp.body or b"")
-        e = resp.error
-        return lib.encode_response_err_frame(int(e.kind), e.detail.encode(), e.payload)
     return codec.frame(resp.to_bytes())
 
 
 def encode_subresponse_frame(item: SubscriptionResponse) -> bytes:
     """Complete subscription-stream frame (server→client hot path)."""
-    lib = _nat()
-    if lib is not None:
-        if item.error is None:
-            return lib.encode_subresponse_ok_frame(item.message_type.encode(), item.body)
-        e = item.error
-        return lib.encode_subresponse_err_frame(int(e.kind), e.detail.encode(), e.payload)
     return codec.frame(item.to_bytes())
 
 
 def decode_response(payload: bytes) -> ResponseEnvelope:
     """Decode a ResponseEnvelope payload (client hot path)."""
-    lib = _nat()
-    if lib is None:
-        return ResponseEnvelope.from_bytes(payload)
-    dec = lib.decode_response(payload)
-    if dec is None:
-        raise SerializationError("malformed ResponseEnvelope")
-    if dec[0]:
-        return ResponseEnvelope.ok(dec[1])
-    _, kind, detail, err_payload = dec
-    try:
-        return ResponseEnvelope.err(
-            ResponseError(ErrorKind(kind), detail.decode(), err_payload)
-        )
-    except (ValueError, UnicodeDecodeError) as e:
-        raise SerializationError(f"malformed ResponseEnvelope: {e}") from e
+    return ResponseEnvelope.from_bytes(payload)
 
 
 def decode_subresponse(payload: bytes) -> SubscriptionResponse:
     """Decode a SubscriptionResponse payload (client hot path)."""
-    lib = _nat()
-    if lib is None:
-        return SubscriptionResponse.from_bytes(payload)
-    dec = lib.decode_subresponse(payload)
-    if dec is None:
-        raise SerializationError("malformed SubscriptionResponse")
-    try:
-        if dec[0]:
-            return SubscriptionResponse(message_type=dec[1].decode(), body=dec[2])
-        _, kind, detail, err_payload = dec
-        return SubscriptionResponse(
-            error=ResponseError(ErrorKind(kind), detail.decode(), err_payload)
-        )
-    except (ValueError, UnicodeDecodeError) as e:
-        raise SerializationError(f"malformed SubscriptionResponse: {e}") from e
+    return SubscriptionResponse.from_bytes(payload)
 
 
 def decode_inbound(payload: bytes) -> RequestEnvelope | SubscriptionRequest:
     """Decode one inbound frame payload on the server side."""
     if not payload:
         raise SerializationError("empty frame")
-    lib = _nat()
-    if lib is not None:
-        dec = lib.decode_inbound(payload)
-        if dec is None:
-            raise SerializationError("malformed inbound frame")
-        try:
-            if dec[0] == 0:
-                return RequestEnvelope(
-                    dec[1].decode(), dec[2].decode(), dec[3].decode(), dec[4]
-                )
-            return SubscriptionRequest(dec[1].decode(), dec[2].decode())
-        except UnicodeDecodeError as e:
-            raise SerializationError(f"malformed inbound frame: {e}") from e
     kind, body = payload[:1], payload[1:]
     if kind == KIND_REQUEST:
         return RequestEnvelope.from_bytes(body)

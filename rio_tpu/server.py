@@ -148,7 +148,7 @@ class Server:
         if self.transport == "auto":
             from . import native
 
-            return "native" if native.get() is not None else "asyncio"
+            return "native" if native.engine_profitable() else "asyncio"
         return self.transport
 
     async def bind(self) -> str:
@@ -176,7 +176,17 @@ class Server:
             )
             bound_host, bound_port = host, self._native_transport.port
         else:
-            self._listener = await asyncio.start_server(self._accept, host, int(port))
+            from .aio import ServerConnProtocol
+
+            def _track(task: asyncio.Task) -> None:
+                # Track per-connection workers so shutdown severs live
+                # connections (a stopped node must not keep serving).
+                self._conn_tasks.add(task)
+                task.add_done_callback(self._conn_tasks.discard)
+
+            self._listener = await asyncio.get_running_loop().create_server(
+                lambda: ServerConnProtocol(self._service, _track), host, int(port)
+            )
             sock = self._listener.sockets[0]
             bound_host, bound_port = sock.getsockname()[:2]
         self._local_addr = self._advertised(bound_host, bound_port)
@@ -207,17 +217,6 @@ class Server:
             members_storage=self.members_storage,
             app_data=self.app_data,
         )
-
-    async def _accept(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
-        # Per-connection service instance, as the reference clones its
-        # Service per accepted socket (server.rs:285-305). Track the task so
-        # shutdown actually severs live connections — a stopped node must not
-        # keep serving over previously-accepted sockets.
-        task = asyncio.current_task()
-        if task is not None:
-            self._conn_tasks.add(task)
-            task.add_done_callback(self._conn_tasks.discard)
-        await self._service().run(reader, writer)
 
     # ------------------------------------------------------------------
     # Internal client + admin consumers (reference server.rs:309-363)

@@ -19,11 +19,9 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Any
 
 from .app_data import AppData
 from .cluster.storage import MembershipStorage
-from .codec import read_frame
 from .errors import HandlerNotFound, ObjectNotFound, SerializationError, TypeNotFound
 from .message_router import MessageRouter
 from .object_placement import ObjectPlacement, ObjectPlacementItem
@@ -33,9 +31,6 @@ from .protocol import (
     ResponseEnvelope,
     ResponseError,
     SubscriptionRequest,
-    decode_inbound,
-    encode_response_frame,
-    encode_subresponse_frame,
 )
 from .registry import ApplicationRaised, ObjectId, Registry
 from .service_object import LifecycleMessage
@@ -72,7 +67,9 @@ class Service:
 
     async def get_or_create_placement(self, object_id: ObjectId) -> str:
         """Resolve the owning server for ``object_id``, self-assigning if free."""
-        with span("placement_lookup", object=str(object_id)):
+        # ObjectId is passed raw: attrs must cost nothing to build when no
+        # sink is registered (sinks str() it themselves).
+        with span("placement_lookup", object=object_id):
             addr = await self.object_placement.lookup(object_id)
         if addr is not None:
             if not _address_well_formed(addr):
@@ -108,7 +105,7 @@ class Service:
     async def start_service_object(self, object_id: ObjectId) -> ResponseError | None:
         if self.registry.has(object_id.type_name, object_id.id):
             return None
-        with span("object_activate", object=str(object_id)):
+        with span("object_activate", object=object_id):
             try:
                 obj = self.registry.new_from_type(object_id.type_name, object_id.id)
             except TypeNotFound:
@@ -144,7 +141,7 @@ class Service:
             return ResponseEnvelope.err(start_err)
 
         try:
-            with span("handler_dispatch", object=str(object_id), msg=req.message_type):
+            with span("handler_dispatch", object=object_id, msg=req.message_type):
                 body = await self.registry.send_raw(
                     req.handler_type,
                     req.handler_id,
@@ -193,70 +190,7 @@ class Service:
         router = self.app_data.get(MessageRouter)
         return router.create_subscription(req.handler_type, req.handler_id)
 
-    # ------------------------------------------------------------------
-    # Connection loop (reference service.rs:370-459)
-    # ------------------------------------------------------------------
-
-    async def run(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
-        """Serve one TCP connection until EOF.
-
-        Requests are answered in order (the wire has no correlation ids, as
-        in the reference); a subscription request switches the connection
-        into streaming mode until the peer disconnects.
-        """
-        peer = writer.get_extra_info("peername")
-        try:
-            while True:
-                payload = await read_frame(reader)
-                if payload is None:
-                    return
-                try:
-                    inbound = decode_inbound(payload)
-                except Exception as e:  # malformed frame → error response
-                    resp = ResponseEnvelope.err(ResponseError.unknown(f"bad frame: {e}"))
-                    writer.write(encode_response_frame(resp))
-                    await writer.drain()
-                    continue
-                if isinstance(inbound, RequestEnvelope):
-                    resp = await self.call(inbound)
-                    writer.write(encode_response_frame(resp))
-                    await writer.drain()
-                else:
-                    await self._stream_subscription(inbound, writer)
-                    return
-        except (ConnectionError, asyncio.CancelledError):
-            raise
-        except SerializationError as e:
-            # Unframeable input (e.g. oversized length header): drop the
-            # connection; nothing sane can follow on this byte stream.
-            log.warning("dropping connection %s: %s", peer, e)
-        except Exception:
-            log.exception("connection loop error (peer=%s)", peer)
-        finally:
-            writer.close()
-            try:
-                await writer.wait_closed()
-            except (ConnectionError, OSError):
-                pass
-
-    async def _stream_subscription(
-        self, req: SubscriptionRequest, writer: asyncio.StreamWriter
-    ) -> None:
-        from .protocol import SubscriptionResponse
-
-        result = await self.subscribe(req)
-        if isinstance(result, ResponseError):
-            writer.write(encode_subresponse_frame(SubscriptionResponse(error=result)))
-            await writer.drain()
-            return
-        queue = result
-        router = self.app_data.get(MessageRouter)
-        try:
-            while True:
-                item = await queue.get()
-                writer.write(encode_subresponse_frame(item))
-                await writer.drain()
-        except (ConnectionError, OSError):
-            pass
-        finally:
-            router.drop_subscription(req.handler_type, req.handler_id, queue)
+    # The per-connection frame loop (reference service.rs:370-459) lives in
+    # the transports: rio_tpu/aio.py (asyncio Protocol) and
+    # rio_tpu/native/transport.py (C++ epoll engine). Both dispatch through
+    # this class, so semantics are defined once here.

@@ -10,7 +10,6 @@ application the same way the reference wires ``tracing_subscriber``).
 
 from __future__ import annotations
 
-import contextlib
 import logging
 import time
 from dataclasses import dataclass, field
@@ -47,19 +46,44 @@ def logging_sink(span: Span) -> None:
     log.debug("span %s %.3fms %s", span.name, span.duration * 1e3, span.attrs)
 
 
-@contextlib.contextmanager
-def span(name: str, **attrs: Any):
-    """Trace a block. Near-free when no sink is registered."""
-    if not _ENABLED:
-        yield None
-        return
-    s = Span(name=name, attrs=attrs, start=time.perf_counter())
-    try:
-        yield s
-    finally:
+class _NullSpan:
+    """Shared no-op context manager: zero allocation on the unsinked path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _LiveSpan:
+    __slots__ = ("_span",)
+
+    def __init__(self, name: str, attrs: dict[str, Any]) -> None:
+        self._span = Span(name=name, attrs=attrs)
+
+    def __enter__(self) -> Span:
+        self._span.start = time.perf_counter()
+        return self._span
+
+    def __exit__(self, *exc) -> bool:
+        s = self._span
         s.duration = time.perf_counter() - s.start
         for sink in _SINKS:
             try:
                 sink(s)
             except Exception:  # sinks must never break the request path
                 log.exception("trace sink failed")
+        return False
+
+
+def span(name: str, **attrs: Any):
+    """Trace a block. Free (shared null object) when no sink is registered."""
+    if not _ENABLED:
+        return _NULL_SPAN
+    return _LiveSpan(name, attrs)

@@ -1,0 +1,50 @@
+"""Lightweight TPU-availability probe (safe under a wedged axon relay).
+
+Runs jax.devices() in THIS process under a hard os._exit watchdog, so a
+hung PJRT init through the axon tunnel cannot orphan a chip grant: the
+process dies cleanly before touching any TPU op.  Exit codes:
+
+  0  — TPU present (prints device list)
+  97 — backend init failed (relay down / fell back to non-tpu)
+  99 — watchdog fired during init (relay wedged)
+
+Run it as a child:  python tpu_probe.py   (never import this in-process).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+
+def main(deadline: float = 120.0) -> None:
+    t = threading.Timer(deadline, lambda: os._exit(99))
+    t.daemon = True
+    t.start()
+    t0 = time.monotonic()
+    try:
+        import jax
+
+        devices = jax.devices()
+    except Exception as e:
+        print(f"init failed: {type(e).__name__}: {e}", flush=True)
+        os._exit(97)
+    dt = time.monotonic() - t0
+    print(f"devices={devices} init_s={dt:.1f}", flush=True)
+    if devices[0].platform != "tpu":
+        os._exit(97)
+    # Tiny smoke op to confirm the chip actually executes (still under the
+    # watchdog; a wedged relay typically hangs here, not at devices()).
+    import jax.numpy as jnp
+
+    x = jnp.ones((128, 128))
+    val = float((x @ x).sum())
+    print(f"smoke matmul ok: {val}", flush=True)
+    t.cancel()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main(float(sys.argv[1]) if len(sys.argv) > 1 else 120.0)
