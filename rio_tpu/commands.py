@@ -81,3 +81,18 @@ class ServerInfo:
     """The hosting server's identity, injected into AppData."""
 
     address: str
+
+
+@dataclasses.dataclass
+class DispatchObserver:
+    """AppData-injectable hook called after every successfully served request.
+
+    ``fn(object_key, serving_address)`` — the seam through which the server
+    feeds live traffic into an :class:`~rio_tpu.object_placement.
+    jax_placement.AffinityTracker` (state-locality features for the
+    hierarchical placement solver) without the application touching the
+    dispatch path.  Kept here (not in ``jax_placement``) so the request
+    engine never imports jax.
+    """
+
+    fn: Any  # Callable[[str, str], None]; Any avoids typing import cost

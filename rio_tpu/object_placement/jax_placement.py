@@ -196,6 +196,7 @@ class JaxObjectPlacement(ObjectPlacement):
         move_cost: float = 0.5,
         obj_features=None,
         node_features=None,
+        affinity_tracker: "AffinityTracker | None" = None,
     ) -> None:
         self._eps = eps
         self._n_iters = n_iters
@@ -213,13 +214,20 @@ class JaxObjectPlacement(ObjectPlacement):
         # balancing proxy; plug an AffinityTracker (or anything encoding
         # state size / cache warmth / request rate) to make the OT affinity
         # term carry real locality signal.
-        if (obj_features or node_features) and mode != "hierarchical":
+        if (obj_features or node_features or affinity_tracker) and mode != "hierarchical":
             # Flat modes build per-node costs only and would silently
             # ignore the hooks — fail at construction, not at solve time.
             raise ValueError(
-                "obj_features/node_features are only consumed by "
-                f'mode="hierarchical" (got mode={mode!r})'
+                "obj_features/node_features/affinity_tracker are only consumed "
+                f'by mode="hierarchical" (got mode={mode!r})'
             )
+        # Carrying the tracker on the provider lets the Server auto-wire
+        # AffinityTracker.observe into the dispatch path (every served
+        # request updates the object's locality feature — no app code).
+        self.affinity_tracker = affinity_tracker
+        if affinity_tracker is not None:
+            obj_features = obj_features or affinity_tracker.obj_features
+            node_features = node_features or affinity_tracker.node_features
         self._obj_features = obj_features or _hash_features
         self._node_features = node_features or _hash_features
         # Host-mirrored directory: "{type}.{id}" -> node index.
@@ -561,7 +569,54 @@ class JaxObjectPlacement(ObjectPlacement):
                             f, g = res.f, res.g
                         assignment = plan_rounded_assign(cost, f, g, self._eps)
                     else:
-                        assignment = greedy_balanced_assign(cost, mass, cap * alive)
+                        # Churn-aware greedy: waterfilling lays *all* mass
+                        # out by cumulative position, so a naive full
+                        # re-solve would reshuffle boundary objects that
+                        # didn't need to move. Instead each object KEEPS its
+                        # seat iff the seat is alive and the object is
+                        # within its node's capacity-fair share (per-node
+                        # rank < fair); everything else — dead seats and
+                        # over-fair overflow — is waterfilled into the
+                        # survivors' remaining headroom. Churn then moves
+                        # exactly the displaced share, and pure load skew
+                        # moves only the overflow, mirroring what the
+                        # move-cost discount does for the OT modes.
+                        cur = jnp.zeros((bucket,), jnp.int32).at[:n].set(
+                            jnp.asarray(cur_idx)
+                        )
+                        # Rank of each object among its node's objects.
+                        # Stable sort keeps padding rows (mass 0, cur 0)
+                        # after the real rows of node 0, so real ranks are
+                        # unaffected.
+                        order = jnp.argsort(cur, stable=True)
+                        sorted_cur = cur[order]
+                        pos = jnp.arange(bucket)
+                        is_start = jnp.concatenate(
+                            [jnp.ones((1,), bool), sorted_cur[1:] != sorted_cur[:-1]]
+                        )
+                        group_start = jax.lax.associative_scan(
+                            jnp.maximum, jnp.where(is_start, pos, 0)
+                        )
+                        rank = jnp.zeros((bucket,), jnp.int32).at[order].set(
+                            (pos - group_start).astype(jnp.int32)
+                        )
+                        cap_alive = cap * alive
+                        fair = (
+                            jnp.sum(mass)
+                            * cap_alive
+                            / jnp.maximum(jnp.sum(cap_alive), 1e-30)
+                        )
+                        keep = (alive[cur] > 0) & (mass > 0) & (rank < fair[cur])
+                        kept_load = jnp.zeros_like(cap).at[cur].add(
+                            jnp.where(keep, mass, 0.0)
+                        )
+                        refill = greedy_balanced_assign(
+                            cost,
+                            jnp.where(keep, 0.0, mass),
+                            cap_alive,
+                            node_load=kept_load,
+                        )
+                        assignment = jnp.where(keep, cur, refill)
                         g = None
             out = np.asarray(assignment)[:n]
             return out, g, (time.perf_counter() - t0) * 1e3

@@ -1,0 +1,133 @@
+"""Background churn→re-solve wiring (the proactive half of recovery).
+
+The reference recovers *reactively inside the request path*: a request to
+an object whose host died triggers ``clean_server`` + lazy re-allocation
+(``rio-rs/src/service.rs:227-238,261-298``).  rio-tpu keeps that path —
+and this daemon adds the *proactive* half SURVEY §7.3 promises: watch
+membership liveness, feed it to :class:`~rio_tpu.object_placement.
+jax_placement.JaxObjectPlacement` (``sync_members``), and trigger a
+warm-started ``rebalance()`` so displaced objects are re-seated by the OT
+solver *before* traffic hits them — no application involvement.
+
+Opt in per node::
+
+    Server(..., placement_daemon=True)
+
+The daemon is a no-op for placement providers without the solver surface
+(``sync_members``/``rebalance``), so it is safe to enable unconditionally.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+
+from .cluster.storage import MembershipStorage
+from .object_placement import ObjectPlacement
+
+log = logging.getLogger("rio_tpu.placement_daemon")
+
+
+@dataclass
+class PlacementDaemonStats:
+    polls: int = 0
+    liveness_changes: int = 0
+    rebalances: int = 0
+    moves: int = 0
+    errors: int = 0
+
+
+@dataclass
+class PlacementDaemonConfig:
+    """Tunables; defaults sized for the gossip defaults (10 s interval).
+
+    One config may be shared by every server in a process; each daemon
+    keeps its own :class:`PlacementDaemonStats`.
+    """
+
+    poll_interval: float = 1.0
+    # Debounce: a churn burst (several nodes flapping within this window)
+    # costs one warm-started solve, not one per event.
+    debounce: float = 0.25
+    # Floor between full re-solves, so a flapping node can't make the
+    # daemon spin the device.
+    min_rebalance_interval: float = 1.0
+    mode: str | None = None  # solver mode override for daemon rebalances
+
+
+class PlacementDaemon:
+    """Watch membership storage; re-solve placement on liveness changes."""
+
+    def __init__(
+        self,
+        members_storage: MembershipStorage,
+        placement: ObjectPlacement,
+        config: PlacementDaemonConfig | None = None,
+    ) -> None:
+        self.members_storage = members_storage
+        self.placement = placement
+        self.config = config or PlacementDaemonConfig()
+        self.stats = PlacementDaemonStats()
+        self._last_liveness: frozenset[tuple[str, bool]] | None = None
+
+    @property
+    def supported(self) -> bool:
+        return hasattr(self.placement, "sync_members") and hasattr(
+            self.placement, "rebalance"
+        )
+
+    async def _liveness(self) -> tuple[frozenset[tuple[str, bool]], list]:
+        members = await self.members_storage.members()
+        return frozenset((m.address, bool(m.active)) for m in members), members
+
+    async def run(self) -> None:
+        """Poll loop; runs until cancelled (a Server.run child task)."""
+        if not self.supported:
+            log.debug(
+                "placement provider %s has no solver surface; daemon idle",
+                type(self.placement).__name__,
+            )
+            await asyncio.Event().wait()  # park forever (until cancelled)
+        cfg = self.config
+        loop = asyncio.get_running_loop()
+        last_rebalance = float("-inf")
+        while True:
+            try:
+                liveness, members = await self._liveness()
+                self.stats.polls += 1
+                if liveness != self._last_liveness:
+                    first_sync = self._last_liveness is None
+                    self._last_liveness = liveness
+                    self.placement.sync_members(members)
+                    if first_sync:
+                        # Startup: learn the initial member set without
+                        # solving — nothing is displaced yet.
+                        await asyncio.sleep(cfg.poll_interval)
+                        continue
+                    self.stats.liveness_changes += 1
+                    # Debounce a churn burst into one solve.
+                    await asyncio.sleep(cfg.debounce)
+                    liveness, members = await self._liveness()
+                    self._last_liveness = liveness
+                    self.placement.sync_members(members)
+                    wait = last_rebalance + cfg.min_rebalance_interval - loop.time()
+                    if wait > 0:
+                        await asyncio.sleep(wait)
+                    moved = await self.placement.rebalance(mode=cfg.mode)
+                    last_rebalance = loop.time()
+                    self.stats.rebalances += 1
+                    self.stats.moves += int(moved)
+                    log.info(
+                        "churn re-solve: %d objects moved (%d liveness changes seen)",
+                        moved,
+                        self.stats.liveness_changes,
+                    )
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # The daemon must never die to a transient storage error —
+                # liveness watching is the node's recovery path.
+                self.stats.errors += 1
+                log.exception("placement daemon poll failed")
+            await asyncio.sleep(cfg.poll_interval)

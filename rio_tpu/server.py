@@ -20,6 +20,7 @@ from .commands import (
     AdminCommand,
     AdminCommandKind,
     AdminSender,
+    DispatchObserver,
     InternalClientSender,
     SendCommand,
     ServerInfo,
@@ -85,6 +86,8 @@ class Server:
         http_members_address: str | None = None,
         transport: str = "asyncio",
         advertise_address: str | None = None,
+        placement_daemon: bool = False,
+        placement_daemon_config=None,
     ) -> None:
         if transport not in ("asyncio", "native", "auto"):
             raise ValueError(f"unknown transport {transport!r}")
@@ -100,6 +103,11 @@ class Server:
         self.app_data = app_data or AppData()
         self.http_members_address = http_members_address
         self.transport = transport
+        # Opt-in proactive churn→re-solve loop (SURVEY §7.3); a no-op for
+        # placement providers without the solver surface.
+        self.placement_daemon_enabled = placement_daemon
+        self.placement_daemon_config = placement_daemon_config
+        self.placement_daemon = None  # set by run() when enabled
 
         self._listener: asyncio.Server | None = None
         self._native_transport = None
@@ -122,6 +130,12 @@ class Server:
         self.app_data.get_or_default(MessageRouter)
         self.app_data.set(self.members_storage, as_type=MembershipStorage)
         self.app_data.set(self.object_placement, as_type=ObjectPlacement)
+        # Auto-wire dispatch→affinity observation: if the placement provider
+        # carries an AffinityTracker, every served request records which node
+        # served which object (the signal hierarchical OT mode solves over).
+        tracker = getattr(self.object_placement, "affinity_tracker", None)
+        if tracker is not None and DispatchObserver not in self.app_data:
+            self.app_data.set(DispatchObserver(tracker.observe))
 
     # ------------------------------------------------------------------
 
@@ -290,6 +304,15 @@ class Server:
             asyncio.ensure_future(self._consume_admin_commands()),
             asyncio.ensure_future(self._stopped.wait()),
         ]
+        if self.placement_daemon_enabled:
+            from .placement_daemon import PlacementDaemon
+
+            daemon = PlacementDaemon(
+                self.members_storage, self.object_placement,
+                self.placement_daemon_config,
+            )
+            self.placement_daemon = daemon
+            tasks.append(asyncio.ensure_future(daemon.run()))
         if self.http_members_address:
             from .cluster.storage.http import serve_members_http
 

@@ -22,6 +22,7 @@ import logging
 
 from .app_data import AppData
 from .cluster.storage import MembershipStorage
+from .commands import DispatchObserver
 from .errors import HandlerNotFound, ObjectNotFound, SerializationError, TypeNotFound
 from .message_router import MessageRouter
 from .object_placement import ObjectPlacement, ObjectPlacementItem
@@ -60,6 +61,10 @@ class Service:
         self.object_placement = object_placement
         self.members_storage = members_storage
         self.app_data = app_data
+        # Resolved once per connection, not per request: the affinity
+        # observation hook (None for deployments without a tracker).
+        observer = app_data.try_get(DispatchObserver)
+        self._observe = observer.fn if observer is not None else None
 
     # ------------------------------------------------------------------
     # Placement (reference service.rs:193-298)
@@ -149,6 +154,10 @@ class Service:
                     req.payload,
                     self.app_data,
                 )
+            if self._observe is not None:
+                # Feed the affinity tracker: this node served this object
+                # (reference has no counterpart — placement there is random).
+                self._observe(f"{req.handler_type}.{req.handler_id}", self.address)
             return ResponseEnvelope.ok(body)
         except ApplicationRaised as e:
             # Typed user error: object stays alive (reference Err path).

@@ -87,6 +87,7 @@ async def run_integration_test(
     gossip: bool = False,
     provider_builder: Callable[[LocalStorage], ClusterProvider] | None = None,
     transport: str = "asyncio",
+    server_kwargs: dict | None = None,
 ) -> None:
     members = members if members is not None else LocalStorage()
     placement = placement if placement is not None else LocalObjectPlacement()
@@ -105,6 +106,7 @@ async def run_integration_test(
             cluster_provider=provider,
             object_placement_provider=placement,
             transport=transport,
+            **(server_kwargs or {}),
         )
         await server.prepare()
         await server.bind()

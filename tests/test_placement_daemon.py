@@ -1,0 +1,193 @@
+"""Churn-driven placement: the Server-owned daemon re-solves with ZERO app code.
+
+VERDICT r2 #3 / SURVEY §7.3: the reference recovers lazily inside the
+request path (``rio-rs/src/service.rs:227-298``); rio-tpu additionally
+re-seats displaced objects *proactively* — gossip marks a node dead, the
+``PlacementDaemon`` feeds liveness to ``JaxObjectPlacement.sync_members``
+and triggers a warm-started ``rebalance()``, and traffic finds every object
+already re-placed.  The application never touches the solver.
+"""
+
+import asyncio
+
+from rio_tpu import AppData, LocalObjectPlacement, LocalStorage, Registry, ServiceObject, handler, message
+from rio_tpu.commands import AdminCommand, ServerInfo
+from rio_tpu.object_placement.jax_placement import AffinityTracker, JaxObjectPlacement
+from rio_tpu.placement_daemon import PlacementDaemon, PlacementDaemonConfig
+
+from .server_utils import Cluster, run_integration_test
+
+N_OBJECTS = 96
+
+
+@message
+class Poke:
+    pass
+
+
+@message
+class Where:
+    address: str = ""
+
+
+class Pin(ServiceObject):
+    @handler
+    async def poke(self, msg: Poke, ctx: AppData) -> Where:
+        return Where(address=ctx.get(ServerInfo).address)
+
+def build_registry() -> Registry:
+    return Registry().add_type(Pin)
+
+
+def test_daemon_reseats_displaced_objects_without_app_solver_calls():
+    """Kill a node; the daemon alone re-places its objects (≈ displaced share)."""
+    placement = JaxObjectPlacement(mode="greedy", move_cost=0.5)
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        try:
+            # Allocate a population across 3 nodes.
+            for i in range(N_OBJECTS):
+                await client.send(Pin, f"o{i}", Poke(), returns=Where)
+            assert placement.count() == N_OBJECTS
+
+            placed_before = {
+                f"o{i}": await cluster.allocation_address("Pin", f"o{i}")
+                for i in range(N_OBJECTS)
+            }
+            victim = max(
+                cluster.addresses, key=lambda a: sum(1 for v in placed_before.values() if v == a)
+            )
+            displaced = [k for k, v in placed_before.items() if v == victim]
+            assert displaced, "victim hosted nothing; test setup broken"
+
+            # Kill the victim node via its admin channel (deterministic —
+            # a wire-level kill could be retried onto a survivor).
+            victim_server = next(
+                s for s in cluster.servers if s.local_address == victim
+            )
+            victim_server.admin_sender().send(AdminCommand.server_exit())
+
+            # Wait for the DAEMON (not the test, not the app) to re-solve.
+            daemons = [
+                s.placement_daemon
+                for s in cluster.servers
+                if getattr(s, "placement_daemon", None) is not None
+            ]
+            assert daemons, "placement daemon was not started"
+            deadline = asyncio.get_event_loop().time() + 15.0
+            while asyncio.get_event_loop().time() < deadline:
+                if any(d.stats.rebalances > 0 for d in daemons):
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise TimeoutError("daemon never rebalanced after node death")
+
+            # Every displaced object now has a LIVE owner in the directory —
+            # proactively, before any traffic touched it.
+            live = set(cluster.addresses) - {victim}
+            reseated = 0
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while asyncio.get_event_loop().time() < deadline:
+                addrs = [
+                    await cluster.allocation_address("Pin", k) for k in displaced
+                ]
+                reseated = sum(1 for a in addrs if a in live)
+                if reseated == len(displaced):
+                    break
+                await asyncio.sleep(0.05)
+            assert reseated == len(displaced), (
+                f"{len(displaced) - reseated} displaced objects still "
+                f"point at the dead node"
+            )
+
+            # Churn moved ≈ the displaced share, not a global reshuffle.
+            moved_total = sum(d.stats.moves for d in daemons)
+            assert moved_total >= len(displaced)
+            assert moved_total <= len(displaced) + N_OBJECTS // 4, (
+                f"daemon moved {moved_total} objects for {len(displaced)} displaced"
+            )
+
+            # And traffic is served from live nodes with no app solver call.
+            for k in displaced[:8]:
+                out = await client.send(Pin, k, Poke(), returns=Where)
+                assert out.address in live
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_registry,
+            num_servers=3,
+            placement=placement,
+            gossip=True,
+            timeout=60.0,
+            server_kwargs={
+                "placement_daemon": True,
+                "placement_daemon_config": PlacementDaemonConfig(
+                    poll_interval=0.1, debounce=0.05, min_rebalance_interval=0.1
+                ),
+            },
+        )
+    )
+
+
+def test_daemon_noop_for_plain_providers():
+    """Enabling the daemon with a CRUD-only provider must be harmless."""
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        try:
+            out = await client.send(Pin, "x", Poke(), returns=Where)
+            assert out.address in cluster.addresses
+            daemon = cluster.servers[0].placement_daemon
+            assert daemon is not None and not daemon.supported
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_registry,
+            num_servers=2,
+            server_kwargs={"placement_daemon": True},
+        )
+    )
+
+
+def test_dispatch_observe_feeds_affinity_tracker():
+    """Served requests update the tracker with zero application wiring."""
+    tracker = AffinityTracker()
+    placement = JaxObjectPlacement(mode="hierarchical", affinity_tracker=tracker)
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        try:
+            for i in range(8):
+                await client.send(Pin, f"t{i}", Poke(), returns=Where)
+            # The tracker saw every object, keyed exactly like the directory.
+            assert len(tracker._obj) == 8
+            for i in range(8):
+                assert f"Pin.t{i}" in tracker._obj
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_registry,
+            num_servers=2,
+            placement=placement,
+        )
+    )
+
+
+def test_shared_config_stats_isolated_per_daemon():
+    """Servers sharing one config object must not share stats counters."""
+    cfg = PlacementDaemonConfig()
+    members, placement = LocalStorage(), LocalObjectPlacement()
+    d1 = PlacementDaemon(members, placement, cfg)
+    d2 = PlacementDaemon(members, placement, cfg)
+    assert d1.stats is not d2.stats
+    assert not d1.supported  # CRUD-only provider: daemon parks
