@@ -73,6 +73,30 @@ def sqlite_baseline_rate(n_samples: int = 5000) -> float:
     return n_samples / (time.perf_counter() - t0)
 
 
+def scaled_route_hops() -> None:
+    """64-server x 50k-object live routing + stale-directory degradation.
+
+    Stderr evidence for BASELINE rows 1-2: the directory policy's hop win
+    at scale, and graceful degradation (redirects + dial fallback, zero
+    failures) when the directory serves a poisoned stale snapshot.
+    """
+    import asyncio
+
+    from rio_tpu.utils.routing_live import measure_route_hops_scaled
+
+    out = asyncio.run(measure_route_hops_scaled())
+    print(
+        f"# scaled routing ({out['n_servers']} servers, {out['n_objects']} objects, "
+        f"{out['displaced']} displaced on {out['dead_servers']} killed nodes, {out['wrong']} wrong "
+        f"pointers): reference mean={out['reference']['mean']} "
+        f"p99={out['reference']['p99']:.0f} | directory mean={out['directory']['mean']} "
+        f"p99={out['directory']['p99']:.0f} | STALE directory "
+        f"mean={out['stale']['mean']} p99={out['stale']['p99']:.0f} "
+        f"failures={out['stale_failures']}",
+        file=sys.stderr,
+    )
+
+
 def live_route_hops() -> dict:
     """p99 route hops measured across real TCP round trips (8 servers)."""
     import asyncio
@@ -330,6 +354,10 @@ def main() -> None:
         rpc_throughput()
     except Exception as e:
         print(f"# rpc throughput failed: {e!r}", file=sys.stderr)
+    try:
+        scaled_route_hops()
+    except Exception as e:
+        print(f"# scaled routing failed: {e!r}", file=sys.stderr)
     try:
         hops = live_route_hops()
         hop_str = (

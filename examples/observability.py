@@ -12,6 +12,15 @@ subscriber layers are. This demo registers two sinks:
 * an in-process aggregator standing where an OTLP exporter would go —
   any callable ``Span -> None`` can forward to a collector.
 
+Spans carry contextvar-propagated ``trace_id``/``span_id``/``parent_id``:
+one request's ``request`` → ``placement_lookup`` → ``object_activate`` →
+``handler_dispatch`` spans share a trace, exactly like the reference's
+nested ``tracing`` spans. With the optional OpenTelemetry packages
+installed, the real exporter is one line::
+
+    from rio_tpu.otel import otlp_sink
+    tracing.add_sink(otlp_sink("http://jaeger:4317"))
+
 Run::
 
     python examples/observability.py
@@ -62,9 +71,11 @@ class SpanAggregator:
 
     def __init__(self) -> None:
         self.durations: dict[str, list[float]] = defaultdict(list)
+        self.traces: dict[str, list[tracing.Span]] = defaultdict(list)
 
     def __call__(self, span: tracing.Span) -> None:
         self.durations[span.name].append(span.duration * 1e3)
+        self.traces[span.trace_id].append(span)
 
     def report(self) -> None:
         print(f"{'span':<28}{'count':>6}{'mean ms':>10}{'p99 ms':>10}")
@@ -72,6 +83,21 @@ class SpanAggregator:
             d = self.durations[name]
             p99 = statistics.quantiles(d, n=100)[98] if len(d) >= 2 else d[0]
             print(f"{name:<28}{len(d):>6}{statistics.fmean(d):>10.3f}{p99:>10.3f}")
+
+    def show_one_trace(self) -> None:
+        """Render one request's correlated span tree (what Jaeger shows)."""
+        trace_id, spans = max(self.traces.items(), key=lambda kv: len(kv[1]))
+        by_id = {s.span_id: s for s in spans}
+        print(f"\n[trace] one correlated request (trace {trace_id[:16]}…):")
+
+        def walk(span: tracing.Span, depth: int) -> None:
+            print(f"  {'  ' * depth}{span.name:<26} {span.duration * 1e3:8.3f} ms")
+            for child in sorted(spans, key=lambda s: s.start):
+                if child.parent_id == span.span_id:
+                    walk(child, depth + 1)
+
+        for root in [s for s in spans if s.parent_id not in by_id]:
+            walk(root, 0)
 
 
 async def main() -> None:
@@ -107,6 +133,7 @@ async def main() -> None:
 
     print("\n[trace] span summary (what an OTLP exporter would ship):")
     aggregator.report()
+    aggregator.show_one_trace()
     tracing.clear_sinks()
     print("[demo] done")
 

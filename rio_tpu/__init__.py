@@ -27,7 +27,7 @@ from .registry.declarative import RegistryDeclaration, make_registry
 from .server import Server
 from .service_object import LifecycleKind, LifecycleMessage, ServiceObject
 
-__version__ = "0.1.0"
+__version__ = "0.7.2"  # tracks the surveyed reference version (pyproject.toml)
 
 __all__ = [
     "AppData",

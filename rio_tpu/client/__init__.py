@@ -139,6 +139,7 @@ class ClientStats:
     requests: int = 0
     roundtrips: int = 0
     redirects: int = 0
+    dial_failures: int = 0  # attempts that died before a response (dead addr)
 
 
 class Client:
@@ -224,22 +225,36 @@ class Client:
             if pool:
                 pool.close()
 
-    async def _pick_address(self, handler_type: str, handler_id: str) -> str:
+    async def _pick_address(
+        self, handler_type: str, handler_id: str, avoid: set[str] | None = None
+    ) -> str:
+        """Routing decision for one attempt.
+
+        ``avoid`` carries addresses that already failed *for this request*
+        (dial failure / disconnect): a cached or resolver answer in that set
+        is ignored — a directory serving a stale snapshot that points at a
+        dead node must degrade to the reference's random-pick policy, not
+        pin the request to the dead answer until retries exhaust.
+        """
         cached = self._placement.get((handler_type, handler_id))
-        if cached is not None:
+        if cached is not None and (avoid is None or cached not in avoid):
             return cached
         if self._placement_resolver is not None:
             # Directory policy: ask the shared placement directory for the
             # owner before dialing anyone. A stale/None answer falls through
             # to the reference policy below; a wrong one costs one redirect.
             resolved = await self._placement_resolver(handler_type, handler_id)
-            if resolved is not None:
+            if resolved is not None and (avoid is None or resolved not in avoid):
                 return resolved
         servers = await self.fetch_active_servers()
         if not servers:
             servers = await self.fetch_active_servers(refresh=True)
         if not servers:
             raise ServerNotAvailable("no active servers in membership view")
+        if avoid:
+            alive = [s for s in servers if s not in avoid]
+            if alive:
+                servers = alive
         # Random pick on cache miss (reference client/mod.rs:255-262); the
         # receiving server self-assigns or redirects us to the owner.
         return random.choice(servers)
@@ -255,10 +270,12 @@ class Client:
         self.stats.requests += 1
         last: BaseException | None = None
         attempts = 0
+        avoid: set[str] = set()  # addresses that failed for THIS request
         for delay in self._backoff.delays():
             attempts += 1
+            address = None
             try:
-                address = await self._pick_address(handler_type, handler_id)
+                address = await self._pick_address(handler_type, handler_id, avoid)
                 pool = self._pool(address)
                 conn = await pool.acquire()
                 try:
@@ -270,6 +287,9 @@ class Client:
                 self.stats.roundtrips += 1
             except (ServerNotAvailable, Disconnect, OSError) as e:
                 last = e
+                if address is not None:  # a real network attempt died
+                    self.stats.dial_failures += 1
+                    avoid.add(address)
                 self._placement.pop(key)
                 self._invalidate(None)
                 await asyncio.sleep(delay)
@@ -283,7 +303,11 @@ class Client:
             if err.kind == ErrorKind.REDIRECT:
                 # Authoritative owner elsewhere: note it and retry there
                 # immediately (no backoff — reference tower_services.rs:158-167).
+                # A redirect target overrides an earlier dial failure to the
+                # same address (one dropped pooled connection must not ban a
+                # healthy owner for the request's remaining attempts).
                 self.stats.redirects += 1
+                avoid.discard(err.detail)
                 self._placement.put(key, err.detail)
                 continue
             if err.kind in (ErrorKind.DEALLOCATE, ErrorKind.ALLOCATE):

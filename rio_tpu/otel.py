@@ -1,0 +1,84 @@
+"""Optional OpenTelemetry bridge for :mod:`rio_tpu.tracing`.
+
+Reference: the observability example exports `tracing` spans via OTLP to
+Jaeger (``examples/observability/src/bin/observability_server.rs:37-63`` +
+``compose.yaml``).  rio-tpu's equivalent: ``add_sink(otlp_sink(...))``
+forwards every finished :class:`~rio_tpu.tracing.Span` — with its
+trace/span/parent correlation ids — through the ``opentelemetry`` SDK.
+
+The dependency is optional (``pip install rio-tpu[otel]`` style); importing
+this module without it raises a clear error, and nothing else in the
+framework touches it.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from .tracing import Span
+
+
+def otlp_sink(
+    endpoint: str = "http://127.0.0.1:4317",
+    service_name: str = "rio-tpu",
+) -> Callable[[Span], None]:
+    """Build a span sink that exports over OTLP/gRPC.
+
+    Usage::
+
+        from rio_tpu import tracing
+        from rio_tpu.otel import otlp_sink
+        tracing.add_sink(otlp_sink("http://jaeger:4317"))
+
+    Raises ``ImportError`` with install guidance when the optional
+    ``opentelemetry-sdk``/``opentelemetry-exporter-otlp`` packages are
+    absent.
+    """
+    try:
+        from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (
+            OTLPSpanExporter,
+        )
+        from opentelemetry.sdk.resources import Resource
+        from opentelemetry.sdk.trace import TracerProvider
+        from opentelemetry.sdk.trace.export import BatchSpanProcessor
+    except ImportError as e:  # pragma: no cover - env without otel
+        raise ImportError(
+            "otlp_sink requires the optional OpenTelemetry packages: "
+            "pip install opentelemetry-sdk opentelemetry-exporter-otlp"
+        ) from e
+
+    provider = TracerProvider(
+        resource=Resource.create({"service.name": service_name})
+    )
+    provider.add_span_processor(BatchSpanProcessor(OTLPSpanExporter(endpoint=endpoint)))
+    tracer = provider.get_tracer("rio_tpu")
+
+    return _SdkSink(tracer)
+
+
+class _SdkSink:
+    """Replays finished rio-tpu spans into an OTel tracer.
+
+    rio-tpu spans arrive at the sink *after* they finish (children before
+    parents), so the bridge recreates each as an explicit-timestamp OTel
+    span carrying the original correlation ids as attributes — Jaeger/Tempo
+    then group and order them by ``rio.trace_id``/``rio.parent_id``. (The
+    SDK's own ids can't be forced from outside its context API; attributes
+    keep the correlation exact.)
+    """
+
+    def __init__(self, tracer) -> None:
+        self._tracer = tracer
+
+    def __call__(self, span: Span) -> None:
+        start_ns = int(span.wall_start * 1e9)
+        otel_span = self._tracer.start_span(span.name, start_time=start_ns)
+        otel_span.set_attribute("rio.trace_id", span.trace_id)
+        otel_span.set_attribute("rio.span_id", span.span_id)
+        if span.parent_id:
+            otel_span.set_attribute("rio.parent_id", span.parent_id)
+        for key, value in span.attrs.items():
+            if not isinstance(value, (str, bool, int, float)):
+                value = str(value)
+            otel_span.set_attribute(key, value)
+        otel_span.end(end_time=start_ns + int(span.duration * 1e9))

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 from dataclasses import dataclass
 
 from .cluster.storage import MembershipStorage
@@ -34,6 +35,7 @@ class PlacementDaemonStats:
     polls: int = 0
     liveness_changes: int = 0
     rebalances: int = 0
+    rebalances_skipped: int = 0  # sibling daemon on a shared provider won
     moves: int = 0
     errors: int = 0
 
@@ -81,6 +83,10 @@ class PlacementDaemon:
         members = await self.members_storage.members()
         return frozenset((m.address, bool(m.active)) for m in members), members
 
+    def _solve_epoch(self):
+        """The provider's committed-solve epoch, when it exposes one."""
+        return getattr(getattr(self.placement, "stats", None), "epoch", None)
+
     async def run(self) -> None:
         """Poll loop; runs until cancelled (a Server.run child task)."""
         if not self.supported:
@@ -106,14 +112,24 @@ class PlacementDaemon:
                         await asyncio.sleep(cfg.poll_interval)
                         continue
                     self.stats.liveness_changes += 1
-                    # Debounce a churn burst into one solve.
-                    await asyncio.sleep(cfg.debounce)
+                    solve_epoch = self._solve_epoch()
+                    # Debounce a churn burst into one solve; the random
+                    # jitter staggers the daemons of co-located servers
+                    # sharing one provider so one of them solves first.
+                    await asyncio.sleep(cfg.debounce * (1 + random.random()))
                     liveness, members = await self._liveness()
                     self._last_liveness = liveness
                     self.placement.sync_members(members)
                     wait = last_rebalance + cfg.min_rebalance_interval - loop.time()
                     if wait > 0:
                         await asyncio.sleep(wait)
+                    if solve_epoch is not None and self._solve_epoch() != solve_epoch:
+                        # A sibling daemon on the SAME provider already
+                        # solved this churn event — don't dispatch another
+                        # device solve just to have it epoch-discarded.
+                        self.stats.rebalances_skipped += 1
+                        await asyncio.sleep(cfg.poll_interval)
+                        continue
                     moved = await self.placement.rebalance(mode=cfg.mode)
                     last_rebalance = loop.time()
                     self.stats.rebalances += 1

@@ -132,6 +132,11 @@ class Service:
     # ------------------------------------------------------------------
 
     async def call(self, req: RequestEnvelope) -> ResponseEnvelope:
+        """One request end-to-end; roots the trace its child spans join."""
+        with span("request", object=req.handler_type, id=req.handler_id):
+            return await self._call(req)
+
+    async def _call(self, req: RequestEnvelope) -> ResponseEnvelope:
         object_id = ObjectId(req.handler_type, req.handler_id)
         if not self.registry.has_type(req.handler_type):
             return ResponseEnvelope.err(ResponseError.not_supported(req.handler_type))
@@ -157,7 +162,13 @@ class Service:
             if self._observe is not None:
                 # Feed the affinity tracker: this node served this object
                 # (reference has no counterpart — placement there is random).
-                self._observe(f"{req.handler_type}.{req.handler_id}", self.address)
+                # Guarded like trace sinks: an observer bug must not be
+                # mistaken for a handler panic (which would deallocate a
+                # healthy object and fail an already-served request).
+                try:
+                    self._observe(f"{req.handler_type}.{req.handler_id}", self.address)
+                except Exception:
+                    log.exception("dispatch observer failed")
             return ResponseEnvelope.ok(body)
         except ApplicationRaised as e:
             # Typed user error: object stays alive (reference Err path).

@@ -10,7 +10,9 @@ application the same way the reference wires ``tracing_subscriber``).
 
 from __future__ import annotations
 
+import contextvars
 import logging
+import random
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
@@ -20,6 +22,21 @@ log = logging.getLogger("rio_tpu.trace")
 _SINKS: list[Callable[["Span"], None]] = []
 _ENABLED = False
 
+# Active (trace_id, span_id), propagated through awaits by contextvars —
+# the stand-in for the reference's nested `tracing` span contexts
+# (service.rs:192-369): a request's placement→activate→dispatch spans all
+# share one trace and point at their parent.
+_CTX: contextvars.ContextVar[tuple[str, str] | None] = contextvars.ContextVar(
+    "rio_tpu_trace", default=None
+)
+_rand = random.Random()
+
+
+def current_trace_id() -> str | None:
+    """The active trace id (e.g. to stamp application log lines)."""
+    ctx = _CTX.get()
+    return ctx[0] if ctx else None
+
 
 @dataclass
 class Span:
@@ -27,6 +44,12 @@ class Span:
     attrs: dict[str, Any] = field(default_factory=dict)
     start: float = 0.0
     duration: float = 0.0
+    # W3C-style correlation ids (hex; 128-bit trace, 64-bit span). Filled
+    # only on the sinked path — the null path never allocates ids.
+    trace_id: str = ""
+    span_id: str = ""
+    parent_id: str = ""
+    wall_start: float = 0.0  # unix seconds (exporters need wall clock)
 
 
 def add_sink(sink: Callable[[Span], None]) -> None:
@@ -62,18 +85,28 @@ _NULL_SPAN = _NullSpan()
 
 
 class _LiveSpan:
-    __slots__ = ("_span",)
+    __slots__ = ("_span", "_token")
 
     def __init__(self, name: str, attrs: dict[str, Any]) -> None:
         self._span = Span(name=name, attrs=attrs)
 
     def __enter__(self) -> Span:
-        self._span.start = time.perf_counter()
-        return self._span
+        s = self._span
+        parent = _CTX.get()
+        if parent is None:
+            s.trace_id = f"{_rand.getrandbits(128):032x}"
+        else:
+            s.trace_id, s.parent_id = parent
+        s.span_id = f"{_rand.getrandbits(64):016x}"
+        self._token = _CTX.set((s.trace_id, s.span_id))
+        s.wall_start = time.time()
+        s.start = time.perf_counter()
+        return s
 
     def __exit__(self, *exc) -> bool:
         s = self._span
         s.duration = time.perf_counter() - s.start
+        _CTX.reset(self._token)
         for sink in _SINKS:
             try:
                 sink(s)

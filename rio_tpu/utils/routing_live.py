@@ -29,7 +29,7 @@ from dataclasses import dataclass
 from .. import AppData, Client, LocalObjectPlacement, LocalStorage, Registry, Server
 from .. import ServiceObject, handler, message
 from ..cluster.membership_protocol import LocalClusterProvider
-from ..registry import ObjectId
+from ..registry import ObjectId, type_id
 
 
 @message(name="routing_live.Echo")
@@ -78,10 +78,11 @@ async def boot_echo_cluster(
     transport: str = "asyncio",
     placement=None,
 ):
-    """Boot N echo servers on loopback; returns (members, placement, tasks).
+    """Boot N echo servers on loopback.
 
-    Shared helper for the measured benchmarks (route hops, RPC throughput).
-    Callers cancel the returned tasks to tear the cluster down.
+    Returns ``(members, placement, tasks, servers)``. Shared helper for the
+    measured benchmarks (route hops, RPC throughput). Callers cancel the
+    returned tasks to tear the cluster down.
     """
     members = LocalStorage()
     placement = placement if placement is not None else LocalObjectPlacement()
@@ -112,7 +113,7 @@ async def boot_echo_cluster(
             t.cancel()
         await asyncio.gather(*tasks, return_exceptions=True)
         raise
-    return members, placement, tasks
+    return members, placement, tasks, servers
 
 
 async def measure_route_hops_live(
@@ -129,7 +130,7 @@ async def measure_route_hops_live(
     so every request exercises the cache-miss routing decision — the case
     the policies differ on.
     """
-    members, placement, tasks = await boot_echo_cluster(
+    members, placement, tasks, _servers = await boot_echo_cluster(
         n_servers, transport=transport
     )
     try:
@@ -165,6 +166,136 @@ async def measure_route_hops_live(
         await asyncio.gather(*tasks, return_exceptions=True)
 
 
+async def measure_route_hops_scaled(
+    *,
+    n_servers: int = 64,
+    n_objects: int = 50_000,
+    wrong_fraction: float = 0.08,
+    dead_servers: int = 4,
+    seed: int = 0,
+    sample_size: int = 8_000,
+) -> dict:
+    """Large-scale live routing evidence, including graceful degradation.
+
+    Boots ``n_servers`` real servers, allocates ``n_objects`` actors, then
+    measures per-request roundtrips (exact, sequential, over a shuffled
+    ``sample_size`` sample of the live population) under three policies:
+
+    * ``reference`` — random pick on cache miss (the reference policy,
+      ``client/mod.rs:255-262``);
+    * ``directory`` — fresh shared-directory resolver (rio-tpu policy);
+    * ``stale``     — the SAME directory policy fed a frozen snapshot
+      poisoned two ways: ``wrong_fraction`` of entries point at the wrong
+      (live) node, and every object owned by ``dead_servers`` killed nodes
+      still points at its dead address. This is the claim BASELINE rows
+      1-2 actually make: a stale directory must degrade to redirects and
+      dial-failure fallback (bounded extra hops), never to failed requests.
+
+    Returns ``{"reference"|"directory"|"stale": LiveHopStats-as-dict,
+    "stale_failures": int, "n_servers": int, "n_objects": int,
+    "displaced": int, "wrong": int}``.
+    """
+    members, placement, tasks, servers = await boot_echo_cluster(n_servers)
+    rng = _random.Random(seed)
+    try:
+        ids = [f"obj-{i}" for i in range(n_objects)]
+        setup = Client(members)
+        # Allocate the population concurrently (placement + activation out
+        # of the measured region).
+        for base in range(0, n_objects, 512):
+            await asyncio.gather(
+                *[
+                    setup.send(EchoActor, oid, Echo(value=1), returns=Echo)
+                    for oid in ids[base : base + 512]
+                ]
+            )
+        setup.close()
+
+        tname = type_id(EchoActor)
+        addresses = [await placement.lookup(ObjectId(tname, oid)) for oid in ids]
+        snapshot = {o: a for o, a in zip(ids, addresses) if a is not None}
+
+        async def measure_seq(resolver, sample: list[str]) -> tuple[LiveHopStats, int]:
+            client = Client(members, placement_resolver=resolver)
+            hops: list[int] = []
+            failures = 0
+            for oid in sample:
+                # A "hop" is any network attempt: completed roundtrips plus
+                # dials that died on a dead address (the stale-directory
+                # cost would be invisible without them).
+                before = client.stats.roundtrips + client.stats.dial_failures
+                try:
+                    await client.send(EchoActor, oid, Echo(value=2), returns=Echo)
+                    hops.append(
+                        client.stats.roundtrips + client.stats.dial_failures - before
+                    )
+                except Exception:
+                    failures += 1
+            client.close()
+            return _stats(hops) if hops else _stats([0]), failures
+
+        sample = list(ids)
+        rng.shuffle(sample)
+        sample = sample[: min(n_objects, sample_size)]
+
+        reference, _ = await measure_seq(None, sample)
+
+        async def fresh_resolver(handler_type: str, handler_id: str) -> str | None:
+            return await placement.lookup(ObjectId(handler_type, handler_id))
+
+        directory, _ = await measure_seq(fresh_resolver, sample)
+
+        # ---- staleness: kill nodes + poison the frozen snapshot ---------
+        live_addrs = sorted(snapshot.values())
+        victims = {s.local_address for s in servers[:dead_servers]}
+        displaced = [o for o, a in snapshot.items() if a in victims]
+        pool = sorted(set(live_addrs) - victims)
+        n_wrong = int(len(snapshot) * wrong_fraction)
+        wrong = 0
+        for oid in rng.sample(ids, n_wrong):
+            cur = snapshot.get(oid)
+            others = [a for a in pool if a != cur]
+            if cur is not None and cur not in victims and others:
+                snapshot[oid] = rng.choice(others)
+                wrong += 1
+
+        # Kill the victims for real; mark them dead in membership (the
+        # LocalClusterProvider has no failure detector) and let the REACTIVE
+        # path re-materialize their objects on first touch — the stale run
+        # below is that first touch for most of them.
+        for srv, task in zip(servers, tasks):
+            if srv.local_address in victims:
+                task.cancel()
+        await asyncio.gather(
+            *[t for s, t in zip(servers, tasks) if s.local_address in victims],
+            return_exceptions=True,
+        )
+        for v in victims:
+            host, _, port = v.rpartition(":")
+            await members.set_inactive(host, int(port))
+
+        async def stale_resolver(handler_type: str, handler_id: str) -> str | None:
+            return snapshot.get(handler_id)
+
+        stale, stale_failures = await measure_seq(stale_resolver, sample)
+
+        return {
+            "reference": reference.as_dict(),
+            "directory": directory.as_dict(),
+            "stale": stale.as_dict(),
+            "stale_failures": stale_failures,
+            "n_servers": n_servers,
+            "n_objects": n_objects,
+            "dead_servers": dead_servers,
+            "displaced": len(displaced),
+            "wrong": wrong,
+        }
+    finally:
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+
 async def measure_rpc_throughput(
     *,
     n_servers: int = 2,
@@ -184,7 +315,7 @@ async def measure_rpc_throughput(
     """
     import time
 
-    members, _placement, tasks = await boot_echo_cluster(
+    members, _placement, tasks, _servers = await boot_echo_cluster(
         n_servers, transport=transport
     )
     client = Client(members, transport=transport)

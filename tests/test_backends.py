@@ -196,6 +196,37 @@ async def test_redis_backends():
         await server.stop()
 
 
+REDIS_ADDR = os.environ.get("RIO_TPU_REDIS_ADDR", "")
+
+
+@pytest.mark.skipif(
+    not REDIS_ADDR,
+    reason="real-backend lane: set RIO_TPU_REDIS_ADDR (see compose.yaml)",
+)
+@pytest.mark.asyncio
+async def test_redis_backends_real_server():
+    """The same matrix as above against a REAL valkey/redis server.
+
+    The reference runs valkey in CI for every redis test
+    (``compose.yaml`` + ``.config/nextest.toml:1-11``); this is the
+    opt-in equivalent: ``docker compose up -d`` then set
+    ``RIO_TPU_REDIS_ADDR=127.0.0.1:16379``. Key-prefix isolation keeps
+    reruns independent (reference ``cluster_storage_backend.rs:50``).
+    """
+    import uuid
+
+    host, _, port = REDIS_ADDR.rpartition(":")
+    client = RedisClient(host or "127.0.0.1", int(port or 6379))
+    assert await client.ping()
+    prefix = f"riotpu_{uuid.uuid4().hex[:8]}"
+    try:
+        await check_membership(RedisMembershipStorage(client, key_prefix=f"{prefix}_mem"))
+        await check_placement(RedisObjectPlacement(client, key_prefix=f"{prefix}_place"))
+        await check_state(RedisState(client, key_prefix=f"{prefix}_state"))
+    finally:
+        client.close()
+
+
 # ---------------------------------------------------------------------------
 # postgres backends — driver-gated like the reference's `postgres` cargo
 # feature; the full matrix runs only where a driver + server exist

@@ -33,3 +33,25 @@ async def test_directory_policy_hops_are_exactly_one():
     ours = stats["rio_tpu"]
     # With a fresh directory and no churn, every directory dial is exact.
     assert ours.mean == 1.0 and ours.p99 == 1.0, ours
+
+
+def test_stale_directory_degrades_gracefully():
+    """A poisoned directory snapshot costs bounded hops, never failures.
+
+    16 servers, 4 of them killed after allocation; the stale resolver still
+    points displaced objects at dead addresses and 8% of the rest at wrong
+    live nodes. Every request must still succeed (redirect-follow +
+    dial-failure fallback), and the fresh-directory policy stays at 1 hop.
+    """
+    import asyncio as _asyncio
+
+    from rio_tpu.utils.routing_live import measure_route_hops_scaled
+
+    out = _asyncio.run(
+        measure_route_hops_scaled(n_servers=16, n_objects=2000, sample_size=800)
+    )
+    assert out["stale_failures"] == 0
+    assert out["directory"]["mean"] == 1.0
+    assert out["stale"]["p99"] <= 4  # dead dial + fallback + possible redirect
+    assert out["reference"]["mean"] > out["directory"]["mean"]
+    assert out["displaced"] > 0 and out["wrong"] > 0

@@ -1,0 +1,163 @@
+"""Span correlation ids + sink behavior (reference: nested `tracing` spans,
+service.rs:192-369, exported via OTLP in the observability example)."""
+
+import asyncio
+
+import pytest
+
+from rio_tpu import tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_sinks():
+    tracing.clear_sinks()
+    yield
+    tracing.clear_sinks()
+
+
+def test_null_path_is_shared_and_silent():
+    got = tracing.span("anything", key="value")
+    assert got is tracing.span("other")  # one shared null object
+    with got as s:
+        assert s is None
+    assert tracing.current_trace_id() is None
+
+
+def test_parent_child_correlation():
+    seen = []
+    tracing.add_sink(seen.append)
+    with tracing.span("parent") as p:
+        assert tracing.current_trace_id() == p.trace_id
+        with tracing.span("child") as c:
+            assert c.trace_id == p.trace_id
+            assert c.parent_id == p.span_id
+        with tracing.span("sibling") as s2:
+            assert s2.parent_id == p.span_id
+    assert tracing.current_trace_id() is None
+    assert [s.name for s in seen] == ["child", "sibling", "parent"]
+    assert len({s.span_id for s in seen}) == 3
+    assert len(seen[0].trace_id) == 32 and len(seen[0].span_id) == 16
+
+
+def test_propagation_across_awaits_and_tasks():
+    """contextvars carry the trace through awaits; tasks inherit a snapshot."""
+
+    async def main():
+        tracing.add_sink(lambda s: None)
+        with tracing.span("root") as root:
+
+            async def child_task():
+                with tracing.span("in-task") as s:
+                    return s.trace_id, s.parent_id
+
+            trace_id, parent_id = await asyncio.create_task(child_task())
+            assert trace_id == root.trace_id
+            assert parent_id == root.span_id
+
+    asyncio.run(main())
+
+
+def test_concurrent_tasks_get_distinct_traces():
+    async def main():
+        tracing.add_sink(lambda s: None)
+
+        async def one():
+            with tracing.span("r") as s:
+                await asyncio.sleep(0.01)
+                assert tracing.current_trace_id() == s.trace_id
+                return s.trace_id
+
+        ids = await asyncio.gather(*[one() for _ in range(8)])
+        assert len(set(ids)) == 8
+
+    asyncio.run(main())
+
+
+def test_sink_exception_does_not_break_request():
+    def bad_sink(span):
+        raise RuntimeError("boom")
+
+    tracing.add_sink(bad_sink)
+    with tracing.span("guarded"):
+        pass  # must not raise
+
+
+def test_otel_bridge():
+    """The SDK bridge replays rio-tpu spans with ids, attrs, and timestamps.
+
+    Runs against the real opentelemetry SDK when installed (it is in the dev
+    env) via an in-memory exporter; otherwise asserts the clean ImportError.
+    """
+    try:
+        from opentelemetry.sdk.trace import TracerProvider
+        from opentelemetry.sdk.trace.export import SimpleSpanProcessor
+        from opentelemetry.sdk.trace.export.in_memory_span_exporter import (
+            InMemorySpanExporter,
+        )
+    except ImportError:
+        from rio_tpu.otel import otlp_sink
+
+        with pytest.raises(ImportError, match="opentelemetry"):
+            otlp_sink()
+        return
+
+    from rio_tpu.otel import _SdkSink
+
+    provider = TracerProvider()
+    exporter = InMemorySpanExporter()
+    provider.add_span_processor(SimpleSpanProcessor(exporter))
+    sink = _SdkSink(provider.get_tracer("test"))
+    tracing.add_sink(sink)
+    with tracing.span("outer", object="Obj.1"):
+        with tracing.span("inner", n=3):
+            pass
+    spans = {s.name: s for s in exporter.get_finished_spans()}
+    assert set(spans) == {"outer", "inner"}
+    inner, outer = spans["inner"], spans["outer"]
+    assert inner.attributes["rio.trace_id"] == outer.attributes["rio.trace_id"]
+    assert inner.attributes["rio.parent_id"] == outer.attributes["rio.span_id"]
+    assert inner.attributes["n"] == 3
+    assert outer.attributes["object"] == "Obj.1"
+    assert outer.end_time >= outer.start_time > 0
+
+
+def test_request_path_spans_share_one_trace():
+    """The service request root correlates placement/activate/dispatch."""
+    from collections import defaultdict
+
+    from rio_tpu import AppData, LocalObjectPlacement, LocalStorage, Registry
+    from rio_tpu import ServiceObject, handler, message
+    from rio_tpu.cluster.storage import Member
+    from rio_tpu.protocol import RequestEnvelope
+    from rio_tpu.service import Service
+    from rio_tpu import codec
+
+    @message(name="trace.Hit")
+    class Hit:
+        pass
+
+    class Traced(ServiceObject):
+        @handler
+        async def hit(self, msg: Hit, ctx: AppData) -> Hit:
+            return msg
+
+    traces = defaultdict(list)
+    tracing.add_sink(lambda s: traces[s.trace_id].append(s.name))
+
+    async def main():
+        members = LocalStorage()
+        await members.push(Member.from_address("127.0.0.1:7001", active=True))
+        svc = Service(
+            address="127.0.0.1:7001",
+            registry=Registry().add_type(Traced),
+            object_placement=LocalObjectPlacement(),
+            members_storage=members,
+            app_data=AppData(),
+        )
+        env = RequestEnvelope("Traced", "t1", "trace.Hit", codec.serialize(Hit()))
+        resp = await svc.call(env)
+        assert resp.is_ok
+
+    asyncio.run(main())
+    (names,) = [v for v in traces.values() if "request" in v]
+    assert set(names) >= {"request", "placement_lookup", "handler_dispatch"}
