@@ -36,7 +36,7 @@ import threading
 import time
 
 N_NODES = 1024
-CHUNK = 8192  # rows per rounding chunk (bounds rounding memory)
+CHUNK = 65536  # rows per rounding chunk (bounds rounding temps to ~256 MB)
 
 EXIT_INIT_FAIL = 97  # jax backend never came up — do not try more TPU tiers
 EXIT_SOLVE_FAIL = 98  # tier failed (e.g. OOM) — a smaller tier may fit
@@ -73,7 +73,7 @@ def sqlite_baseline_rate(n_samples: int = 5000) -> float:
     return n_samples / (time.perf_counter() - t0)
 
 
-def scaled_route_hops() -> None:
+def scaled_route_hops() -> dict:
     """64-server x 50k-object live routing + stale-directory degradation.
 
     Stderr evidence for BASELINE rows 1-2: the directory policy's hop win
@@ -95,6 +95,7 @@ def scaled_route_hops() -> None:
         f"failures={out['stale_failures']}",
         file=sys.stderr,
     )
+    return out
 
 
 def live_route_hops() -> dict:
@@ -128,54 +129,126 @@ def _arm_watchdog(seconds: float, code: int) -> threading.Timer:
     return t
 
 
-def _solve_rate(n_obj: int, kernel_dtype) -> tuple[float, float]:
-    """Placements/sec for the on-device OT solve; returns (rate, compile_s).
+def _solve_rate(n_obj: int, kernel_dtype, n_nodes: int = N_NODES) -> dict:
+    """On-device OT solve throughput; returns a result dict.
 
-    Uses the scaling-form solver (``rio_tpu/ops/scaling.py``): K = exp(-C/eps)
-    is built once and each iteration is two matrix-vector products — no
-    per-iteration transcendentals, bandwidth-bound on reading K.
+    Uses the scaling-form core (``rio_tpu/ops/scaling.py``): K = exp(-C/eps)
+    is built once, each iteration is two matrix-vector products, and the
+    capacity-aware rounding pass REUSES K (bf16) instead of re-reading the
+    fp32 cost — no per-iteration transcendentals anywhere, bandwidth-bound
+    on K alone. Reports the sinkhorn-only rate too, so the rounding share
+    stays visible.
     """
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    from rio_tpu.ops import plan_rounded_assign, scaling_sinkhorn
+    from rio_tpu.ops import plan_rounded_assign_from_scaling, scaling_core
 
-    def step(cost, mass, cap):
-        res = scaling_sinkhorn(
+    def solve_only(cost, mass, cap):
+        u, v, K, _ = scaling_core(
             cost, mass, cap, eps=0.05, n_iters=30, kernel_dtype=kernel_dtype
         )
-        # Chunk the rounding pass so its softmax/cumsum temps stay bounded.
-        n_chunks = cost.shape[0] // CHUNK
-        cost_c = cost.reshape(n_chunks, CHUNK, cost.shape[1])
-        f_c = res.f.reshape(n_chunks, CHUNK)
+        return jnp.sum(u) + jnp.sum(v)
+
+    def step(cost, mass, cap):
+        u, v, K, _ = scaling_core(
+            cost, mass, cap, eps=0.05, n_iters=30, kernel_dtype=kernel_dtype
+        )
+        # Chunk the rounding pass so its cumsum temps stay bounded. NOTE:
+        # quantile ranks are per-chunk, which is only equivalent to global
+        # ranking because every row here is real with identical mass (each
+        # chunk spreads over the same marginals); mixed masses or padding
+        # split across chunks would need an explicit rank offset.
+        chunk = min(CHUNK, n_obj)
+        n_chunks = n_obj // chunk
+        K_c = K.reshape(n_chunks, chunk, n_nodes)
+        u_c = u.reshape(n_chunks, chunk)
 
         def round_chunk(args):
-            c, f = args
-            return plan_rounded_assign(c, f, res.g, 0.05)
+            k, uu = args
+            return plan_rounded_assign_from_scaling(k, uu, v)
 
-        assignment = lax.map(round_chunk, (cost_c, f_c)).reshape(-1)
+        assignment = lax.map(round_chunk, (K_c, u_c)).reshape(-1)
         # Scalar checksum: pulling it to host forces full completion (the
         # axon tunnel's block_until_ready returns before execution finishes).
         return assignment, jnp.sum(assignment)
 
     key = jax.random.PRNGKey(0)
-    cost = jax.random.uniform(key, (n_obj, N_NODES), jnp.float32)
+    cost = jax.random.uniform(key, (n_obj, n_nodes), jnp.float32)
     mass = jnp.ones((n_obj,), jnp.float32)
-    cap = jnp.ones((N_NODES,), jnp.float32)
+    cap = jnp.ones((n_nodes,), jnp.float32)
 
-    fn = jax.jit(step)
+    def timed(fn):
+        t0 = time.perf_counter()
+        chk = fn(cost, mass, cap)
+        jax.block_until_ready(chk)
+        float(jnp.sum(chk[-1]) if isinstance(chk, tuple) else chk)
+        compile_s = time.perf_counter() - t0
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            chk = fn(cost, mass, cap)
+            float(jnp.sum(chk[-1]) if isinstance(chk, tuple) else chk)
+            times.append(time.perf_counter() - t0)
+        return min(times), compile_s
+
+    solve_s, solve_compile = timed(jax.jit(solve_only))
+    full_s, full_compile = timed(jax.jit(step))
+    return {
+        "rate": n_obj / full_s,
+        "full_ms": round(full_s * 1e3, 2),
+        "sinkhorn_ms": round(solve_s * 1e3, 2),
+        "compile_s": round(solve_compile + full_compile, 2),
+        "n_nodes": n_nodes,
+    }
+
+
+def _hier_rate(n_obj: int, n_nodes: int = N_NODES, n_groups: int = 32, d: int = 16) -> dict:
+    """BASELINE row-5 tier: hierarchical 2-level OT at the scale ceiling.
+
+    10M x 1k cannot materialize a flat cost (40 GB fp32); the two-level
+    solve runs in O(N*(G+S+d)) memory (~2.6 GB at 10M) — see
+    ``rio_tpu/parallel/hierarchical.py``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from rio_tpu.parallel.hierarchical import hierarchical_assign
+
+    key = jax.random.PRNGKey(1)
+    k1, k2 = jax.random.split(key)
+    obj_feat = jax.random.normal(k1, (n_obj, d), jnp.float32)
+    node_feat = jax.random.normal(k2, (d, n_nodes), jnp.float32)
+    cap = jnp.ones((n_nodes,), jnp.float32)
+    alive = jnp.ones((n_nodes,), jnp.float32)
+
+    def run():
+        res = hierarchical_assign(
+            obj_feat, node_feat, cap, alive, n_groups=n_groups
+        )
+        return res.assignment, res.overflow
+
     t0 = time.perf_counter()
-    _, chk = fn(cost, mass, cap)
-    float(chk)  # compile + warm
+    _, ovf = run()
+    overflow = int(ovf)  # host pull forces completion
     compile_s = time.perf_counter() - t0
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
-        _, chk = fn(cost, mass, cap)
-        float(chk)
+        _, ovf = run()
+        int(ovf)
         times.append(time.perf_counter() - t0)
-    return n_obj / min(times), compile_s
+    best = min(times)
+    return {
+        "rate": n_obj / best,
+        "full_ms": round(best * 1e3, 2),
+        "n_obj": n_obj,
+        "n_nodes": n_nodes,
+        "n_groups": n_groups,
+        "overflow": overflow,
+        "compile_s": round(compile_s, 2),
+    }
 
 
 def _pallas_smoke(n_obj: int = 65536) -> dict:
@@ -228,6 +301,43 @@ def _pallas_smoke(n_obj: int = 65536) -> dict:
     return out
 
 
+def run_hier_tier(n_obj: int, deadline: float) -> None:
+    """Child entry for the BASELINE row-5 (hierarchical) tier.
+
+    Adaptive sizing against the relay-wedge hazard: measure a quarter-size
+    tier first, project the full tier's cost (4x runtime + a fresh compile
+    — shapes differ, nothing is cached), and only attempt the full size
+    when it fits well inside the deadline. Whatever completed last is the
+    reported tier.
+    """
+    start = time.monotonic()
+    _arm_watchdog(deadline, EXIT_WATCHDOG)
+    probe_timer = _arm_watchdog(min(PROBE_DEADLINE_S, deadline), EXIT_INIT_FAIL)
+    import jax
+
+    try:
+        devices = jax.devices()
+    except Exception as e:
+        print(f"# backend init failed: {type(e).__name__}: {e}", file=sys.stderr)
+        sys.exit(EXIT_INIT_FAIL)
+    probe_timer.cancel()
+    if devices[0].platform != "tpu":
+        sys.exit(EXIT_INIT_FAIL)
+    try:
+        quarter = _hier_rate(n_obj // 4)
+        result = {"ok": True, "kind": "hier", "quarter": quarter}
+        print(json.dumps(result), flush=True)
+        elapsed = time.monotonic() - start
+        projected = 4 * (4 * quarter["full_ms"] / 1e3) + 1.5 * quarter["compile_s"]
+        if elapsed + projected < 0.7 * deadline:
+            full = _hier_rate(n_obj)
+            result["full"] = full
+            print(json.dumps(result), flush=True)
+    except Exception as e:
+        print(f"# hier tier failed: {type(e).__name__}: {e}", file=sys.stderr)
+        sys.exit(EXIT_SOLVE_FAIL)
+
+
 def run_tier(n_obj: int, platform: str, deadline: float, pallas_smoke: bool) -> None:
     """Child entry: probe backend once, run one tier, print JSON result lines.
 
@@ -262,20 +372,34 @@ def run_tier(n_obj: int, platform: str, deadline: float, pallas_smoke: bool) -> 
 
     kernel_dtype = jnp.bfloat16 if platform == "tpu" else jnp.float32
     try:
-        rate, compile_s = _solve_rate(n_obj, kernel_dtype)
+        tier = _solve_rate(n_obj, kernel_dtype)
     except Exception as e:
         print(f"# tier {n_obj} failed: {type(e).__name__}: {e}", file=sys.stderr)
         sys.exit(EXIT_SOLVE_FAIL)
 
     result = {
         "ok": True,
-        "rate": rate,
+        "rate": tier["rate"],
         "n_obj": n_obj,
         "platform": platform,
         "device": str(devices[0]),
-        "compile_s": round(compile_s, 2),
+        **{k: v for k, v in tier.items() if k != "rate"},
     }
     print(json.dumps(result), flush=True)
+    remaining = deadline - (time.monotonic() - start)
+    # BASELINE row 3 is the <50 ms-class config: 1M objects x 256 nodes on
+    # one chip (a quarter of the 1k-node headline's bandwidth). Budget from
+    # the MEASURED headline cost — a watchdog exit mid-TPU-op wedges the
+    # relay, so a stage must never start unless it clearly fits.
+    row3_budget = 60.0 + 10.0 * tier["full_ms"] / 1e3
+    if platform == "tpu" and n_obj >= 1_048_576 and remaining > row3_budget:
+        try:
+            row3 = _solve_rate(1_048_576, kernel_dtype, n_nodes=256)
+            result["baseline_row3_1m_x_256"] = row3
+            print(f"# row-3 tier (1M x 256): {row3}", file=sys.stderr)
+            print(json.dumps(result), flush=True)
+        except Exception as e:
+            print(f"# row-3 tier failed: {type(e).__name__}: {e}", file=sys.stderr)
     remaining = deadline - (time.monotonic() - start)
     if pallas_smoke and platform == "tpu" and remaining > 150:
         try:
@@ -291,7 +415,7 @@ def run_tier(n_obj: int, platform: str, deadline: float, pallas_smoke: bool) -> 
 # ---------------------------------------------------------------------------
 
 
-def _run_child(n_obj: int, platform: str, deadline: float, pallas: bool):
+def _run_child(n_obj: int, platform: str, deadline: float, pallas: bool, hier: bool = False):
     """Run one tier child; returns (rc, parsed_json_or_None)."""
     env = os.environ.copy()
     if platform == "cpu":
@@ -303,6 +427,8 @@ def _run_child(n_obj: int, platform: str, deadline: float, pallas: bool):
         sys.executable, os.path.abspath(__file__),
         "--tier", str(n_obj), "--platform", platform, "--deadline", str(deadline),
     ]
+    if hier:
+        cmd.append("--hier")
     if pallas:
         cmd.append("--pallas-smoke")
     try:
@@ -328,16 +454,18 @@ def _run_child(n_obj: int, platform: str, deadline: float, pallas: bool):
     return proc.returncode, parsed
 
 
-def rpc_throughput() -> None:
-    """Actor data-plane msgs/sec, asyncio vs native transport (stderr only)."""
+def rpc_throughput() -> dict:
+    """Actor data-plane msgs/sec per transport; also printed to stderr."""
     import asyncio
 
     from rio_tpu import native
     from rio_tpu.utils.routing_live import measure_rpc_throughput
 
     transports = ["asyncio"] + (["native"] if native.get() is not None else [])
+    rates = {}
     for transport in transports:
         rate = asyncio.run(measure_rpc_throughput(transport=transport))
+        rates[transport] = round(rate)
         note = ""
         if transport == "native" and not native.engine_profitable():
             note = " (engine demoted: single-core host, thread handoff is pure loss)"
@@ -346,20 +474,24 @@ def rpc_throughput() -> None:
             f"{rate:,.0f} msgs/sec{note}",
             file=sys.stderr,
         )
+    return rates
 
 
 def main() -> None:
+    detail: dict = {}
     baseline = sqlite_baseline_rate()
+    detail["sqlite_baseline_rate"] = round(baseline)
     try:
-        rpc_throughput()
+        detail["rpc_msgs_per_sec"] = rpc_throughput()
     except Exception as e:
         print(f"# rpc throughput failed: {e!r}", file=sys.stderr)
     try:
-        scaled_route_hops()
+        detail["scaled_routing"] = scaled_route_hops()
     except Exception as e:
         print(f"# scaled routing failed: {e!r}", file=sys.stderr)
     try:
         hops = live_route_hops()
+        detail["route_hops"] = hops
         hop_str = (
             f"measured p99 hops {hops['ours']['p99']:.0f} "
             f"vs {hops['reference']['p99']:.0f}"
@@ -368,12 +500,18 @@ def main() -> None:
         print(f"# live hop measurement failed: {e!r}", file=sys.stderr)
         hops, hop_str = None, "hops unmeasured"
 
+    # Pallas smoke is opt-in: a Mosaic compile hang through the axon tunnel
+    # forces a watchdog exit mid-TPU-op, which orphans the chip grant and
+    # wedges the relay for subsequent jax inits (observed r3). Validation
+    # runs are produced manually (PALLAS_TPU.json), not by the driver.
+    pallas = os.environ.get("RIO_TPU_BENCH_PALLAS") == "1"
+
     result = None
     # TPU tiers, largest first. An init failure or watchdog exit means the
     # tunnel is down/wedged — retrying would burn ~25 min per attempt in
     # backend setup (the round-1 failure mode), so abort TPU entirely.
     for n_obj, deadline in ((1_048_576, 420.0), (524_288, 300.0), (262_144, 240.0)):
-        rc, parsed = _run_child(n_obj, "tpu", deadline, pallas=True)
+        rc, parsed = _run_child(n_obj, "tpu", deadline, pallas=pallas)
         if parsed:
             result = parsed
             break
@@ -383,10 +521,27 @@ def main() -> None:
         # EXIT_SOLVE_FAIL (OOM) or EXIT_TIER_TIMEOUT (healthy probe, tier
         # too slow): a smaller tier may still fit the deadline.
         print(f"# tier {n_obj} rc={rc}; trying smaller tier", file=sys.stderr)
+    if result is not None and result.get("platform") == "tpu":
+        # BASELINE row 5 (scale ceiling): hierarchical 2-level OT toward
+        # 10M x 1k, in its OWN child so an overrun can't cost the banked
+        # headline result; the child sizes itself adaptively.
+        rc, hier = _run_child(10_485_760, "tpu", 420.0, pallas=False, hier=True)
+        if hier:
+            detail["baseline_row5_hier"] = hier
+            print(f"# row-5 hier tier: {hier}", file=sys.stderr)
     if result is None:
         rc, parsed = _run_child(131_072, "cpu", 300.0, pallas=False)
         if parsed:
             result = parsed
+    detail["solve_tier"] = result
+    try:
+        with open(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json"),
+            "w",
+        ) as fh:
+            json.dump(detail, fh, indent=1)
+    except OSError as e:  # never let the sidecar kill the headline line
+        print(f"# BENCH_DETAIL.json write failed: {e}", file=sys.stderr)
 
     if result is None:
         # Solve tiers all failed: still emit a real measured number so the
@@ -429,8 +584,11 @@ if __name__ == "__main__":
     parser.add_argument("--platform", choices=("tpu", "cpu"), default="tpu")
     parser.add_argument("--deadline", type=float, default=300.0)
     parser.add_argument("--pallas-smoke", action="store_true")
+    parser.add_argument("--hier", action="store_true")
     args = parser.parse_args()
-    if args.tier is not None:
+    if args.tier is not None and args.hier:
+        run_hier_tier(args.tier, args.deadline)
+    elif args.tier is not None:
         run_tier(args.tier, args.platform, args.deadline, args.pallas_smoke)
     else:
         main()

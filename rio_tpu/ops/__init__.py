@@ -23,9 +23,16 @@ from .pallas_sinkhorn import fused_iteration, pallas_sinkhorn
 from .scaling import (
     fused_scaling_iteration,
     pallas_scaling_sinkhorn,
+    scaling_core,
     scaling_sinkhorn,
 )
-from .sinkhorn import SinkhornResult, plan_rounded_assign, sinkhorn, sinkhorn_assign
+from .sinkhorn import (
+    SinkhornResult,
+    plan_rounded_assign,
+    plan_rounded_assign_from_scaling,
+    sinkhorn,
+    sinkhorn_assign,
+)
 
 __all__ = [
     "SinkhornResult",
@@ -33,11 +40,13 @@ __all__ = [
     "fused_scaling_iteration",
     "pallas_scaling_sinkhorn",
     "pallas_sinkhorn",
+    "scaling_core",
     "scaling_sinkhorn",
     "assign_from_potentials",
     "build_cost_matrix",
     "greedy_balanced_assign",
     "plan_rounded_assign",
+    "plan_rounded_assign_from_scaling",
     "sinkhorn",
     "sinkhorn_assign",
 ]

@@ -57,7 +57,7 @@ def _potentials(u, v, eps):
 
 
 @functools.partial(jax.jit, static_argnames=("eps", "n_iters", "kernel_dtype"))
-def scaling_sinkhorn(
+def scaling_core(
     cost: jax.Array,
     row_mass: jax.Array,
     col_capacity: jax.Array,
@@ -65,22 +65,35 @@ def scaling_sinkhorn(
     eps: float = 0.05,
     n_iters: int = 50,
     kernel_dtype=jnp.bfloat16,
-) -> SinkhornResult:
-    """Sinkhorn-Knopp in scaling form; returns log-domain potentials.
+):
+    """The scaling iteration itself; returns ``(u, v, K, row_shift)``.
 
-    Matches :func:`rio_tpu.ops.sinkhorn.sinkhorn` up to dtype tolerance
-    (use ``kernel_dtype=jnp.float32`` for tightest parity).
+    ``row_shift`` is the (n,) per-row gauge shift subtracted from the cost
+    before exponentiating (add it back to ``eps*log(u)`` to recover ``f``).
+
+    Exposed separately from :func:`scaling_sinkhorn` so capacity-aware
+    rounding can reuse the already-materialized kernel ``K`` (see
+    :func:`rio_tpu.ops.sinkhorn.plan_rounded_assign_from_scaling`): the
+    plan is ``P = diag(u) K diag(v)`` — re-deriving it from the cost
+    matrix would re-read the fp32 cost (2x the bytes of a bf16 K) and
+    re-do a transcendental sweep.
     """
     cost = cost.astype(jnp.float32)
     a, b = normalize_marginals(row_mass, col_capacity)
-    # Global min-shift is pure gauge (scales every u uniformly) and keeps
-    # exp(-C/eps) <= 1, so negative costs can't overflow. High-cost pairs
-    # may underflow to 0 when (range/eps) >> 88 — acceptable (they are
-    # effectively forbidden); for extreme ranges use the log-domain solver.
-    # The shift is folded back into f below so the returned potentials
-    # match the log-domain solver exactly, not just up to gauge.
-    cmin = jnp.min(cost)
-    cost = cost - cmin
+    # PER-ROW min-shift: pure gauge (each row's shift is absorbed into that
+    # row's u), keeps every row's best entry at exp(0)=1 — so no row can
+    # underflow to all-zeros no matter the global cost range (a global
+    # shift breaks down once range/eps >> 88: tail rows lose every entry
+    # and their u explodes; observed at the 10M-object hierarchical tier).
+    # Individual high-cost pairs may still underflow — acceptable, they are
+    # effectively forbidden. The shift is folded back into f by
+    # scaling_sinkhorn so the returned potentials match the log-domain
+    # solver exactly, not just up to gauge.
+    shift = jnp.min(cost, axis=1, keepdims=True)  # (n, 1)
+    # Padding rows of +inf cost would make shift inf -> NaN in K; they
+    # carry no mass, so pin their shift to 0.
+    shift = jnp.where(jnp.isfinite(shift), shift, 0.0)
+    cost = cost - shift
     K = jnp.exp(-cost / eps).astype(kernel_dtype)
 
     def body(carry, _):
@@ -96,9 +109,33 @@ def scaling_sinkhorn(
     u0 = jnp.zeros_like(a)
     v0 = jnp.ones_like(b)
     (u, v), _ = lax.scan(body, (u0, v0), None, length=n_iters)
+    return u, v, K, shift[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "n_iters", "kernel_dtype"))
+def scaling_sinkhorn(
+    cost: jax.Array,
+    row_mass: jax.Array,
+    col_capacity: jax.Array,
+    *,
+    eps: float = 0.05,
+    n_iters: int = 50,
+    kernel_dtype=jnp.bfloat16,
+) -> SinkhornResult:
+    """Sinkhorn-Knopp in scaling form; returns log-domain potentials.
+
+    Matches :func:`rio_tpu.ops.sinkhorn.sinkhorn` up to dtype tolerance
+    (use ``kernel_dtype=jnp.float32`` for tightest parity).
+    """
+    u, v, _, shift = scaling_core(
+        cost, row_mass, col_capacity, eps=eps, n_iters=n_iters,
+        kernel_dtype=kernel_dtype,
+    )
+    cost = cost.astype(jnp.float32) - shift[:, None]
+    _, b = normalize_marginals(row_mass, col_capacity)
     f, g = _potentials(u, v, eps)
     err = marginal_err(cost, f, g, b, eps)  # shifted-cost/shifted-f pair
-    f = jnp.where(jnp.isfinite(f), f + cmin, f)  # undo the gauge shift
+    f = jnp.where(jnp.isfinite(f), f + shift, f)  # undo the gauge shift
     return SinkhornResult(f=f, g=g, err=err)
 
 
@@ -200,8 +237,10 @@ def pallas_scaling_sinkhorn(
     n, m = cost.shape
     cost = cost.astype(jnp.float32)
     a, b = normalize_marginals(row_mass, col_capacity)
-    cmin = jnp.min(cost)
-    cost = cost - cmin  # gauge shift, folded back into f; see scaling_sinkhorn
+    # Per-row gauge shift, folded back into f; see scaling_core.
+    shift = jnp.min(cost, axis=1, keepdims=True)
+    shift = jnp.where(jnp.isfinite(shift), shift, 0.0)
+    cost = cost - shift
     K = jnp.exp(-cost / eps).astype(kernel_dtype)
 
     lane = 128
@@ -227,5 +266,5 @@ def pallas_scaling_sinkhorn(
 
     f, g = _potentials(u[:n], v[:m], eps)
     err = marginal_err(cost, f, g, b, eps)
-    f = jnp.where(jnp.isfinite(f), f + cmin, f)
+    f = jnp.where(jnp.isfinite(f), f + shift[:, 0], f)
     return SinkhornResult(f=f, g=g, err=err)

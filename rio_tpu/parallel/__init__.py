@@ -166,9 +166,13 @@ def sharded_scaling_sinkhorn(
         b = b.astype(jnp.float32)
         a = a / jnp.maximum(lax.psum(jnp.sum(a), "obj"), 1e-30)
         b = b / jnp.maximum(lax.psum(jnp.sum(b), "node"), 1e-30)
-        # Gauge min-shift (global) keeps exp(-C/eps) <= 1; see ops/scaling.py.
-        cmin = lax.pmin(lax.pmin(jnp.min(c), "obj"), "node")
-        K = jnp.exp(-(c - cmin) / eps).astype(kernel_dtype)
+        # PER-ROW gauge shift (pmin across node shards): every row keeps its
+        # best entry at exp(0)=1, so no row underflows to all-zeros however
+        # wide the cost range — same stabilization as scaling_core (a global
+        # shift breaks tail rows once range/eps >> 88); see ops/scaling.py.
+        shift = lax.pmin(jnp.min(c, axis=1, keepdims=True), "node")
+        shift = jnp.where(jnp.isfinite(shift), shift, 0.0)
+        K = jnp.exp(-(c - shift) / eps).astype(kernel_dtype)
 
         def body(carry, _):
             u, v = carry
@@ -187,7 +191,9 @@ def sharded_scaling_sinkhorn(
         u0 = lax.pcast(jnp.zeros(c.shape[0], jnp.float32), ("obj",), to="varying")
         v0 = lax.pcast(jnp.ones(c.shape[1], jnp.float32), ("node",), to="varying")
         (u, v), _ = lax.scan(body, (u0, v0), None, length=n_iters)
-        f = jnp.where(u > 0, eps * jnp.log(jnp.maximum(u, 1e-30)) + cmin, -jnp.inf)
+        f = jnp.where(
+            u > 0, eps * jnp.log(jnp.maximum(u, 1e-30)) + shift[:, 0], -jnp.inf
+        )
         g = jnp.where(v > 0, eps * jnp.log(jnp.maximum(v, 1e-30)), -jnp.inf)
         return f, g
 

@@ -153,3 +153,87 @@ def test_sharded_scaling_matches_single_device():
     )
     np.testing.assert_allclose(np.asarray(g), np.asarray(single.g), rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(np.asarray(f), np.asarray(single.f), rtol=1e-4, atol=1e-4)
+
+
+def test_plan_rounding_from_scaling_state_matches_potential_form():
+    """K-reuse rounding == potential-form rounding (the bench hot path).
+
+    ``plan_rounded_assign_from_scaling`` reads the already-materialized
+    bf16 kernel instead of re-deriving exp((f+g-C)/eps) from the fp32 cost;
+    with a float32 kernel the assignments must be identical, with bfloat16
+    near-identical and equally balanced.
+    """
+    import numpy as np
+
+    from rio_tpu.ops import (
+        plan_rounded_assign,
+        plan_rounded_assign_from_scaling,
+        scaling_core,
+        scaling_sinkhorn,
+    )
+
+    key = jax.random.PRNGKey(3)
+    n, m = 2048, 128
+    cost = jax.random.uniform(key, (n, m))
+    mass, cap = jnp.ones((n,)), jnp.ones((m,))
+    kw = dict(eps=0.05, n_iters=25)
+
+    res = scaling_sinkhorn(cost, mass, cap, kernel_dtype=jnp.float32, **kw)
+    base = np.asarray(plan_rounded_assign(cost, res.f, res.g, 0.05))
+
+    u, v, K, _ = scaling_core(cost, mass, cap, kernel_dtype=jnp.float32, **kw)
+    exact = np.asarray(plan_rounded_assign_from_scaling(K, u, v))
+    assert (exact == base).all()
+
+    u, v, K, _ = scaling_core(cost, mass, cap, kernel_dtype=jnp.bfloat16, **kw)
+    approx = np.asarray(plan_rounded_assign_from_scaling(K, u, v))
+    assert (approx == base).mean() > 0.98
+    loads_base = np.bincount(base, minlength=m)
+    loads_approx = np.bincount(approx, minlength=m)
+    assert abs(int(loads_approx.max()) - int(loads_base.max())) <= 2
+
+
+def test_plan_rounding_from_scaling_padding_and_dead_columns():
+    """Padding rows (u=0) spread over live columns; dead columns never chosen."""
+    import numpy as np
+
+    from rio_tpu.ops import plan_rounded_assign_from_scaling, scaling_core
+
+    key = jax.random.PRNGKey(5)
+    n, m, n_real = 512, 16, 300
+    cost = jax.random.uniform(key, (n, m))
+    mass = jnp.concatenate([jnp.ones((n_real,)), jnp.zeros((n - n_real,))])
+    cap = jnp.concatenate([jnp.ones((m - 4,)), jnp.zeros((4,))])  # 4 dead
+    u, v, K, _ = scaling_core(cost, mass, cap, eps=0.05, n_iters=25)
+    idx = np.asarray(plan_rounded_assign_from_scaling(K, u, v))
+    assert (idx[:n_real] < m - 4).all()  # real rows avoid dead columns
+    assert (idx[n_real:] < m - 4).all()  # padding falls back to live columns
+
+
+def test_scaling_survives_wide_cost_ranges():
+    """Per-row gauge shift: no row underflows even when range/eps >> 88.
+
+    Regression: with a GLOBAL min shift, rows whose best entry sits far
+    above the global min lost every kernel entry to exp-underflow and their
+    scaling exploded — observed as 37% bucket overflow in the 10M-object
+    hierarchical tier (random-normal features, std-normalized cost,
+    eps=0.05).
+    """
+    import numpy as np
+
+    from rio_tpu.ops import scaling_sinkhorn, sinkhorn
+
+    key = jax.random.PRNGKey(11)
+    n, m = 8192, 64
+    # Heavy-tailed rows: some rows sit 20+ sigma from the global min.
+    cost = jax.random.normal(key, (n, m)) + 30.0 * jax.random.uniform(
+        jax.random.PRNGKey(12), (n, 1)
+    )
+    cost = cost / jnp.std(cost)
+    mass, cap = jnp.ones((n,)), jnp.ones((m,))
+    res = scaling_sinkhorn(cost, mass, cap, eps=0.05, n_iters=40)
+    assert bool(jnp.isfinite(res.err)), "marginal error must be finite"
+    assert float(res.err) < 0.05 * n  # marginals approximately matched
+    ref = sinkhorn(cost, mass, cap, eps=0.05, n_iters=40)
+    finite = jnp.isfinite(res.g) & jnp.isfinite(ref.g)
+    assert float(jnp.max(jnp.abs(res.g[finite] - ref.g[finite]))) < 5e-2
