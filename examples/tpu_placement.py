@@ -7,11 +7,14 @@ the TPU-native directory and shows the three behaviors that replace it:
 
 1. **Directory routing** — clients resolve the owner from the host-mirrored
    directory before dialing: 1 network hop, no redirect round trip.
-2. **Churn-aware re-solve** — kill a node; a full OT re-solve moves ONLY
-   the displaced objects (stay-put discount), not a global reshuffle.
-3. **Affinity** — an AffinityTracker feeds observed traffic into the
-   hierarchical solver's feature hooks, pulling objects back to the nodes
-   that served them (cache warmth) while capacity keeps load balanced.
+2. **Server-owned churn response** — kill a node; the opt-in
+   ``placement_daemon`` watches liveness and triggers a warm-started OT
+   re-solve that moves ONLY the displaced objects (stay-put discount) —
+   zero application solver calls.
+3. **Affinity** — the provider carries an AffinityTracker; the server
+   auto-observes every served request into it, pulling objects back to
+   the nodes that served them (cache warmth) while capacity keeps load
+   balanced.
 
 Runs on CPU out of the box (JAX_PLATFORMS=cpu); the same code jit-compiles
 the solve onto a TPU when one is attached::
@@ -38,6 +41,7 @@ from rio_tpu import (
 from rio_tpu.cluster.membership_protocol import LocalClusterProvider
 from rio_tpu.commands import AdminCommand
 from rio_tpu.object_placement.jax_placement import AffinityTracker, JaxObjectPlacement
+from rio_tpu.placement_daemon import PlacementDaemonConfig
 
 N_SERVERS = 5
 N_OBJECTS = 200
@@ -69,13 +73,14 @@ class CounterActor(ServiceObject):
 async def main() -> None:
     members = LocalStorage()
     tracker = AffinityTracker(dim=32)
-    # Hierarchical mode is the one that consumes the feature hooks — the
-    # tracker's observed-traffic affinity steers the 2-level OT solve.
+    # Hierarchical mode consumes the tracker's feature hooks — the
+    # observed-traffic affinity steers the 2-level OT solve. Carrying the
+    # tracker on the provider makes the Server auto-wire observation into
+    # its dispatch path (rio_tpu/commands.py DispatchObserver).
     placement = JaxObjectPlacement(
         mode="hierarchical",
         n_iters=20,
-        obj_features=tracker.obj_features,
-        node_features=tracker.node_features,
+        affinity_tracker=tracker,
     )
 
     servers: list[Server] = []
@@ -85,6 +90,11 @@ async def main() -> None:
             registry=Registry().add_type(CounterActor),
             cluster_provider=LocalClusterProvider(members),
             object_placement_provider=placement,
+            # Churn response with zero app code: watch liveness, re-solve.
+            placement_daemon=True,
+            placement_daemon_config=PlacementDaemonConfig(
+                poll_interval=0.1, debounce=0.05, min_rebalance_interval=0.1
+            ),
         )
         await s.prepare()
         await s.bind()
@@ -101,25 +111,32 @@ async def main() -> None:
 
     print(f"[demo] driving {N_OBJECTS} actors over {N_SERVERS} servers")
     for i in range(N_OBJECTS):
-        out = await client.send(CounterActor, f"c{i}", Hit(n=1), returns=HitCount)
-        tracker.observe(f"CounterActor.c{i}", out.server)
+        # NOTE: no tracker.observe here — the serving node records it.
+        await client.send(CounterActor, f"c{i}", Hit(n=1), returns=HitCount)
     print(
         f"[demo] {client.stats.requests} requests took "
         f"{client.stats.roundtrips} hops ({client.stats.redirects} redirects)"
     )
 
-    # Kill a node; gossip marks it dead; re-solve moves only its objects.
+    # Kill a node. A cleanly-exiting server deregisters itself from
+    # membership (Server.run's finally); from there the PLACEMENT DAEMON
+    # does everything: sees the liveness change, syncs the solver, and
+    # triggers the warm-started re-solve. Zero application code.
     victim = servers[0]
+    epoch0 = placement.stats.epoch  # snapshot BEFORE the churn event
     print(f"[demo] killing {victim.local_address}")
     victim.admin_sender().queue.put_nowait(AdminCommand.server_exit())
-    await asyncio.sleep(0.3)
-    host, _, port = victim.local_address.rpartition(":")
-    await members.set_inactive(host, int(port))
-    placement.sync_members(await members.members())
-    moved = await placement.rebalance()
+    for _ in range(600):  # the daemon's first real solve includes jit compile
+        if placement.stats.epoch != epoch0 and placement.stats.n_objects:
+            break
+        await asyncio.sleep(0.05)
+    else:
+        raise SystemExit("[demo] FAILED: the placement daemon never re-solved")
+    moved = placement.stats.moved
     print(
-        f"[demo] re-solve in {placement.stats.solve_ms:.1f} ms: moved {moved} "
-        f"of {placement.stats.n_objects} objects (only the displaced share)"
+        f"[demo] daemon re-solve in {placement.stats.solve_ms:.1f} ms: moved "
+        f"{moved} of {placement.stats.n_objects} objects (only the displaced "
+        f"share) — zero app-level solver calls"
     )
 
     # Every actor still answers, state intact where the node survived.
