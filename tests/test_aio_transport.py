@@ -1,0 +1,177 @@
+"""Pipelined transport invariants (rio_tpu/aio.py).
+
+The wire has no correlation ids (reference protocol contract), so the
+whole design rests on two properties: the server writes responses in
+exactly per-connection request order even though handlers run
+concurrently, and the client matches inbound frames to pending roundtrips
+FIFO — including when a roundtrip is cancelled mid-flight (its orphaned
+response must be discarded, not delivered to the next waiter).
+"""
+
+import asyncio
+
+from rio_tpu import (
+    AppData,
+    LocalObjectPlacement,
+    LocalStorage,
+    Registry,
+    Server,
+    ServiceObject,
+    handler,
+    message,
+)
+from rio_tpu import aio
+from rio_tpu.cluster.membership_protocol import LocalClusterProvider
+from rio_tpu.codec import deserialize, serialize
+from rio_tpu.protocol import (
+    RequestEnvelope,
+    decode_response,
+    encode_request_frame,
+)
+
+
+@message(name="aio.Sleepy")
+class Sleepy:
+    tag: int = 0
+    delay_ms: int = 0
+
+
+@message(name="aio.Tagged")
+class Tagged:
+    tag: int = 0
+
+
+class SleepyActor(ServiceObject):
+    @handler
+    async def run(self, msg: Sleepy, ctx: AppData) -> Tagged:
+        if msg.delay_ms:
+            await asyncio.sleep(msg.delay_ms / 1e3)
+        return Tagged(tag=msg.tag)
+
+
+async def _boot():
+    members, placement = LocalStorage(), LocalObjectPlacement()
+    server = Server(
+        address="127.0.0.1:0",
+        registry=Registry().add_type(SleepyActor),
+        cluster_provider=LocalClusterProvider(members),
+        object_placement_provider=placement,
+    )
+    await server.prepare()
+    addr = await server.bind()
+    task = asyncio.create_task(server.run())
+    for _ in range(100):
+        if await members.active_members():
+            break
+        await asyncio.sleep(0.02)
+    host, _, port = addr.rpartition(":")
+    return server, task, host, int(port)
+
+
+def _frame(obj_id: str, tag: int, delay_ms: int = 0) -> bytes:
+    return encode_request_frame(
+        RequestEnvelope(
+            "SleepyActor", obj_id, "aio.Sleepy",
+            serialize(Sleepy(tag=tag, delay_ms=delay_ms)),
+        )
+    )
+
+
+def test_fifo_order_with_out_of_order_completion():
+    """Slow-then-fast pipelined requests: responses come back in request order.
+
+    Distinct actor ids make the handlers truly concurrent (no shared
+    per-object lock); the first (slow) handler finishes last, yet its
+    response must be written first.
+    """
+
+    async def body():
+        server, task, host, port = await _boot()
+        try:
+            conn = await aio.connect(host, port, 2.0)
+            slow = asyncio.ensure_future(conn.roundtrip(_frame("a", 1, delay_ms=150)))
+            await asyncio.sleep(0.01)  # ensure 'slow' is written first
+            fast = asyncio.ensure_future(conn.roundtrip(_frame("b", 2, delay_ms=0)))
+            r1, r2 = await asyncio.gather(slow, fast)
+            t1 = deserialize(decode_response(r1).body, Tagged).tag
+            t2 = deserialize(decode_response(r2).body, Tagged).tag
+            assert (t1, t2) == (1, 2), "FIFO matching broke under reordering"
+            conn.close()
+        finally:
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+
+    asyncio.run(body())
+
+
+def test_cancelled_roundtrip_discards_orphan_response():
+    """A response to a cancelled roundtrip must not shift later matches."""
+
+    async def body():
+        server, task, host, port = await _boot()
+        try:
+            conn = await aio.connect(host, port, 2.0)
+            doomed = asyncio.ensure_future(conn.roundtrip(_frame("c", 7, delay_ms=80)))
+            await asyncio.sleep(0.01)
+            doomed.cancel()
+            try:
+                await doomed
+            except asyncio.CancelledError:
+                pass
+            # The orphan (tag 7) arrives ~70ms from now; this roundtrip must
+            # get ITS OWN response (tag 8), not the orphan.
+            raw = await conn.roundtrip(_frame("d", 8, delay_ms=100))
+            tag = deserialize(decode_response(raw).body, Tagged).tag
+            assert tag == 8, f"orphan response leaked into the next waiter (tag={tag})"
+            conn.close()
+        finally:
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+
+    asyncio.run(body())
+
+
+def test_deep_pipeline_all_served_in_order():
+    """Many in-flight requests on ONE connection, randomized handler delays."""
+
+    async def body():
+        server, task, host, port = await _boot()
+        try:
+            conn = await aio.connect(host, port, 2.0)
+            n = 96  # deeper than ServerConnProtocol.MAX_CONCURRENT (64)
+            futs = [
+                asyncio.ensure_future(
+                    conn.roundtrip(_frame(f"p{i}", i, delay_ms=(i * 7) % 23))
+                )
+                for i in range(n)
+            ]
+            raws = await asyncio.gather(*futs)
+            tags = [deserialize(decode_response(r).body, Tagged).tag for r in raws]
+            assert tags == list(range(n))
+            conn.close()
+        finally:
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+
+    asyncio.run(body())
+
+
+def test_eof_flushes_in_flight_responses():
+    """Half-close after sending: pending handler responses still arrive."""
+
+    async def body():
+        server, task, host, port = await _boot()
+        try:
+            conn = await aio.connect(host, port, 2.0)
+            fut = asyncio.ensure_future(conn.roundtrip(_frame("e", 5, delay_ms=80)))
+            await asyncio.sleep(0.01)
+            conn._transport.write_eof()  # we stop sending; still reading
+            raw = await fut
+            tag = deserialize(decode_response(raw).body, Tagged).tag
+            assert tag == 5
+            conn.close()
+        finally:
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+
+    asyncio.run(body())
