@@ -175,3 +175,92 @@ def test_eof_flushes_in_flight_responses():
             await asyncio.gather(task, return_exceptions=True)
 
     asyncio.run(body())
+
+
+def test_subscription_switch_flushes_pipeline_first():
+    """A subscription request behind in-flight requests: all prior
+    responses must be written (FIFO) before the stream takes over."""
+    from rio_tpu.message_router import MessageRouter
+    from rio_tpu.protocol import (
+        SubscriptionRequest,
+        decode_subresponse,
+        encode_subscribe_frame,
+    )
+
+    async def body():
+        server, task, host, port = await _boot()
+        try:
+            conn = await aio.connect(host, port, 2.0)
+            slow = asyncio.ensure_future(conn.roundtrip(_frame("s1", 11, delay_ms=60)))
+            await asyncio.sleep(0.01)
+            conn.write(encode_subscribe_frame(SubscriptionRequest("SleepyActor", "s1")))
+            raw = await slow  # the pending response still arrives first
+            assert deserialize(decode_response(raw).body, Tagged).tag == 11
+            # now in streaming mode: a publish reaches the wire
+            await asyncio.sleep(0.05)  # let the server enter streaming mode
+            router = server.app_data.get(MessageRouter)
+            router.publish("SleepyActor", "s1", Tagged(tag=99))
+            frame = await asyncio.wait_for(conn.read_frame(), 2.0)
+            sub = decode_subresponse(frame)
+            assert deserialize(sub.body, Tagged).tag == 99
+            conn.close()
+        finally:
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+
+    asyncio.run(body())
+
+
+def test_native_transport_pipelining_invariants():
+    """The C++ engine path honors the same FIFO + orphan-discard contract."""
+    from rio_tpu import native as native_mod
+
+    if native_mod.get() is None:
+        import pytest
+
+        pytest.skip("native library unavailable")
+    from rio_tpu.native.transport import ClientEngine, NativeServerTransport
+
+    async def body():
+        members, placement = LocalStorage(), LocalObjectPlacement()
+        server = Server(
+            address="127.0.0.1:0",
+            registry=Registry().add_type(SleepyActor),
+            cluster_provider=LocalClusterProvider(members),
+            object_placement_provider=placement,
+            transport="native",
+        )
+        await server.prepare()
+        addr = await server.bind()
+        task = asyncio.create_task(server.run())
+        for _ in range(100):
+            if await members.active_members():
+                break
+            await asyncio.sleep(0.02)
+        host, _, port = addr.rpartition(":")
+        engine = ClientEngine()
+        try:
+            conn = await engine.connect(host, int(port), 2.0)
+            # FIFO under out-of-order completion
+            slow = asyncio.ensure_future(conn.roundtrip(_frame("na", 1, delay_ms=120)))
+            await asyncio.sleep(0.01)
+            fast = asyncio.ensure_future(conn.roundtrip(_frame("nb", 2, delay_ms=0)))
+            r1, r2 = await asyncio.gather(slow, fast)
+            assert deserialize(decode_response(r1).body, Tagged).tag == 1
+            assert deserialize(decode_response(r2).body, Tagged).tag == 2
+            # orphan discard after cancellation
+            doomed = asyncio.ensure_future(conn.roundtrip(_frame("nc", 7, delay_ms=80)))
+            await asyncio.sleep(0.01)
+            doomed.cancel()
+            try:
+                await doomed
+            except asyncio.CancelledError:
+                pass
+            raw = await conn.roundtrip(_frame("nd", 8, delay_ms=100))
+            assert deserialize(decode_response(raw).body, Tagged).tag == 8
+        finally:
+            engine.close()
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+
+    asyncio.run(body())
