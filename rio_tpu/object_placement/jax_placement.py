@@ -190,7 +190,7 @@ class JaxObjectPlacement(ObjectPlacement):
         *,
         eps: float = 0.05,
         n_iters: int = 30,
-        mode: str = "sinkhorn",
+        mode: str = "auto",
         mesh=None,
         node_axis_size: int = 64,
         move_cost: float = 0.5,
@@ -200,6 +200,13 @@ class JaxObjectPlacement(ObjectPlacement):
     ) -> None:
         self._eps = eps
         self._n_iters = n_iters
+        if mode == "auto":
+            # Pick the solver for the hardware: the dense OT solve is a win
+            # on an accelerator (bandwidth-bound matvecs, measured 35x the
+            # SQL baseline on TPU v5e) but LOSES to the thing it replaces
+            # on host CPUs, where the O(N log M) greedy waterfill tier is
+            # the right default (measured ~26x the baseline).
+            mode = "sinkhorn" if jax.default_backend() == "tpu" else "greedy"
         self._mode = mode
         self._mesh = mesh
         # Stay-put discount applied to each object's CURRENT seat during a
