@@ -1,0 +1,130 @@
+"""On-hardware Pallas kernel validation (isolated, wedge-conscious).
+
+The r3 bench's in-tier Pallas smoke hung (Mosaic compile through the axon
+tunnel) and its watchdog exit wedged the relay. This runner validates each
+fused kernel in its OWN child process with a long deadline and tiny
+shapes, banking results to ``PALLAS_TPU.json`` between children, so:
+
+* a hang costs one kernel's evidence, not the banked results;
+* the long (default 600 s) deadline lets a slow-but-finite Mosaic compile
+  land instead of being watchdog-killed mid-op (the wedge trigger);
+* stderr shows which kernel was in flight if it does wedge.
+
+Usage:  python tpu_pallas_check.py            # orchestrator
+        python tpu_pallas_check.py --kernel pallas_scaling   # one child
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "PALLAS_TPU.json")
+N_OBJ, N_NODES = 8192, 256  # small: bound on-chip time, still real tiles
+KERNELS = ("pallas_scaling", "pallas_logdomain")
+
+
+def child(kernel: str, deadline: float) -> None:
+    t = threading.Timer(deadline, lambda: os._exit(99))
+    t.daemon = True
+    t.start()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    devices = jax.devices()
+    if devices[0].platform != "tpu":
+        print(json.dumps({"kernel": kernel, "error": "no tpu"}), flush=True)
+        os._exit(97)
+    from rio_tpu.ops import scaling_sinkhorn
+    from rio_tpu.ops.pallas_sinkhorn import pallas_sinkhorn
+    from rio_tpu.ops.scaling import pallas_scaling_sinkhorn
+
+    key = jax.random.PRNGKey(7)
+    cost = jax.random.uniform(key, (N_OBJ, N_NODES), jnp.float32)
+    mass = jnp.ones((N_OBJ,), jnp.float32)
+    cap = jnp.ones((N_NODES,), jnp.float32)
+    kw = dict(eps=0.05, n_iters=20)
+
+    print(f"# reference solve...", file=sys.stderr, flush=True)
+    ref = scaling_sinkhorn(cost, mass, cap, **kw)
+    jax.block_until_ready((ref.f, ref.g))
+    float(jnp.sum(jnp.where(jnp.isfinite(ref.g), ref.g, 0.0)))
+
+    fn = {
+        "pallas_scaling": lambda: pallas_scaling_sinkhorn(
+            cost, mass, cap, interpret=False, **kw
+        ),
+        "pallas_logdomain": lambda: pallas_sinkhorn(
+            cost, mass, cap, interpret=False, **kw
+        ),
+    }[kernel]
+    print(f"# compiling+running {kernel} (interpret=False)...", file=sys.stderr, flush=True)
+    t0 = time.perf_counter()
+    res = fn()
+    jax.block_until_ready((res.f, res.g))
+    float(jnp.sum(jnp.where(jnp.isfinite(res.g), res.g, 0.0)))
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    res = fn()
+    jax.block_until_ready((res.f, res.g))
+    float(jnp.sum(jnp.where(jnp.isfinite(res.g), res.g, 0.0)))
+    run_ms = (time.perf_counter() - t0) * 1e3
+
+    g_ref, g = np.asarray(ref.g), np.asarray(res.g)
+    finite = np.isfinite(g_ref) & np.isfinite(g)
+    out = {
+        "kernel": kernel,
+        "ok": True,
+        "device": str(devices[0]),
+        "shape": [N_OBJ, N_NODES],
+        "compile_s": round(compile_s, 2),
+        "run_ms": round(run_ms, 2),
+        "max_dg_vs_xla": float(np.max(np.abs(g_ref[finite] - g[finite]))),
+    }
+    print(json.dumps(out), flush=True)
+    os._exit(0)
+
+
+def main(deadline: float) -> None:
+    results = {}
+    if os.path.exists(OUT):
+        with open(OUT) as fh:
+            results = json.load(fh)
+    for kernel in KERNELS:
+        print(f"=== {kernel}", file=sys.stderr)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--kernel", kernel,
+             "--deadline", str(deadline)],
+            stdout=subprocess.PIPE, timeout=deadline + 60,
+        )
+        parsed = None
+        for line in proc.stdout.decode(errors="replace").splitlines():
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+        results[kernel] = parsed or {"kernel": kernel, "rc": proc.returncode,
+                                     "error": "no result (hang/wedge?)"}
+        with open(OUT, "w") as fh:  # bank after every child
+            json.dump(results, fh, indent=1)
+        print(f"=== {kernel}: {results[kernel]}", file=sys.stderr)
+        if proc.returncode == 99:
+            print("=== watchdog fired: relay likely wedged; stopping", file=sys.stderr)
+            break
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--kernel", choices=KERNELS)
+    ap.add_argument("--deadline", type=float, default=600.0)
+    args = ap.parse_args()
+    if args.kernel:
+        child(args.kernel, args.deadline)
+    else:
+        main(args.deadline)
