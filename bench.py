@@ -175,14 +175,21 @@ def _solve_rate(n_obj: int, kernel_dtype, n_nodes: int = N_NODES) -> dict:
         return assignment, jnp.sum(assignment)
 
     cost, mass, cap = _tier_inputs(n_obj, n_nodes)
-    solve_s, solve_compile = _time_fn(jax.jit(solve_only), cost, mass, cap)
-    full_s, full_compile = _time_fn(jax.jit(step), cost, mass, cap)
+    solve_s, solve_compile, _ = _time_fn(jax.jit(solve_only), cost, mass, cap)
+    full_s, full_compile, out = _time_fn(jax.jit(step), cost, mass, cap)
+    # Quality evidence from the already-computed assignment: the speed
+    # number only counts if it is actually capacity-balanced.
+    import numpy as np
+
+    loads = np.bincount(np.asarray(out[0]), minlength=n_nodes)
     return {
         "rate": n_obj / full_s,
         "full_ms": round(full_s * 1e3, 2),
         "sinkhorn_ms": round(solve_s * 1e3, 2),
         "compile_s": round(solve_compile + full_compile, 2),
         "n_nodes": n_nodes,
+        "max_load": int(loads.max()),
+        "fair_load": n_obj // n_nodes,
     }
 
 
@@ -198,10 +205,11 @@ def _tier_inputs(n_obj: int, n_nodes: int):
     return cost, mass, cap
 
 
-def _time_fn(fn, cost, mass, cap) -> tuple[float, float]:
+def _time_fn(fn, cost, mass, cap) -> tuple[float, float, object]:
     """Warm (compile) + best-of-3; the host float() pull forces completion
     (the axon tunnel's block_until_ready returns early). Returns
-    (best_seconds, compile_seconds)."""
+    (best_seconds, compile_seconds, last_output) — callers reuse the
+    output for quality checks instead of paying another on-device run."""
     import jax
     import jax.numpy as jnp
 
@@ -217,9 +225,10 @@ def _time_fn(fn, cost, mass, cap) -> tuple[float, float]:
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
-        force(fn(cost, mass, cap))
+        out = fn(cost, mass, cap)
+        force(out)
         times.append(time.perf_counter() - t0)
-    return min(times), compile_s
+    return min(times), compile_s, out
 
 
 def _greedy_rate(n_obj: int, n_nodes: int = N_NODES) -> dict:
@@ -234,7 +243,7 @@ def _greedy_rate(n_obj: int, n_nodes: int = N_NODES) -> dict:
         a = greedy_balanced_assign(c, m, k)
         return a, jnp.sum(a)
 
-    best, compile_s = _time_fn(step, *_tier_inputs(n_obj, n_nodes))
+    best, compile_s, _ = _time_fn(step, *_tier_inputs(n_obj, n_nodes))
     return {
         "rate": n_obj / best,
         "full_ms": round(best * 1e3, 2),
@@ -289,56 +298,6 @@ def _hier_rate(n_obj: int, n_nodes: int = N_NODES, n_groups: int = 32, d: int = 
     }
 
 
-def _pallas_smoke(n_obj: int = 65536) -> dict:
-    """Compile + run the fused Pallas solvers on the real chip.
-
-    Returns timings and max |Δ| vs the plain-XLA scaling solver — the
-    on-hardware validation VERDICT flagged (Mosaic lowering failures are
-    invisible in interpret-mode tests).
-    """
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    from rio_tpu.ops import scaling_sinkhorn
-    from rio_tpu.ops.pallas_sinkhorn import pallas_sinkhorn
-    from rio_tpu.ops.scaling import pallas_scaling_sinkhorn
-
-    key = jax.random.PRNGKey(7)
-    cost = jax.random.uniform(key, (n_obj, N_NODES), jnp.float32)
-    mass = jnp.ones((n_obj,), jnp.float32)
-    cap = jnp.ones((N_NODES,), jnp.float32)
-    kw = dict(eps=0.05, n_iters=20)
-
-    def timed(fn):
-        res = fn()  # compile + warm
-        jax.block_until_ready((res.f, res.g))
-        t0 = time.perf_counter()
-        res = fn()
-        jax.block_until_ready((res.f, res.g))
-        return res, (time.perf_counter() - t0) * 1e3
-
-    ref, xla_ms = timed(lambda: scaling_sinkhorn(cost, mass, cap, **kw))
-    out: dict = {"n_obj": n_obj, "xla_scaling_ms": round(xla_ms, 2)}
-    for label, fn in (
-        ("pallas_scaling", lambda: pallas_scaling_sinkhorn(
-            cost, mass, cap, interpret=False, **kw)),
-        ("pallas_logdomain", lambda: pallas_sinkhorn(
-            cost, mass, cap, interpret=False, **kw)),
-    ):
-        try:
-            res, ms = timed(fn)
-            g_ref, g = np.asarray(ref.g), np.asarray(res.g)
-            finite = np.isfinite(g_ref) & np.isfinite(g)
-            out[label] = {
-                "ms": round(ms, 2),
-                "max_dg": float(np.max(np.abs(g_ref[finite] - g[finite]))),
-            }
-        except Exception as e:  # record, never fail the tier
-            out[label] = {"error": f"{type(e).__name__}: {e}"}
-    return out
-
-
 def run_hier_tier(n_obj: int, deadline: float) -> None:
     """Child entry for the BASELINE row-5 (hierarchical) tier.
 
@@ -376,13 +335,15 @@ def run_hier_tier(n_obj: int, deadline: float) -> None:
         sys.exit(EXIT_SOLVE_FAIL)
 
 
-def run_tier(n_obj: int, platform: str, deadline: float, pallas_smoke: bool) -> None:
+def run_tier(n_obj: int, platform: str, deadline: float) -> None:
     """Child entry: probe backend once, run one tier, print JSON result lines.
 
     The tier result is printed (and flushed) the moment it exists — before
-    the optional pallas smoke — so a hang later in the child can never
+    any optional extra stage — so a hang later in the child can never
     destroy an already-successful measurement; the parent takes the last
-    parseable line.
+    parseable line. (Pallas validation lives in tpu_pallas_check.py: its
+    Mosaic compile can hang through the tunnel, and a watchdog exit
+    mid-TPU-op wedges the relay — observed r3.)
     """
     start = time.monotonic()
     init_watchdog = _arm_watchdog(deadline, EXIT_WATCHDOG)
@@ -449,14 +410,7 @@ def run_tier(n_obj: int, platform: str, deadline: float, pallas_smoke: bool) -> 
             print(json.dumps(result), flush=True)
         except Exception as e:
             print(f"# row-3 tier failed: {type(e).__name__}: {e}", file=sys.stderr)
-    remaining = deadline - (time.monotonic() - start)
-    if pallas_smoke and platform == "tpu" and remaining > 150:
-        try:
-            result["pallas"] = _pallas_smoke()
-            print(f"# pallas smoke: {result['pallas']}", file=sys.stderr)
-        except Exception as e:
-            result["pallas"] = {"error": f"{type(e).__name__}: {e}"}
-        print(json.dumps(result), flush=True)
+
 
 
 # ---------------------------------------------------------------------------
@@ -464,7 +418,7 @@ def run_tier(n_obj: int, platform: str, deadline: float, pallas_smoke: bool) -> 
 # ---------------------------------------------------------------------------
 
 
-def _run_child(n_obj: int, platform: str, deadline: float, pallas: bool, hier: bool = False):
+def _run_child(n_obj: int, platform: str, deadline: float, hier: bool = False):
     """Run one tier child; returns (rc, parsed_json_or_None)."""
     env = os.environ.copy()
     if platform == "cpu":
@@ -478,8 +432,6 @@ def _run_child(n_obj: int, platform: str, deadline: float, pallas: bool, hier: b
     ]
     if hier:
         cmd.append("--hier")
-    if pallas:
-        cmd.append("--pallas-smoke")
     try:
         proc = subprocess.run(
             cmd, stdout=subprocess.PIPE, env=env,
@@ -553,18 +505,12 @@ def main() -> None:
         print(f"# live hop measurement failed: {e!r}", file=sys.stderr)
         hops, hop_str = None, "hops unmeasured"
 
-    # Pallas smoke is opt-in: a Mosaic compile hang through the axon tunnel
-    # forces a watchdog exit mid-TPU-op, which orphans the chip grant and
-    # wedges the relay for subsequent jax inits (observed r3). Validation
-    # runs are produced manually (PALLAS_TPU.json), not by the driver.
-    pallas = os.environ.get("RIO_TPU_BENCH_PALLAS") == "1"
-
     result = None
     # TPU tiers, largest first. An init failure or watchdog exit means the
     # tunnel is down/wedged — retrying would burn ~25 min per attempt in
     # backend setup (the round-1 failure mode), so abort TPU entirely.
     for n_obj, deadline in ((1_048_576, 420.0), (524_288, 300.0), (262_144, 240.0)):
-        rc, parsed = _run_child(n_obj, "tpu", deadline, pallas=pallas)
+        rc, parsed = _run_child(n_obj, "tpu", deadline)
         if parsed:
             result = parsed
             break
@@ -578,12 +524,12 @@ def main() -> None:
         # BASELINE row 5 (scale ceiling): hierarchical 2-level OT toward
         # 10M x 1k, in its OWN child so an overrun can't cost the banked
         # headline result; the child sizes itself adaptively.
-        rc, hier = _run_child(10_485_760, "tpu", 420.0, pallas=False, hier=True)
+        rc, hier = _run_child(10_485_760, "tpu", 420.0, hier=True)
         if hier:
             detail["baseline_row5_hier"] = hier
             print(f"# row-5 hier tier: {hier}", file=sys.stderr)
     if result is None:
-        rc, parsed = _run_child(131_072, "cpu", 300.0, pallas=False)
+        rc, parsed = _run_child(131_072, "cpu", 300.0)
         if parsed:
             result = parsed
     detail["solve_tier"] = result
@@ -648,12 +594,11 @@ if __name__ == "__main__":
     parser.add_argument("--tier", type=int, default=None)
     parser.add_argument("--platform", choices=("tpu", "cpu"), default="tpu")
     parser.add_argument("--deadline", type=float, default=300.0)
-    parser.add_argument("--pallas-smoke", action="store_true")
     parser.add_argument("--hier", action="store_true")
     args = parser.parse_args()
     if args.tier is not None and args.hier:
         run_hier_tier(args.tier, args.deadline)
     elif args.tier is not None:
-        run_tier(args.tier, args.platform, args.deadline, args.pallas_smoke)
+        run_tier(args.tier, args.platform, args.deadline)
     else:
         main()

@@ -37,7 +37,11 @@ def child(kernel: str, deadline: float) -> None:
     import jax.numpy as jnp
     import numpy as np
 
-    devices = jax.devices()
+    try:
+        devices = jax.devices()
+    except Exception as e:
+        print(json.dumps({"kernel": kernel, "error": f"init: {e}"}), flush=True)
+        os._exit(97)
     if devices[0].platform != "tpu":
         print(json.dumps({"kernel": kernel, "error": "no tpu"}), flush=True)
         os._exit(97)
@@ -78,6 +82,18 @@ def child(kernel: str, deadline: float) -> None:
 
     g_ref, g = np.asarray(ref.g), np.asarray(res.g)
     finite = np.isfinite(g_ref) & np.isfinite(g)
+    if not finite.any():
+        # A Mosaic miscompile can yield all-NaN potentials — record it as a
+        # PARITY FAILURE, not a hang.
+        out = {
+            "kernel": kernel,
+            "ok": False,
+            "device": str(devices[0]),
+            "compile_s": round(compile_s, 2),
+            "error": "no finite potentials (miscompile?)",
+        }
+        print(json.dumps(out), flush=True)
+        os._exit(0)
     out = {
         "kernel": kernel,
         "ok": True,
@@ -94,15 +110,26 @@ def child(kernel: str, deadline: float) -> None:
 def main(deadline: float) -> None:
     results = {}
     if os.path.exists(OUT):
-        with open(OUT) as fh:
-            results = json.load(fh)
+        try:
+            with open(OUT) as fh:
+                results = json.load(fh)
+        except (json.JSONDecodeError, OSError):
+            results = {}  # prior run died mid-write; start fresh
     for kernel in KERNELS:
         print(f"=== {kernel}", file=sys.stderr)
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--kernel", kernel,
-             "--deadline", str(deadline)],
-            stdout=subprocess.PIPE, timeout=deadline + 60,
-        )
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--kernel", kernel,
+                 "--deadline", str(deadline)],
+                stdout=subprocess.PIPE, timeout=deadline + 60,
+            )
+        except subprocess.TimeoutExpired:
+            results[kernel] = {"kernel": kernel, "error": "parent backstop timeout"}
+            with open(OUT, "w") as fh:
+                json.dump(results, fh, indent=1)
+            print("=== parent backstop fired; relay likely wedged; stopping",
+                  file=sys.stderr)
+            break
         parsed = None
         for line in proc.stdout.decode(errors="replace").splitlines():
             try:
@@ -116,6 +143,10 @@ def main(deadline: float) -> None:
         print(f"=== {kernel}: {results[kernel]}", file=sys.stderr)
         if proc.returncode == 99:
             print("=== watchdog fired: relay likely wedged; stopping", file=sys.stderr)
+            break
+        if proc.returncode == 97:
+            print("=== backend init failed; stopping (no point re-initing)",
+                  file=sys.stderr)
             break
 
 
