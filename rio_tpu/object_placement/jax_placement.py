@@ -588,6 +588,8 @@ class JaxObjectPlacement(ObjectPlacement):
                         # exactly the displaced share, and pure load skew
                         # moves only the overflow, mirroring what the
                         # move-cost discount does for the OT modes.
+                        from ..ops.assignment import rank_within_group
+
                         cur = jnp.zeros((bucket,), jnp.int32).at[:n].set(
                             jnp.asarray(cur_idx)
                         )
@@ -595,17 +597,9 @@ class JaxObjectPlacement(ObjectPlacement):
                         # Stable sort keeps padding rows (mass 0, cur 0)
                         # after the real rows of node 0, so real ranks are
                         # unaffected.
-                        order = jnp.argsort(cur, stable=True)
-                        sorted_cur = cur[order]
-                        pos = jnp.arange(bucket)
-                        is_start = jnp.concatenate(
-                            [jnp.ones((1,), bool), sorted_cur[1:] != sorted_cur[:-1]]
-                        )
-                        group_start = jax.lax.associative_scan(
-                            jnp.maximum, jnp.where(is_start, pos, 0)
-                        )
+                        order, _, rank_sorted = rank_within_group(cur)
                         rank = jnp.zeros((bucket,), jnp.int32).at[order].set(
-                            (pos - group_start).astype(jnp.int32)
+                            rank_sorted
                         )
                         cap_alive = cap * alive
                         fair = (

@@ -28,6 +28,7 @@ from .scaling import (
 )
 from .sinkhorn import (
     SinkhornResult,
+    exact_quota_repair,
     plan_rounded_assign,
     plan_rounded_assign_from_scaling,
     sinkhorn,
@@ -45,6 +46,7 @@ __all__ = [
     "assign_from_potentials",
     "build_cost_matrix",
     "greedy_balanced_assign",
+    "exact_quota_repair",
     "plan_rounded_assign",
     "plan_rounded_assign_from_scaling",
     "sinkhorn",

@@ -181,6 +181,66 @@ def plan_rounded_assign_from_scaling(
     return jnp.clip(idx, 0, K.shape[1] - 1).astype(jnp.int32)
 
 
+@jax.jit
+def exact_quota_repair(
+    idx: jax.Array, expected_counts: jax.Array
+) -> jax.Array:
+    """Make a rounded assignment match integer column quotas EXACTLY.
+
+    CDF-inversion rounding matches the soft plan's column marginals only in
+    expectation — per-column counts carry ~sqrt(fair) binomial noise, so the
+    max load overshoots fair share by ~3 sigma (measured +33% at fair=128).
+    This repair computes integer quotas from the soft marginals (largest-
+    remainder method), KEEPS every object whose column is within quota
+    (within-column rank < quota), and re-slots only the excess into the
+    under-quota columns — the minimal move set (~the total overshoot, a
+    few percent), not a global re-slotting. Zero-expected (dead) columns
+    get zero quota and end up empty.
+
+    Args:
+      idx: (n,) int32 initial assignment (e.g. from plan rounding).
+      expected_counts: (m,) float expected objects per column (soft column
+        marginals x n); must sum to ~n.
+    """
+    from .assignment import rank_within_group
+
+    n = idx.shape[0]
+    m = expected_counts.shape[0]
+    counts = jnp.bincount(idx, length=m)
+    scaled = jnp.maximum(expected_counts.astype(jnp.float32), 0.0)
+    # Normalize to sum exactly n so the largest-remainder distribution can
+    # always place every object (guards float drift in the marginals).
+    scaled = scaled * (n / jnp.maximum(jnp.sum(scaled), 1e-30))
+    base = jnp.floor(scaled).astype(jnp.int32)
+    rem = scaled - base
+    short = n - jnp.sum(base)
+    # Largest remainders get the leftover units; remainder ties prefer the
+    # MORE-occupied column (awarding a tied bonus to an empty column would
+    # displace a seated object for no quota reason — churn, not repair).
+    rem_order = jnp.lexsort((-counts, -rem))
+    bonus = (
+        jnp.zeros((m,), jnp.int32)
+        .at[rem_order]
+        .set((jnp.arange(m) < short).astype(jnp.int32))
+    )
+    quota = base + bonus
+
+    # Within-column rank via one stable sort (shared with the greedy
+    # churn-aware rebalance): keep iff rank < quota[column].
+    order, sorted_idx, rank = rank_within_group(idx)
+    keep = rank < quota[sorted_idx]
+
+    # Excess objects fill the under-quota columns in cumulative order.
+    deficit = jnp.maximum(quota - counts, 0)
+    bounds = jnp.cumsum(deficit)
+    disp_rank = jnp.cumsum((~keep).astype(jnp.int32)) - 1
+    refill = jnp.clip(
+        jnp.searchsorted(bounds, disp_rank, side="right"), 0, m - 1
+    )
+    col_sorted = jnp.where(keep, sorted_idx, refill.astype(idx.dtype))
+    return jnp.zeros_like(idx).at[order].set(col_sorted)
+
+
 def sinkhorn_assign(
     cost: jax.Array,
     row_mass: jax.Array,

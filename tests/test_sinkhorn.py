@@ -159,3 +159,37 @@ def test_route_hop_simulation_beats_reference_policy():
         n_objects=100_000, n_nodes=100, n_requests=30_000, seed=7
     )
     assert again["reference"].as_dict() == ref.as_dict()
+
+
+def test_exact_quota_repair_minimal_moves_and_exact_loads():
+    """Repair hits integer quotas exactly, moving only the excess.
+
+    CDF rounding matches the soft marginals in expectation only (binomial
+    noise, ~+3 sigma on the max column); the repair must land every column
+    exactly on its largest-remainder quota while keeping >90% of objects
+    where they were, and leave dead columns empty.
+    """
+    import numpy as np
+
+    from rio_tpu.ops import (
+        exact_quota_repair,
+        plan_rounded_assign_from_scaling,
+        scaling_core,
+    )
+
+    n, m = 16384, 64
+    cost = jax.random.uniform(jax.random.PRNGKey(2), (n, m), jnp.float32)
+    mass = jnp.ones((n,))
+    cap = jnp.ones((m,)).at[7].set(0.0)  # one dead column
+    u, v, K, _ = scaling_core(cost, mass, cap, eps=0.05, n_iters=30)
+    idx = plan_rounded_assign_from_scaling(K, u, v)
+    expected = cap / jnp.sum(cap) * n
+    fixed = np.asarray(exact_quota_repair(idx, expected))
+    loads = np.bincount(fixed, minlength=m)
+    fair = n // (m - 1)
+    assert loads[7] == 0
+    live = np.delete(loads, 7)
+    assert live.max() - live.min() <= 1  # largest-remainder exactness
+    assert abs(int(live.max()) - fair) <= 1
+    changed = (np.asarray(idx) != fixed).mean()
+    assert changed < 0.10, f"repair moved {changed:.1%} of objects"
