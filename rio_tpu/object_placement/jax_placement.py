@@ -575,6 +575,49 @@ class JaxObjectPlacement(ObjectPlacement):
                             )
                             f, g = res.f, res.g
                         assignment = plan_rounded_assign(cost, f, g, self._eps)
+                        # Exact-capacity repair on the REAL rows (padding
+                        # excluded): CDF rounding matches capacities only
+                        # in expectation; re-slot the ~3% overshoot so no
+                        # node exceeds its integer quota (ties keep seated
+                        # objects — see ops.sinkhorn.exact_quota_repair).
+                        from ..ops import exact_quota_repair
+
+                        # Repair at BUCKET shape so the jitted repair's
+                        # trace is reused across varying object counts
+                        # (slicing to n first would recompile per n):
+                        # padding rows go to a sentinel column whose quota
+                        # is exactly the padding count — n enters as array
+                        # VALUES, never as a shape.
+                        cap_alive = cap * alive
+                        m_axis = cap_alive.shape[0]
+                        real = jnp.arange(bucket) < n
+                        idx_full = jnp.where(real, assignment, m_axis)
+                        # Absolute expected counts (not just shares): the
+                        # sentinel column needs its exact padding count, so
+                        # normalize here rather than relying on the
+                        # repair's internal renormalization.
+                        expected = jnp.concatenate(
+                            [
+                                cap_alive
+                                / jnp.maximum(jnp.sum(cap_alive), 1e-30)
+                                * n,
+                                jnp.asarray([bucket - n], jnp.float32),
+                            ]
+                        )
+                        cur_full = jnp.zeros((bucket,), jnp.int32).at[:n].set(
+                            jnp.asarray(cur_idx)
+                        )
+                        assignment = exact_quota_repair(
+                            idx_full,
+                            expected,
+                            # Evict movers first: quota trimming then adds
+                            # ~zero churn beyond what the solve chose.
+                            # Padding rows sit alone on the sentinel column
+                            # (quota == their count) and never move.
+                            prefer_keep=jnp.where(
+                                real, idx_full == cur_full, True
+                            ),
+                        )
                     else:
                         # Churn-aware greedy: waterfilling lays *all* mass
                         # out by cumulative position, so a naive full

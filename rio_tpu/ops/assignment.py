@@ -64,26 +64,29 @@ def assign_from_potentials(cost_rows: jax.Array, g: jax.Array) -> jax.Array:
 
 
 @jax.jit
-def rank_within_group(keys: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Per-element rank among equal keys, via one stable sort.
+def rank_within_group(
+    keys: jax.Array, group_keys: jax.Array | None = None
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-element rank among equal group keys, via one stable sort.
 
-    Returns ``(order, sorted_keys, rank_sorted)`` where ``order`` is the
-    stable argsort of ``keys``, and ``rank_sorted[i]`` is the 0-based rank
-    of ``sorted_keys[i]`` within its run of equal keys. Shared by the
-    churn-aware greedy rebalance (keep-within-fair-share) and the exact
-    quota repair (keep-within-quota) — the scan is subtle enough that one
-    copy is plenty.
+    Elements are ordered by a stable sort of ``keys``; ranks count within
+    runs of equal ``group_keys`` (default: ``keys`` themselves — pass a
+    composite sort key plus separate group keys to control ordering WITHIN
+    each group, e.g. preferred-first eviction). Returns
+    ``(order, sorted_group_keys, rank_sorted)``. Shared by the churn-aware
+    greedy rebalance (keep-within-fair-share) and the exact quota repair
+    (keep-within-quota) — the scan is subtle enough that one copy is plenty.
     """
     order = jnp.argsort(keys, stable=True)
-    sorted_keys = keys[order]
+    sorted_groups = (keys if group_keys is None else group_keys)[order]
     pos = jnp.arange(keys.shape[0])
     is_start = jnp.concatenate(
-        [jnp.ones((1,), bool), sorted_keys[1:] != sorted_keys[:-1]]
+        [jnp.ones((1,), bool), sorted_groups[1:] != sorted_groups[:-1]]
     )
     group_start = jax.lax.associative_scan(
         jnp.maximum, jnp.where(is_start, pos, 0)
     )
-    return order, sorted_keys, (pos - group_start).astype(jnp.int32)
+    return order, sorted_groups, (pos - group_start).astype(jnp.int32)
 
 
 def greedy_balanced_assign(

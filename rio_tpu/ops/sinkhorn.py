@@ -183,7 +183,9 @@ def plan_rounded_assign_from_scaling(
 
 @jax.jit
 def exact_quota_repair(
-    idx: jax.Array, expected_counts: jax.Array
+    idx: jax.Array,
+    expected_counts: jax.Array,
+    prefer_keep: jax.Array | None = None,
 ) -> jax.Array:
     """Make a rounded assignment match integer column quotas EXACTLY.
 
@@ -201,6 +203,10 @@ def exact_quota_repair(
       idx: (n,) int32 initial assignment (e.g. from plan rounding).
       expected_counts: (m,) float expected objects per column (soft column
         marginals x n); must sum to ~n.
+      prefer_keep: optional (n,) bool — objects to evict LAST from an
+        over-quota column. A churn re-solve passes "rounded to its current
+        seat", so quota eviction lands on objects that were moving anyway
+        and the repair adds ~zero extra churn.
     """
     from .assignment import rank_within_group
 
@@ -226,8 +232,16 @@ def exact_quota_repair(
     quota = base + bonus
 
     # Within-column rank via one stable sort (shared with the greedy
-    # churn-aware rebalance): keep iff rank < quota[column].
-    order, sorted_idx, rank = rank_within_group(idx)
+    # churn-aware rebalance): keep iff rank < quota[column]. With a
+    # prefer_keep mask, sort by (column, not-preferred) so preferred
+    # objects take the low ranks — eviction order is preferred-last.
+    if prefer_keep is None:
+        order, sorted_idx, rank = rank_within_group(idx)
+    else:
+        composite = idx.astype(jnp.int32) * 2 + (
+            1 - prefer_keep.astype(jnp.int32)
+        )
+        order, sorted_idx, rank = rank_within_group(composite, idx)
     keep = rank < quota[sorted_idx]
 
     # Excess objects fill the under-quota columns in cumulative order.

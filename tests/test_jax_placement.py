@@ -286,3 +286,42 @@ async def test_hierarchical_affinity_tracker_steers_placement():
     fair = len(ids) / len(nodes)
     assert hit >= 0.75 * len(ids), hit  # measured ~92%; hashed default ~9%
     assert max(counts.values()) <= 2.0 * fair, counts
+
+
+async def test_rebalance_exact_capacity_with_minimal_churn():
+    """Flat-mode rebalance lands EXACT integer quotas at zero extra churn.
+
+    After killing 2 of 20 nodes: every displaced object moves (they must),
+    nothing else does (stay-put preference in the quota repair evicts
+    movers first), and the survivors' loads match largest-remainder quotas
+    exactly (111/112 for 2000 over 18).
+    """
+    import numpy as np
+
+    n_nodes, n_objects = 20, 2000
+    p = JaxObjectPlacement(mode="sinkhorn")
+    for i in range(n_nodes):
+        p.register_node(f"10.0.0.{i}:50")
+    ids = [ObjectId("T", str(i)) for i in range(n_objects)]
+    await p.assign_batch(ids)
+    await p.rebalance()
+    before = {str(i): await p.lookup(i) for i in ids}
+
+    class M:
+        def __init__(self, addr, active):
+            self.address, self.active = addr, active
+
+    p.sync_members([M(f"10.0.0.{i}:50", active=i >= 2) for i in range(n_nodes)])
+    dead = {f"10.0.0.{j}:50" for j in range(2)}
+    displaced = sum(1 for v in before.values() if v in dead)
+    moved = await p.rebalance()
+    assert moved == displaced, (moved, displaced)
+
+    after = [await p.lookup(i) for i in ids]
+    assert not any(a in dead for a in after)
+    loads = np.bincount(
+        [int(a.rsplit(":", 1)[0].rsplit(".", 1)[1]) for a in after],
+        minlength=n_nodes,
+    )
+    live = loads[2:]
+    assert int(live.max()) - int(live.min()) <= 1  # exact integer quotas
