@@ -40,7 +40,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.scaling import scaling_sinkhorn
-from ..ops.sinkhorn import plan_rounded_assign
+from ..ops.sinkhorn import exact_quota_repair, plan_rounded_assign
 
 __all__ = ["HierarchicalResult", "hierarchical_assign", "sharded_hierarchical_assign"]
 
@@ -115,6 +115,13 @@ def hierarchical_assign(
         coarse_cost, mass, group_cap, eps=eps, n_iters=coarse_iters
     )
     group = plan_rounded_assign(coarse_cost, res_c.f, res_c.g, eps)  # (N,)
+    # Exact group quotas: CDF rounding matches group capacities only in
+    # expectation; the repair pins every group to its largest-remainder
+    # quota, so a bucket sized >= max quota makes overflow structurally
+    # impossible (instead of merely improbable).
+    group = exact_quota_repair(
+        group, group_cap / jnp.maximum(jnp.sum(group_cap), 1e-30) * n
+    )
 
     # ---- bucket objects by group (static shapes) -------------------------
     # rank-in-group via a stable sort by group id; each group's objects are
