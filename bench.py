@@ -183,7 +183,7 @@ def _solve_rate(n_obj: int, kernel_dtype, n_nodes: int = N_NODES) -> dict:
         assignment = exact_quota_repair(assignment, expected)
         # Scalar checksum: pulling it to host forces full completion (the
         # axon tunnel's block_until_ready returns before execution finishes).
-        return assignment, jnp.sum(assignment)
+        return assignment, _mean_assigned_cost(cost, assignment), jnp.sum(assignment)
 
     cost, mass, cap = _tier_inputs(n_obj, n_nodes)
     solve_s, solve_compile, _ = _time_fn(jax.jit(solve_only), cost, mass, cap)
@@ -193,6 +193,10 @@ def _solve_rate(n_obj: int, kernel_dtype, n_nodes: int = N_NODES) -> dict:
     import numpy as np
 
     loads = np.bincount(np.asarray(out[0]), minlength=n_nodes)
+    # Cost quality: mean assigned cost on U[0,1) random costs — random
+    # placement scores 0.50; lower is better (shows the solve optimizes
+    # per-object cost, not just balance). Computed inside the jitted step.
+    mean_cost = float(out[1])
     return {
         "rate": n_obj / full_s,
         "full_ms": round(full_s * 1e3, 2),
@@ -201,6 +205,7 @@ def _solve_rate(n_obj: int, kernel_dtype, n_nodes: int = N_NODES) -> dict:
         "n_nodes": n_nodes,
         "max_load": int(loads.max()),
         "fair_load": n_obj // n_nodes,
+        "mean_cost": round(mean_cost, 4),
     }
 
 
@@ -214,6 +219,15 @@ def _tier_inputs(n_obj: int, n_nodes: int):
     mass = jnp.ones((n_obj,), jnp.float32)
     cap = jnp.ones((n_nodes,), jnp.float32)
     return cost, mass, cap
+
+
+def _mean_assigned_cost(cost, assignment):
+    """Mean of cost[i, assignment[i]] — computed INSIDE the jitted step so
+    it is banked with the tier result (no post-measurement eager device
+    work; an extra pass after timing once risked a watchdog exit mid-op)."""
+    import jax.numpy as jnp
+
+    return jnp.mean(jnp.take_along_axis(cost, assignment[:, None], axis=1))
 
 
 def _time_fn(fn, cost, mass, cap) -> tuple[float, float, object]:
@@ -252,13 +266,16 @@ def _greedy_rate(n_obj: int, n_nodes: int = N_NODES) -> dict:
     @jax.jit
     def step(c, m, k):
         a = greedy_balanced_assign(c, m, k)
-        return a, jnp.sum(a)
+        return a, _mean_assigned_cost(c, a), jnp.sum(a)
 
-    best, compile_s, _ = _time_fn(step, *_tier_inputs(n_obj, n_nodes))
+    cost, mass, cap = _tier_inputs(n_obj, n_nodes)
+    best, compile_s, out = _time_fn(step, cost, mass, cap)
+    mean_cost = float(out[1])
     return {
         "rate": n_obj / best,
         "full_ms": round(best * 1e3, 2),
         "compile_s": round(compile_s, 2),
+        "mean_cost": round(mean_cost, 4),
     }
 
 
