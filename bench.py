@@ -98,6 +98,37 @@ def scaled_route_hops() -> dict:
     return out
 
 
+def row2_jax_provider_live() -> dict:
+    """BASELINE row 2: 8 nodes x 100k objects on the REAL JaxObjectPlacement.
+
+    The cluster's shared directory IS the provider under test (mode="auto"
+    — greedy waterfill on this CPU host, OT on TPU); allocation flows
+    through Server self-assign into the host-mirrored directory, and the
+    directory-resolver policy then dials owners directly.
+    """
+    import asyncio
+
+    from rio_tpu.object_placement.jax_placement import JaxObjectPlacement
+    from rio_tpu.utils.routing_live import measure_route_hops_live
+
+    stats = asyncio.run(
+        measure_route_hops_live(
+            n_servers=8,
+            n_objects=100_000,
+            placement=JaxObjectPlacement(),
+            sample_size=4_000,
+        )
+    )
+    ref, ours = stats["reference"], stats["rio_tpu"]
+    print(
+        f"# row-2 live (8 servers, 100k objects on JaxObjectPlacement): "
+        f"directory p99={ours.p99:.0f} mean={ours.mean:.2f} | "
+        f"reference-policy p99={ref.p99:.0f} mean={ref.mean:.2f}",
+        file=sys.stderr,
+    )
+    return {"ours": ours.as_dict(), "reference": ref.as_dict()}
+
+
 def live_route_hops() -> dict:
     """p99 route hops measured across real TCP round trips (8 servers)."""
     import asyncio
@@ -522,6 +553,10 @@ def main() -> None:
         detail["scaled_routing"] = scaled_route_hops()
     except Exception as e:
         print(f"# scaled routing failed: {e!r}", file=sys.stderr)
+    try:
+        detail["row2_jax_provider"] = row2_jax_provider_live()
+    except Exception as e:
+        print(f"# row-2 live measurement failed: {e!r}", file=sys.stderr)
     try:
         hops = live_route_hops()
         detail["route_hops"] = hops

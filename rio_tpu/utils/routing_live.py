@@ -122,35 +122,47 @@ async def measure_route_hops_live(
     n_objects: int = 1024,
     seed: int = 0,
     transport: str = "asyncio",
+    placement=None,
+    sample_size: int | None = None,
 ) -> dict[str, LiveHopStats]:
     """Boot a cluster, measure per-request hops under both client policies.
 
     Returns ``{"reference": LiveHopStats, "rio_tpu": LiveHopStats}``. Each
-    object is requested exactly once per policy with a cold placement LRU,
+    sampled object is requested once per policy with a cold placement LRU,
     so every request exercises the cache-miss routing decision — the case
-    the policies differ on.
+    the policies differ on. Pass ``placement`` (e.g. a JaxObjectPlacement)
+    to run the cluster on a specific provider; allocation is concurrent,
+    hop measurement sequential over ``sample_size`` (default: all) ids.
     """
     members, placement, tasks, _servers = await boot_echo_cluster(
-        n_servers, transport=transport
+        n_servers, transport=transport, placement=placement
     )
     try:
         ids = [f"obj-{i}" for i in range(n_objects)]
         # Warm-up pass: allocate every object somewhere (random landing →
         # near-uniform spread, like organic traffic would produce).
         setup = Client(members)
-        for oid in ids:
-            await setup.send(EchoActor, oid, Echo(value=1), returns=Echo)
+        for base in range(0, n_objects, 512):
+            await asyncio.gather(
+                *[
+                    setup.send(EchoActor, oid, Echo(value=1), returns=Echo)
+                    for oid in ids[base : base + 512]
+                ]
+            )
         setup.close()
 
         async def directory_resolver(handler_type: str, handler_id: str) -> str | None:
             return await placement.lookup(ObjectId(handler_type, handler_id))
 
+        sample = list(ids)
+        _random.Random(seed).shuffle(sample)
+        if sample_size is not None:
+            sample = sample[:sample_size]
+
         async def run_policy(resolver) -> LiveHopStats:
             client = Client(members, placement_resolver=resolver)
-            order = list(ids)
-            _random.Random(seed).shuffle(order)
             hops: list[int] = []
-            for oid in order:
+            for oid in sample:
                 before = client.stats.roundtrips
                 await client.send(EchoActor, oid, Echo(value=2), returns=Echo)
                 hops.append(client.stats.roundtrips - before)
