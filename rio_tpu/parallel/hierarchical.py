@@ -152,9 +152,20 @@ def hierarchical_assign(
 
     def solve_one(c, a, b):
         r = scaling_sinkhorn(c, a, b, eps=eps, n_iters=fine_iters)
-        return plan_rounded_assign(c, r.f, r.g, eps)
+        local = plan_rounded_assign(c, r.f, r.g, eps)
+        # Exact per-node quotas within the group (same largest-remainder
+        # repair as the coarse stage): padding rows go to a sentinel slot
+        # sized to their count, so real rows land exactly on capacity
+        # shares of the group's real population.
+        n_real = jnp.sum(a)
+        local = jnp.where(a > 0, local, s)
+        pad_count = (jnp.float32(a.shape[0]) - n_real)[None]
+        expected = jnp.concatenate(
+            [b / jnp.maximum(jnp.sum(b), 1e-30) * n_real, pad_count]
+        )
+        return exact_quota_repair(local, expected)
 
-    fine_local = jax.vmap(solve_one)(fine_cost, fine_mass, cap_g)  # (G, B) in [0,S)
+    fine_local = jax.vmap(solve_one)(fine_cost, fine_mass, cap_g)  # (G, B) in [0,S]
     members = jnp.arange(m, dtype=jnp.int32).reshape(n_groups, s)
     fine_global = jnp.take_along_axis(members, fine_local, axis=1)  # (G, B)
 

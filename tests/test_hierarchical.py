@@ -99,3 +99,16 @@ def test_sharded_hierarchical_on_mesh():
     assert not np.any(a == 3)
     counts = np.bincount(a, minlength=m)
     assert counts[np.setdiff1d(np.arange(m), [3])].max() < 2.5 * (n / 63)
+
+
+def test_hierarchical_exact_node_quotas():
+    """Both stages repair to exact largest-remainder quotas: every live
+    node lands within 1 of its capacity share (was ±20% rounding noise)."""
+    n, d, m, g = 8192, 8, 64, 8
+    obj, node = _features(jax.random.PRNGKey(9), n, d, m)
+    cap = jnp.ones((m,), jnp.float32)
+    alive = jnp.ones((m,), jnp.float32)
+    res = hierarchical_assign(obj, node, cap, alive, n_groups=g)
+    assert int(res.overflow) == 0
+    loads = np.bincount(np.asarray(res.assignment), minlength=m)
+    assert loads.max() - loads.min() <= 2  # group quota +-1, node quota +-1
