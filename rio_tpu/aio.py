@@ -239,7 +239,13 @@ class ServerConnProtocol(asyncio.Protocol):
                 if type(inbound) is RequestEnvelope:
                     if not self._resp_q and not self._queue:
                         # Sole in-flight request on this connection: dispatch
-                        # inline (no task) — the common non-pipelined case.
+                        # inline (no task) — the common non-pipelined case,
+                        # worth ~5-8% (measured). Frames arriving DURING the
+                        # inline await just buffer; when it finishes, the
+                        # backlog takes the concurrent spawn path below, so
+                        # head-of-line serialization is bounded to this one
+                        # request (and FIFO response order delays delivery
+                        # behind a slow head regardless of execution model).
                         resp = await service.call(inbound)
                         if not self._broken:
                             try:
