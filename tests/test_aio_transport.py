@@ -264,3 +264,65 @@ def test_native_transport_pipelining_invariants():
             await asyncio.gather(task, return_exceptions=True)
 
     asyncio.run(body())
+
+
+def test_subscription_backpressure_bounds_server_memory():
+    """A subscriber that stops reading must not grow server memory without
+    bound: the streaming pump parks on pause_writing, the router's bounded
+    per-subscriber queue drops OLDEST on overflow (broadcast-lag semantics,
+    reference message_router.rs capacity 1000), and the stream stays
+    healthy for fresh publishes once the client drains."""
+    from rio_tpu.message_router import DEFAULT_CAPACITY, MessageRouter
+    from rio_tpu.protocol import (
+        SubscriptionRequest,
+        decode_subresponse,
+        encode_subscribe_frame,
+    )
+
+    async def body():
+        server, task, host, port = await _boot()
+        try:
+            conn = await aio.connect(host, port, 2.0)
+            conn.write(encode_subscribe_frame(SubscriptionRequest("SleepyActor", "bp")))
+            await asyncio.sleep(0.1)  # server enters streaming mode
+            # Stop the client from reading; shrink its receive window so
+            # kernel buffers saturate quickly and pause_writing fires.
+            import socket as _socket
+
+            sock = conn._transport.get_extra_info("socket")
+            sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVBUF, 4096)
+            conn._transport.pause_reading()
+
+            router = server.app_data.get(MessageRouter)
+            publish_count = 5 * DEFAULT_CAPACITY
+            for i in range(publish_count):
+                router.publish("SleepyActor", "bp", Tagged(tag=i))
+            await asyncio.sleep(0.3)
+
+            # Resume: what arrives is whatever squeezed through before the
+            # stall plus at most the router's bounded queue — far less than
+            # everything published (the overflow was dropped, not buffered).
+            conn._transport.resume_reading()
+            got = []
+            try:
+                while True:
+                    frame = await asyncio.wait_for(conn.read_frame(), 1.0)
+                    assert frame is not None
+                    got.append(deserialize(decode_subresponse(frame).body, Tagged).tag)
+                    if got and got[-1] == publish_count - 1:
+                        break  # newest message delivered; backlog drained
+            except asyncio.TimeoutError:
+                raise AssertionError("stream never delivered the newest message")
+            assert len(got) < publish_count  # lag dropped, not buffered
+            assert got[-1] == publish_count - 1  # newest survives (drop-oldest)
+
+            # The stream is still live for fresh publishes.
+            router.publish("SleepyActor", "bp", Tagged(tag=999_999))
+            frame = await asyncio.wait_for(conn.read_frame(), 2.0)
+            assert deserialize(decode_subresponse(frame).body, Tagged).tag == 999_999
+            conn.close()
+        finally:
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+
+    asyncio.run(body())
