@@ -160,7 +160,7 @@ def _arm_watchdog(seconds: float, code: int) -> threading.Timer:
     return t
 
 
-def _solve_rate(n_obj: int, kernel_dtype, n_nodes: int = N_NODES) -> dict:
+def _solve_rate(n_obj: int, kernel_dtype, n_nodes: int = N_NODES, n_iters: int = 30) -> dict:
     """On-device OT solve throughput; returns a result dict.
 
     Uses the scaling-form core (``rio_tpu/ops/scaling.py``): K = exp(-C/eps)
@@ -179,17 +179,29 @@ def _solve_rate(n_obj: int, kernel_dtype, n_nodes: int = N_NODES) -> dict:
         plan_rounded_assign_from_scaling,
         scaling_core,
     )
+    from rio_tpu.ops.sinkhorn import normalize_marginals
+
+    def _row_marginal_err(K, u, v, mass, cap):
+        # Convergence proof: row-marginal L1 error against the SOLVER's own
+        # normalized target (the column marginal is exact by construction
+        # after the trailing v update). One extra matvec; included in BOTH
+        # solve_only and step so full_ms - sinkhorn_ms still isolates the
+        # rounding share.
+        Kv = jnp.matmul(K, v.astype(K.dtype), preferred_element_type=jnp.float32)
+        a, _ = normalize_marginals(mass, cap)
+        return jnp.sum(jnp.abs(u * Kv - a))
 
     def solve_only(cost, mass, cap):
         u, v, K, _ = scaling_core(
-            cost, mass, cap, eps=0.05, n_iters=30, kernel_dtype=kernel_dtype
+            cost, mass, cap, eps=0.05, n_iters=n_iters, kernel_dtype=kernel_dtype
         )
-        return jnp.sum(u) + jnp.sum(v)
+        return jnp.sum(u) + jnp.sum(v) + _row_marginal_err(K, u, v, mass, cap)
 
     def step(cost, mass, cap):
         u, v, K, _ = scaling_core(
-            cost, mass, cap, eps=0.05, n_iters=30, kernel_dtype=kernel_dtype
+            cost, mass, cap, eps=0.05, n_iters=n_iters, kernel_dtype=kernel_dtype
         )
+        marginal_err = _row_marginal_err(K, u, v, mass, cap)
         # Chunk the rounding pass so its cumsum temps stay bounded. NOTE:
         # quantile ranks are per-chunk, which is only equivalent to global
         # ranking because every row here is real with identical mass (each
@@ -214,7 +226,12 @@ def _solve_rate(n_obj: int, kernel_dtype, n_nodes: int = N_NODES) -> dict:
         assignment = exact_quota_repair(assignment, expected)
         # Scalar checksum: pulling it to host forces full completion (the
         # axon tunnel's block_until_ready returns before execution finishes).
-        return assignment, _mean_assigned_cost(cost, assignment), jnp.sum(assignment)
+        return (
+            assignment,
+            _mean_assigned_cost(cost, assignment),
+            marginal_err,
+            jnp.sum(assignment),
+        )
 
     cost, mass, cap = _tier_inputs(n_obj, n_nodes)
     solve_s, solve_compile, _ = _time_fn(jax.jit(solve_only), cost, mass, cap)
@@ -234,9 +251,11 @@ def _solve_rate(n_obj: int, kernel_dtype, n_nodes: int = N_NODES) -> dict:
         "sinkhorn_ms": round(solve_s * 1e3, 2),
         "compile_s": round(solve_compile + full_compile, 2),
         "n_nodes": n_nodes,
+        "n_iters": n_iters,
         "max_load": int(loads.max()),
         "fair_load": n_obj // n_nodes,
         "mean_cost": round(mean_cost, 4),
+        "marginal_err": float(out[2]),
     }
 
 
@@ -463,7 +482,10 @@ def run_tier(n_obj: int, platform: str, deadline: float) -> None:
     row3_budget = 60.0 + 10.0 * tier["full_ms"] / 1e3
     if platform == "tpu" and n_obj >= 1_048_576 and remaining > row3_budget:
         try:
-            row3 = _solve_rate(1_048_576, kernel_dtype, n_nodes=256)
+            # 15 iters = 1.5x the measured convergence point for this
+            # cost model (marginal err and mean_cost flat from iter 10;
+            # both recorded in the tier dict as proof).
+            row3 = _solve_rate(1_048_576, kernel_dtype, n_nodes=256, n_iters=15)
             result["baseline_row3_1m_x_256"] = row3
             print(f"# row-3 tier (1M x 256): {row3}", file=sys.stderr)
             print(json.dumps(result), flush=True)
