@@ -159,6 +159,34 @@ def _next_bucket(n: int, minimum: int = 256) -> int:
     return b
 
 
+def _apply_class_quotas(quotas: np.ndarray, cur_idx: np.ndarray) -> np.ndarray:
+    """Expand (M x M) class quotas into a per-object assignment, O(N + M^2).
+
+    Objects within a class (= current seat) are interchangeable, so laying
+    each class's own column FIRST keeps ``quotas[k, k]`` objects exactly
+    where they are — the move-minimal application of the collapsed solve
+    (``rio_tpu.ops.structured.class_quotas``).
+    """
+    m = quotas.shape[0]
+    out = np.empty(cur_idx.shape[0], np.int32)
+    order = np.argsort(cur_idx, kind="stable")
+    counts = np.bincount(cur_idx, minlength=m)
+    start = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    all_cols = np.arange(m)
+    for k in range(m):
+        c = int(counts[k])
+        if c == 0:
+            continue
+        cols = np.concatenate([[k], np.delete(all_cols, k)])
+        targets = np.repeat(cols, quotas[k][cols])
+        if targets.shape[0] < c:  # belt-and-braces vs float drift upstream
+            targets = np.concatenate(
+                [targets, np.full(c - targets.shape[0], k, np.int32)]
+            )
+        out[order[start[k] : start[k] + c]] = targets[:c]
+    return out
+
+
 @dataclass
 class _NodeSlot:
     address: str
@@ -521,20 +549,94 @@ class JaxObjectPlacement(ObjectPlacement):
 
         n = len(keys)
         bucket = _next_bucket(n)
-        def _solve() -> tuple[np.ndarray, jax.Array | None, float]:
+        def _solve() -> tuple[np.ndarray, jax.Array | None, float, str]:
             """Device solve off the event loop: np.asarray blocks until the
             TPU finishes, so running it in a thread keeps lookups/gossip/RPCs
             live — and makes the epoch-discard check below load-bearing.
             Only the snapshots taken under the lock are read here."""
             t0 = time.perf_counter()
+            # Decide the actual code path up front so traces, profiler
+            # labels, and SolveStats.mode all agree on what ran.
+            collapse = mode in ("sinkhorn", "scaling") and self._mesh is None
+            solved_as = f"{mode}+collapsed" if collapse else mode
             from ..tracing import span
 
-            with span("placement_solve", mode=mode, n=n), _profiler_trace(
-                f"rio_tpu.solve.{mode}"
+            with span("placement_solve", mode=solved_as, n=n), _profiler_trace(
+                f"rio_tpu.solve.{solved_as}"
             ):
+                def _repair_exact(assignment_padded):
+                    """Exact integer quotas at bucket shape (trace reuse);
+                    movers evicted first so repair adds ~zero churn."""
+                    from ..ops import exact_quota_repair
+
+                    cap_alive = cap * alive
+                    m_axis = cap_alive.shape[0]
+                    real = jnp.arange(bucket) < n
+                    idx_full = jnp.where(real, assignment_padded, m_axis)
+                    expected = jnp.concatenate(
+                        [
+                            cap_alive
+                            / jnp.maximum(jnp.sum(cap_alive), 1e-30)
+                            * n,
+                            jnp.asarray([bucket - n], jnp.float32),
+                        ]
+                    )
+                    cur_full = jnp.zeros((bucket,), jnp.int32).at[:n].set(
+                        jnp.asarray(cur_idx)
+                    )
+                    return exact_quota_repair(
+                        idx_full,
+                        expected,
+                        prefer_keep=jnp.where(real, idx_full == cur_full, True),
+                    )
+
                 if mode == "hierarchical":
                     # Never materializes the flat (bucket x node_axis) cost.
                     assignment, g = self._hierarchical_solve(keys, node_order, cap, alive)
+                elif collapse:
+                    # CLASS-COLLAPSED exact solve (ops/structured.py): the
+                    # flat cost model is a per-node vector plus a stay-put
+                    # diagonal, so every object with the same current seat
+                    # has an identical cost row and the (N x M) problem
+                    # collapses EXACTLY to (M x M) — N drops out of the
+                    # device solve entirely (<50 ms class at ANY N). The
+                    # dense path below remains for mesh-sharded solves
+                    # (per-shard capacity splits break the pure-class
+                    # structure) and anything with per-object costs.
+                    from ..ops.structured import class_quotas
+
+                    base_cost = build_cost_matrix(
+                        jnp.zeros_like(load), cap, alive
+                    )[0]
+                    counts = jnp.bincount(
+                        jnp.asarray(cur_idx), length=base_cost.shape[0]
+                    )
+                    # The class problem is tiny (M x M), so sharpen eps
+                    # until off-diagonal leakage is negligible: soft-plan
+                    # off-diag mass scales like M * exp(-move_cost/eps),
+                    # and at the default eps (0.05, ratio 10) that is ~5%
+                    # of all objects moved for no reason. Ratio >= 25 puts
+                    # the leak below 1e-8; the log-domain solver is stable
+                    # at any eps.
+                    class_eps = min(
+                        self._eps, self._move_cost / 25.0 if self._move_cost > 0 else self._eps
+                    )
+                    quotas, g = class_quotas(
+                        base_cost,
+                        counts,
+                        cap * alive,
+                        move_cost=self._move_cost,
+                        eps=class_eps,
+                        n_iters=self._n_iters,
+                    )
+                    raw = _apply_class_quotas(np.asarray(quotas), cur_idx)
+                    # Column sums of per-row-rounded quotas are only
+                    # approximately capacity; the shared repair makes node
+                    # loads exactly integer-quota (still O(N log N)).
+                    padded = jnp.zeros((bucket,), jnp.int32).at[:n].set(
+                        jnp.asarray(raw)
+                    )
+                    assignment = _repair_exact(padded)
                 else:
                     base_cost = build_cost_matrix(jnp.zeros_like(load), cap, alive)
                     cost = jnp.broadcast_to(base_cost, (bucket, base_cost.shape[1]))
@@ -551,73 +653,31 @@ class JaxObjectPlacement(ObjectPlacement):
                         [jnp.ones((n,), jnp.float32), jnp.zeros((bucket - n,), jnp.float32)]
                     )
                     if mode in ("sinkhorn", "scaling"):
-                        if self._mesh is not None:
-                            from ..parallel import (
-                                shard_cost,
-                                sharded_scaling_sinkhorn,
-                                sharded_sinkhorn,
-                            )
+                        # Only reachable with a mesh (the collapsed branch
+                        # owns every non-mesh flat solve): shard-local
+                        # capacity splits break the pure-class structure,
+                        # so the dense sharded solvers run here.
+                        from ..parallel import (
+                            shard_cost,
+                            sharded_scaling_sinkhorn,
+                            sharded_sinkhorn,
+                        )
 
-                            cost = shard_cost(self._mesh, cost)
-                            sharded = (
-                                sharded_scaling_sinkhorn
-                                if mode == "scaling"
-                                else sharded_sinkhorn
-                            )
-                            f, g = sharded(
-                                self._mesh, cost, mass, cap * alive,
-                                eps=self._eps, n_iters=self._n_iters,
-                            )
-                        else:
-                            solver = scaling_sinkhorn if mode == "scaling" else sinkhorn
-                            res = solver(
-                                cost, mass, cap * alive, eps=self._eps, n_iters=self._n_iters
-                            )
-                            f, g = res.f, res.g
+                        cost = shard_cost(self._mesh, cost)
+                        sharded = (
+                            sharded_scaling_sinkhorn
+                            if mode == "scaling"
+                            else sharded_sinkhorn
+                        )
+                        f, g = sharded(
+                            self._mesh, cost, mass, cap * alive,
+                            eps=self._eps, n_iters=self._n_iters,
+                        )
                         assignment = plan_rounded_assign(cost, f, g, self._eps)
-                        # Exact-capacity repair on the REAL rows (padding
-                        # excluded): CDF rounding matches capacities only
-                        # in expectation; re-slot the ~3% overshoot so no
-                        # node exceeds its integer quota (ties keep seated
-                        # objects — see ops.sinkhorn.exact_quota_repair).
-                        from ..ops import exact_quota_repair
-
-                        # Repair at BUCKET shape so the jitted repair's
-                        # trace is reused across varying object counts
-                        # (slicing to n first would recompile per n):
-                        # padding rows go to a sentinel column whose quota
-                        # is exactly the padding count — n enters as array
-                        # VALUES, never as a shape.
-                        cap_alive = cap * alive
-                        m_axis = cap_alive.shape[0]
-                        real = jnp.arange(bucket) < n
-                        idx_full = jnp.where(real, assignment, m_axis)
-                        # Absolute expected counts (not just shares): the
-                        # sentinel column needs its exact padding count, so
-                        # normalize here rather than relying on the
-                        # repair's internal renormalization.
-                        expected = jnp.concatenate(
-                            [
-                                cap_alive
-                                / jnp.maximum(jnp.sum(cap_alive), 1e-30)
-                                * n,
-                                jnp.asarray([bucket - n], jnp.float32),
-                            ]
-                        )
-                        cur_full = jnp.zeros((bucket,), jnp.int32).at[:n].set(
-                            jnp.asarray(cur_idx)
-                        )
-                        assignment = exact_quota_repair(
-                            idx_full,
-                            expected,
-                            # Evict movers first: quota trimming then adds
-                            # ~zero churn beyond what the solve chose.
-                            # Padding rows sit alone on the sentinel column
-                            # (quota == their count) and never move.
-                            prefer_keep=jnp.where(
-                                real, idx_full == cur_full, True
-                            ),
-                        )
+                        # Exact-capacity repair (bucket-shaped for trace
+                        # reuse; padding rows ride a sentinel column; see
+                        # _repair_exact above).
+                        assignment = _repair_exact(assignment)
                     else:
                         # Churn-aware greedy: waterfilling lays *all* mass
                         # out by cumulative position, so a naive full
@@ -663,9 +723,9 @@ class JaxObjectPlacement(ObjectPlacement):
                         assignment = jnp.where(keep, cur, refill)
                         g = None
             out = np.asarray(assignment)[:n]
-            return out, g, (time.perf_counter() - t0) * 1e3
+            return out, g, (time.perf_counter() - t0) * 1e3, solved_as
 
-        assignment, g, solve_ms = await asyncio.to_thread(_solve)
+        assignment, g, solve_ms, solved_as = await asyncio.to_thread(_solve)
 
         async with self._lock:
             if self._epoch != snapshot_epoch:
@@ -685,7 +745,7 @@ class JaxObjectPlacement(ObjectPlacement):
                 solve_ms=solve_ms,
                 moved=moved,
                 epoch=self._epoch,
-                mode=mode,
+                mode=solved_as,
                 discarded=False,
             )
             return moved
