@@ -507,6 +507,13 @@ def _run_child(n_obj: int, platform: str, deadline: float, hier: bool = False):
         # while the TPU relay is wedged by a killed claim).
         env["JAX_PLATFORMS"] = "cpu"
         env["PYTHONPATH"] = ""
+    else:
+        # The orchestrator pinned itself to cpu; TPU children get the
+        # platform the driver launched us with (usually "axon").
+        if _TPU_PLATFORMS is not None:
+            env["JAX_PLATFORMS"] = _TPU_PLATFORMS
+        else:
+            env.pop("JAX_PLATFORMS", None)
     cmd = [
         sys.executable, os.path.abspath(__file__),
         "--tier", str(n_obj), "--platform", platform, "--deadline", str(deadline),
@@ -563,7 +570,27 @@ def rpc_throughput() -> dict:
     return rates
 
 
+_TPU_PLATFORMS = os.environ.get("JAX_PLATFORMS")  # as the driver launched us
+
+
+def _pin_orchestrator_to_cpu() -> None:
+    """The orchestrator must NEVER touch the TPU backend itself.
+
+    The live-cluster stages (rpc, routing, row-2) run real servers with a
+    JaxObjectPlacement in THIS process; their first solve initializes the
+    jax backend, and with the ambient ``JAX_PLATFORMS=axon`` a wedged
+    relay hangs that init indefinitely with no watchdog (observed r3: the
+    whole bench froze before printing anything). The shared helper pins
+    cpu AND deregisters the axon PJRT factory; TPU tiers run in child
+    processes that restore the original platform env.
+    """
+    from rio_tpu.utils.jaxenv import force_cpu
+
+    force_cpu()
+
+
 def main() -> None:
+    _pin_orchestrator_to_cpu()
     detail: dict = {}
     baseline = sqlite_baseline_rate()
     detail["sqlite_baseline_rate"] = round(baseline)

@@ -8,33 +8,18 @@ mechanism the driver's ``dryrun_multichip`` uses). Must run before the first
 
 import asyncio
 import inspect
-import os
+import sys
+
+sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
 
 # Hard override: the ambient environment (sitecustomize) may pin
 # JAX_PLATFORMS to the real TPU tunnel ("axon"); tests always run on the
-# virtual CPU mesh, so force both the env var and the live jax config.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# virtual 8-device CPU mesh. One shared implementation of the cpu pin +
+# axon-factory deregistration (a wedged tunnel otherwise hangs the whole
+# session on the first jax op) lives in rio_tpu.utils.jaxenv.
+from rio_tpu.utils.jaxenv import force_cpu  # noqa: E402
 
-import jax  # noqa: E402  (must come after the env setup above)
-
-jax.config.update("jax_platforms", "cpu")
-
-# Defensive: deregister the axon TPU-tunnel PJRT plugin entirely. Even with
-# jax_platforms=cpu its factory can be initialized during backend discovery,
-# and a wedged tunnel (e.g. a stale chip grant) then hangs the whole test
-# session on the first jax op.
-try:  # pragma: no cover - environment-specific
-    from jax._src import xla_bridge as _xb
-
-    for _reg in ("_backend_factories", "backend_factories"):
-        _factories = getattr(_xb, _reg, None)
-        if isinstance(_factories, dict):
-            _factories.pop("axon", None)
-except Exception:
-    pass
+force_cpu(n_devices=8)
 
 
 def pytest_configure(config):

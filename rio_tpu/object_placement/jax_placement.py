@@ -228,13 +228,12 @@ class JaxObjectPlacement(ObjectPlacement):
     ) -> None:
         self._eps = eps
         self._n_iters = n_iters
-        if mode == "auto":
-            # Pick the solver for the hardware: the dense OT solve is a win
-            # on an accelerator (bandwidth-bound matvecs, measured 35x the
-            # SQL baseline on TPU v5e) but LOSES to the thing it replaces
-            # on host CPUs, where the O(N log M) greedy waterfill tier is
-            # the right default (measured ~26x the baseline).
-            mode = "sinkhorn" if jax.default_backend() == "tpu" else "greedy"
+        # "auto" resolves LAZILY at the first solve: jax.default_backend()
+        # initializes the jax backend, and constructing a provider must
+        # never block on that — against a wedged TPU relay a backend init
+        # can hang indefinitely (observed r3: it froze the whole bench
+        # orchestrator), while the first actual solve initializes the
+        # backend anyway.
         self._mode = mode
         self._mesh = mesh
         # Stay-put discount applied to each object's CURRENT seat during a
@@ -249,7 +248,7 @@ class JaxObjectPlacement(ObjectPlacement):
         # balancing proxy; plug an AffinityTracker (or anything encoding
         # state size / cache warmth / request rate) to make the OT affinity
         # term carry real locality signal.
-        if (obj_features or node_features or affinity_tracker) and mode != "hierarchical":
+        if (obj_features or node_features or affinity_tracker) and mode != "hierarchical":  # noqa: E501 — hooks demand hierarchical; auto never resolves to it
             # Flat modes build per-node costs only and would silently
             # ignore the hooks — fail at construction, not at solve time.
             raise ValueError(
@@ -278,6 +277,21 @@ class JaxObjectPlacement(ObjectPlacement):
         self._g: jax.Array | None = None  # cached node potentials (padded axis)
         self._lock = asyncio.Lock()
         self.stats = SolveStats()
+
+    def _solver_mode(self) -> str:
+        """Resolve ``mode="auto"`` on first use (first backend touch).
+
+        The dense OT solve wins on an accelerator (measured 35x the SQL
+        baseline on TPU v5e) but loses to the thing it replaces on host
+        CPUs, where the O(N log M) greedy waterfill is the right default
+        (measured ~26x the baseline). Flat OT rebalances additionally
+        collapse to O(M^2) either way (see ``rebalance``).
+        """
+        if self._mode == "auto":
+            self._mode = (
+                "sinkhorn" if jax.default_backend() == "tpu" else "greedy"
+            )
+        return self._mode
 
     # ------------------------------------------------- directory internals
     def _set_placement(self, key: str, idx: int) -> bool:
@@ -534,7 +548,10 @@ class JaxObjectPlacement(ObjectPlacement):
         single-writer/versioned-epoch consistency design from ``SURVEY.md``
         §7 "hard parts".
         """
-        mode = mode or self._mode
+        # An explicit mode="auto" resolves exactly like the constructor
+        # default (it would otherwise fall through every dispatch check
+        # and silently run the greedy branch).
+        mode = self._solver_mode() if mode in (None, "auto") else mode
         async with self._lock:
             keys = list(self._placements.keys())
             cur_idx = np.fromiter(

@@ -1,0 +1,57 @@
+"""Pin the current process's jax to CPU, axon-proof.
+
+One shared implementation of the wedge-defense dance used by the test
+conftest, the bench orchestrator, and the driver entry's multichip dryrun
+(previously three hand-maintained copies of the same jax-internal poke):
+
+1. set ``JAX_PLATFORMS=cpu`` (+ optionally the virtual device count) in the
+   environment BEFORE jax initializes a backend;
+2. mirror it into live jax config (the env alone is ignored once jax is
+   imported);
+3. deregister the axon PJRT plugin factory — even under
+   ``jax_platforms=cpu`` its discovery hook can run, and against a wedged
+   TPU relay that hangs the process indefinitely (observed r1 and r3).
+
+Importing jax here is safe: the hang is in backend *initialization*, not
+import.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def force_cpu(n_devices: int | None = None) -> None:
+    """Pin this process to the CPU backend; never touches the TPU relay.
+
+    ``n_devices`` additionally forces that many virtual CPU devices (the
+    multichip-dryrun / sharded-test mesh), raising an existing
+    ``xla_force_host_platform_device_count`` flag when it is lower.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+        if m is None:
+            flags = (
+                flags + f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+        elif int(m.group(1)) < n_devices:
+            flags = flags.replace(
+                m.group(0), f"--xla_force_host_platform_device_count={n_devices}"
+            )
+        os.environ["XLA_FLAGS"] = flags
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge as _xb
+
+        for reg in ("_backend_factories", "backend_factories"):
+            factories = getattr(_xb, reg, None)
+            if isinstance(factories, dict):
+                factories.pop("axon", None)
+    except Exception:  # pragma: no cover - jax-internal surface
+        pass
