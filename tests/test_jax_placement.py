@@ -390,3 +390,34 @@ def test_apply_class_quotas_unit():
     for k in range(3):
         stayed = int(((cur == k) & (out == k)).sum())
         assert stayed == quotas[k, k]
+
+
+def test_provider_construction_initializes_no_backend():
+    """Constructing a provider must NEVER initialize a jax backend.
+
+    Regression for the r3 bench freeze: mode="auto" once resolved via
+    jax.default_backend() in __init__, and against a wedged TPU relay
+    that init hangs indefinitely — construction (e.g. inside a Server
+    bootstrap or the bench orchestrator) must stay backend-free; the
+    first SOLVE initializes the backend instead.
+    """
+    import subprocess
+    import sys as _sys
+
+    code = (
+        "from rio_tpu.object_placement.jax_placement import JaxObjectPlacement\n"
+        "p = JaxObjectPlacement()\n"
+        "p.register_node('10.0.0.1:1')\n"
+        "from jax._src import xla_bridge as xb\n"
+        "assert not xb._backends, f'backend initialized: {list(xb._backends)}'\n"
+        "print('CLEAN')\n"
+    )
+    proc = subprocess.run(
+        [_sys.executable, "-c", code],
+        capture_output=True,
+        env={"PYTHONPATH": ".", "JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin"},
+        cwd="/root/repo",
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr.decode()
+    assert b"CLEAN" in proc.stdout
