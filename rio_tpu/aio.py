@@ -53,6 +53,7 @@ class ServerConnProtocol(asyncio.Protocol):
     """One accepted connection: framing + ordered-concurrent dispatch."""
 
     MAX_CONCURRENT = 64  # per-connection in-flight handler cap
+    MAX_PENDING_FRAMES = 1024  # inbound backpressure threshold (pause reads)
 
     __slots__ = (
         "_service_factory",
@@ -65,6 +66,7 @@ class ServerConnProtocol(asyncio.Protocol):
         "_transport",
         "_worker",
         "_paused",
+        "_reading_paused",
         "_drain",
         "_streaming",
         "_resp_q",
@@ -88,6 +90,7 @@ class ServerConnProtocol(asyncio.Protocol):
         self._transport: asyncio.Transport | None = None
         self._worker: asyncio.Task | None = None
         self._paused = False
+        self._reading_paused = False
         self._drain: asyncio.Future | None = None  # streaming backpressure
         self._streaming = False
         self._resp_q: deque[asyncio.Future] = deque()  # FIFO response slots
@@ -116,6 +119,19 @@ class ServerConnProtocol(asyncio.Protocol):
         if payloads:
             self._queue.extend(payloads)
             self._wake()
+            # Inbound backpressure: MAX_CONCURRENT caps in-flight handlers
+            # but not buffered frames — a fast pipelining client could grow
+            # _queue without bound (the native engine cuts such peers off at
+            # _MAX_PENDING_FRAMES).  Pausing the transport propagates real
+            # TCP backpressure instead; the dispatch loop resumes reads as
+            # it drains.
+            if (
+                not self._reading_paused
+                and len(self._queue) + len(self._resp_q) > self.MAX_PENDING_FRAMES
+            ):
+                self._reading_paused = True
+                assert self._transport is not None
+                self._transport.pause_reading()
 
     def eof_received(self) -> bool | None:
         self._eof = True
@@ -181,12 +197,23 @@ class ServerConnProtocol(asyncio.Protocol):
                 transport.close()
                 break
         self._wake_room()
+        self._maybe_resume_reading()
 
     def _wake_room(self) -> None:
         r = self._room
         if r is not None and not r.done():
             self._room = None
             r.set_result(None)
+
+    def _maybe_resume_reading(self) -> None:
+        if (
+            self._reading_paused
+            and not self._lost
+            and len(self._queue) + len(self._resp_q) <= self.MAX_PENDING_FRAMES // 2
+        ):
+            self._reading_paused = False
+            assert self._transport is not None
+            self._transport.resume_reading()
 
     # -- reader/dispatcher ---------------------------------------------------
 
@@ -202,7 +229,9 @@ class ServerConnProtocol(asyncio.Protocol):
                 return None
             self._waiter = asyncio.get_running_loop().create_future()
             await self._waiter
-        return self._queue.popleft()
+        payload = self._queue.popleft()
+        self._maybe_resume_reading()
+        return payload
 
     async def _flushed(self) -> None:
         """Honor write backpressure (the StreamWriter.drain equivalent)."""
@@ -321,7 +350,7 @@ class ClientConnProtocol(asyncio.Protocol):
     in-flight depth for the pool's least-loaded pick.
     """
 
-    __slots__ = ("_frames", "_waiters", "_queue", "_transport", "closed")
+    __slots__ = ("_frames", "_waiters", "_queue", "_transport", "closed", "delivered")
 
     def __init__(self) -> None:
         self._frames = FrameReader()
@@ -329,6 +358,7 @@ class ClientConnProtocol(asyncio.Protocol):
         self._queue: deque[bytes] = deque()  # frames beyond waiters (subscribe)
         self._transport: asyncio.Transport | None = None
         self.closed = False
+        self.delivered = 0  # inbound frames seen (client's progress signal)
 
     @property
     def pending(self) -> int:
@@ -346,6 +376,7 @@ class ClientConnProtocol(asyncio.Protocol):
             self._transport.close()
             return
         for payload in payloads:
+            self.delivered += 1
             if self._waiters:
                 w = self._waiters.popleft()
                 if not w.done():

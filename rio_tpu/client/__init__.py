@@ -278,8 +278,20 @@ class Client:
                 address = await self._pick_address(handler_type, handler_id, avoid)
                 pool = self._pool(address)
                 conn = await pool.acquire()
+                seen = conn.delivered
                 try:
                     raw = await conn.roundtrip(frame_bytes)
+                except asyncio.CancelledError:
+                    # Caller timeout/cancel: both transports discard the
+                    # orphaned response, so the shared pipelined socket stays
+                    # usable — closing it would kill every sibling in-flight
+                    # request for no reason.  But only while the connection
+                    # is making progress: if NO frame arrived since this
+                    # send, the server side is likely head-of-line hung and
+                    # reusing the conn would zombie the pool (every later
+                    # request round-robins onto a socket that never answers).
+                    pool.release(conn, reuse=conn.delivered > seen)
+                    raise
                 except BaseException:
                     pool.release(conn, reuse=False)
                     raise

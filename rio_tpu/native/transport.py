@@ -97,66 +97,84 @@ class NativeClientConn:
     """One outbound connection managed by a :class:`ClientEngine`.
 
     Exposes the same surface the asyncio client connection offers
-    (``roundtrip``/``read_frame``/``close``); requests are strictly
-    sequential per connection (the client's per-server pool hands a
-    connection to one request at a time), so inbound frames map to the
-    in-flight request FIFO-style with no correlation ids — exactly the
-    reference's wire contract.
+    (``roundtrip``/``read_frame``/``write``/``close``) including
+    **pipelining**: concurrent roundtrips register futures in a FIFO deque
+    and inbound frames resolve the oldest one inside the engine's event
+    drain — the same design as :class:`rio_tpu.aio.ClientConnProtocol`.
+    (A shared Queue was racy here: a parked getter woken by a response
+    could be beaten to ``get_nowait`` by a roundtrip issued later,
+    silently delivering the response to the wrong caller.)  A roundtrip
+    cancelled mid-flight leaves its cancelled future in the deque; its
+    response, when it arrives, is discarded rather than shifting every
+    later match.
     """
 
     def __init__(self, engine: "ClientEngine", conn_id: int) -> None:
         self._engine = engine
         self._id = conn_id
-        self._frames: asyncio.Queue[bytes | None] = asyncio.Queue()
+        self._waiters: deque[asyncio.Future] = deque()  # FIFO roundtrips
+        self._queue: deque[bytes] = deque()  # frames beyond waiters (subscribe)
         self.opened: asyncio.Future[bool] = asyncio.get_running_loop().create_future()
         self.closed = False
-        self.pending = 0  # in-flight roundtrips (pool's least-loaded pick)
-        self._orphans = 0  # cancelled roundtrips whose response is still due
+        self.delivered = 0  # inbound frames seen (client's progress signal)
+
+    @property
+    def pending(self) -> int:
+        return len(self._waiters)
+
+    def _deliver(self, payload: bytes) -> None:
+        """Resolve the oldest pending roundtrip (engine drain context)."""
+        self.delivered += 1
+        if self._waiters:
+            w = self._waiters.popleft()
+            if not w.done():
+                w.set_result(payload)
+            # else: that roundtrip was cancelled — this payload is its
+            # orphaned response; drop it (each cancelled roundtrip is owed
+            # exactly ONE orphan frame; never skip several slots per frame).
+            return
+        self._queue.append(payload)
+
+    def _close_pending(self) -> None:
+        for w in self._waiters:
+            if not w.done():
+                w.set_result(None)
+        self._waiters.clear()
 
     async def roundtrip(self, frame_bytes: bytes) -> bytes:
-        """Send one request; await its response.
-
-        Supports pipelining: concurrent roundtrips are matched to inbound
-        frames FIFO (the queue's getters wake in call order, and there is
-        no await between ``send`` and ``get``, so registration order equals
-        send order). A roundtrip cancelled mid-flight leaves an orphan
-        marker — its response, when it arrives, is discarded rather than
-        shifting every later match.
-        """
+        """Send one request; await its response (FIFO-matched)."""
         from ..errors import Disconnect
 
         if self.closed:
             raise Disconnect("native connection closed")
-        self.pending += 1
-        try:
-            self._engine._engine.send(self._id, frame_bytes)
-            try:
-                payload = await self._frames.get()
-                while self._orphans and payload is not None:
-                    self._orphans -= 1
-                    payload = await self._frames.get()
-            except asyncio.CancelledError:
-                self._orphans += 1
-                raise
-        finally:
-            self.pending -= 1
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters.append(fut)
+        self._engine._engine.send(self._id, frame_bytes)
+        payload = await fut
         if payload is None:
             raise Disconnect("connection closed mid-request")
         return payload
 
     async def read_frame(self) -> bytes | None:
         """Next inbound frame; None at EOF (subscription streaming)."""
-        if self.closed and self._frames.empty():
-            return None
-        return await self._frames.get()
+        while not self._queue:
+            if self.closed:
+                return None
+            fut = asyncio.get_running_loop().create_future()
+            self._waiters.append(fut)
+            return await fut
+        return self._queue.popleft()
 
     def write(self, frame_bytes: bytes) -> None:
         self._engine._engine.send(self._id, frame_bytes)
 
     def close(self) -> None:
         # Always drop: the C++ Conn/fd must be released even when the close
-        # was peer-initiated (closed=True set by EV_CLOSED).
+        # was peer-initiated (closed=True set by EV_CLOSED).  Locally
+        # initiated closes emit no EV_CLOSED, so park-ed waiters must be
+        # resolved here or they hang forever.
         self.closed = True
+        self._close_pending()
         self._engine._drop(self._id)
 
 
@@ -202,16 +220,12 @@ class ClientEngine:
                 if not c.opened.done():
                     c.opened.set_result(True)
             elif ev_type == EV_FRAME:
-                c._frames.put_nowait(data)
+                c._deliver(data)
             elif ev_type == EV_CLOSED:
                 c.closed = True
                 if not c.opened.done():
                     c.opened.set_result(False)
-                # One EOF sentinel per in-flight roundtrip (pipelining may
-                # have several waiters parked on the queue), plus one for a
-                # subscription reader.
-                for _ in range(c.pending + 1):
-                    c._frames.put_nowait(None)
+                c._close_pending()
                 self._conns.pop(conn, None)
                 # Free the C++ side: a peer FIN takes the engine's soft-EOF
                 # path, which keeps the fd open for writes until told
@@ -263,8 +277,7 @@ class ClientEngine:
             self._loop.remove_reader(self._engine.notify_fd)
         for c in list(self._conns.values()):
             c.closed = True
-            for _ in range(c.pending + 1):
-                c._frames.put_nowait(None)
+            c._close_pending()
         self._conns.clear()
         self._engine.shutdown()
 

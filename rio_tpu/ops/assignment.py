@@ -89,6 +89,7 @@ def rank_within_group(
     return order, sorted_groups, (pos - group_start).astype(jnp.int32)
 
 
+@jax.jit
 def greedy_balanced_assign(
     cost: jax.Array,
     row_mass: jax.Array,

@@ -326,3 +326,133 @@ def test_subscription_backpressure_bounds_server_memory():
             await asyncio.gather(task, return_exceptions=True)
 
     asyncio.run(body())
+
+
+class _RecordingTransport:
+    """asyncio.Transport stand-in recording pause/resume/write calls."""
+
+    def __init__(self):
+        self.paused = False
+        self.pauses = 0
+        self.resumes = 0
+        self.writes = []
+        self.closed = False
+
+    def pause_reading(self):
+        self.paused = True
+        self.pauses += 1
+
+    def resume_reading(self):
+        self.paused = False
+        self.resumes += 1
+
+    def write(self, data):
+        self.writes.append(data)
+
+    def close(self):
+        self.closed = True
+
+    def is_closing(self):
+        return self.closed
+
+
+def test_server_inbound_backpressure_pauses_and_resumes_reads():
+    """A pipelining flood beyond MAX_PENDING_FRAMES pauses the transport.
+
+    MAX_CONCURRENT caps in-flight handlers but not buffered frames; without
+    pause_reading a fast client grows server memory without bound (the native
+    engine cuts such peers off at its _MAX_PENDING_FRAMES — the asyncio path
+    must propagate TCP backpressure instead). Regression for the round-3
+    advisor finding.
+    """
+
+    async def body():
+        from rio_tpu.protocol import ResponseEnvelope
+
+        gate = asyncio.Event()
+
+        class _StubService:
+            async def call(self, env):
+                await gate.wait()
+                return ResponseEnvelope.ok(b"")
+
+        proto = aio.ServerConnProtocol(_StubService)
+        transport = _RecordingTransport()
+        proto.connection_made(transport)
+        flood = proto.MAX_PENDING_FRAMES + 200
+        payload = _frame("bp", 0)
+        fed = 0
+        while fed < flood and not transport.paused:
+            n = min(50, flood - fed)
+            proto.data_received(payload * n)  # a real kernel stops after pause
+            fed += n
+            await asyncio.sleep(0)
+        assert transport.pauses >= 1, "flood never paused reads"
+        assert fed < flood, "pause came only after the whole flood buffered"
+        backlog = len(proto._queue) + len(proto._resp_q)
+        assert backlog <= proto.MAX_PENDING_FRAMES + 50 + proto.MAX_CONCURRENT
+
+        gate.set()  # handlers complete -> queue drains -> reads resume
+        for _ in range(300):
+            await asyncio.sleep(0)
+            if transport.resumes and not proto._queue and not proto._resp_q:
+                break
+        assert transport.resumes >= 1, "drain never resumed reads"
+        proto.data_received(payload * (flood - fed))  # post-resume remainder
+        for _ in range(300):
+            await asyncio.sleep(0)
+            if len(transport.writes) == flood:
+                break
+        assert len(transport.writes) == flood, "every buffered frame answered"
+        proto.eof_received()
+        await asyncio.sleep(0)
+        proto.connection_lost(None)
+        await asyncio.gather(proto._worker, return_exceptions=True)
+
+    asyncio.run(body())
+
+
+def test_native_client_conn_pipelined_fifo_is_race_free():
+    """Responses resolve the issuing roundtrip even when a later roundtrip
+    starts before an earlier (already-resolved) one resumes.
+
+    Regression for the round-3 advisor 'high': the shared-Queue design let a
+    roundtrip issued after a response was queued steal that response from the
+    parked earlier caller. The futures-deque design resolves frames to their
+    FIFO slot inside the engine drain, so arrival/resume interleaving is
+    irrelevant.
+    """
+
+    async def body():
+        from rio_tpu.native.transport import NativeClientConn
+
+        class _Sink:
+            def send(self, conn_id, data):
+                pass
+
+        class _EngineStub:
+            _engine = _Sink()
+
+        conn = NativeClientConn(_EngineStub(), 1)
+        rt1 = asyncio.ensure_future(conn.roundtrip(b"r1"))
+        await asyncio.sleep(0)  # rt1's waiter registered, parked
+        conn._deliver(b"resp1")  # resolves rt1's future; rt1 NOT yet resumed
+        rt2 = asyncio.ensure_future(conn.roundtrip(b"r2"))
+        await asyncio.sleep(0)  # rt2 registered before rt1 resumes
+        conn._deliver(b"resp2")
+        assert await rt1 == b"resp1"
+        assert await rt2 == b"resp2"
+
+        # Cancelled roundtrip: its orphan frame is discarded, one per slot.
+        rt3 = asyncio.ensure_future(conn.roundtrip(b"r3"))
+        await asyncio.sleep(0)
+        rt4 = asyncio.ensure_future(conn.roundtrip(b"r4"))
+        await asyncio.sleep(0)
+        rt3.cancel()
+        await asyncio.gather(rt3, return_exceptions=True)
+        conn._deliver(b"orphan")  # rt3's response -> dropped
+        conn._deliver(b"resp4")
+        assert await rt4 == b"resp4"
+        assert conn.pending == 0
+
+    asyncio.run(body())
