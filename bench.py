@@ -306,6 +306,162 @@ def _time_fn(fn, cost, mass, cap) -> tuple[float, float, object]:
     return min(times), compile_s, out
 
 
+def _collapsed_rate(
+    n_obj: int,
+    n_nodes: int = N_NODES,
+    dead_frac: float = 0.03,
+    n_iters: int = 30,
+    move_cost: float = 0.5,
+) -> dict:
+    """The directory's COMMITTED fast path for a full rebalance, end to end.
+
+    Measures exactly what ``JaxObjectPlacement.rebalance()`` runs for a
+    flat (non-mesh) OT-mode re-solve (``jax_placement.py`` collapsed
+    branch): per-seat counts -> class-collapsed (M x M) Sinkhorn
+    (``ops/structured.class_quotas``) -> on-device quota expansion
+    (``expand_class_quotas``) -> exact integer-quota repair — one XLA
+    pipeline, N never materializes an (N x M) cost.  Scenario is BASELINE
+    row 3/4: n_obj objects seated across n_nodes, ``dead_frac`` of nodes
+    just died (churn), the solve must re-seat the displaced share and
+    nothing else.  The reported time is the full placement DECISION for
+    all n_obj objects (scalar-checksum forced); the bulk host pull and the
+    O(N) directory dict update are timed separately — they are host-side
+    bookkeeping every Python directory pays, not part of the device solve.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rio_tpu.ops import exact_quota_repair
+    from rio_tpu.ops.assignment import build_cost_matrix
+    from rio_tpu.ops.structured import class_quotas, expand_class_quotas
+
+    m = n_nodes
+    n_dead = max(1, int(m * dead_frac))
+    cur = jax.random.randint(jax.random.PRNGKey(2), (n_obj,), 0, m, jnp.int32)
+    alive_np = np.ones(m, np.float32)
+    alive_np[:n_dead] = 0.0  # the churn event: n_dead nodes just died
+    alive = jnp.asarray(alive_np)
+    cap = jnp.ones((m,), jnp.float32)
+    # Same eps rule as the provider: off-diagonal leakage < 1e-8.
+    class_eps = min(0.05, move_cost / 25.0)
+
+    @jax.jit
+    def step(cur, cap, alive):
+        base_cost = build_cost_matrix(jnp.zeros((m,), jnp.float32), cap, alive)[0]
+        counts = jnp.bincount(cur, length=m)
+        quotas, g = class_quotas(
+            base_cost, counts, cap * alive,
+            move_cost=move_cost, eps=class_eps, n_iters=n_iters,
+        )
+        expanded = expand_class_quotas(quotas, cur)
+        cap_alive = cap * alive
+        expected = cap_alive / jnp.maximum(jnp.sum(cap_alive), 1e-30) * n_obj
+        assignment = exact_quota_repair(
+            expanded, expected, prefer_keep=expanded == cur
+        )
+        moved = jnp.sum(assignment != cur)
+        return assignment, g, moved, jnp.sum(assignment)
+
+    def force(out):
+        float(jnp.sum(out[-1]))
+
+    t0 = time.perf_counter()
+    out = step(cur, cap, alive)
+    jax.block_until_ready(out)
+    force(out)
+    compile_s = time.perf_counter() - t0
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = step(cur, cap, alive)
+        force(out)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+
+    # Host-side bookkeeping, timed separately: the 4 MB assignment pull and
+    # the O(N) directory dict update (what rebalance()'s apply loop does).
+    t0 = time.perf_counter()
+    a = np.asarray(out[0])
+    pull_ms = (time.perf_counter() - t0) * 1e3
+    keys = [str(i) for i in range(n_obj)]
+    directory = dict.fromkeys(keys, 0)
+    a_list = a.tolist()
+    t0 = time.perf_counter()
+    for k, idx in zip(keys, a_list):
+        directory[k] = idx
+    host_apply_ms = (time.perf_counter() - t0) * 1e3
+
+    displaced = int((np.asarray(cur) < n_dead).sum())  # objects on dead nodes
+    loads = np.bincount(a, minlength=m)
+    return {
+        "rate": n_obj / best,
+        "full_ms": round(best * 1e3, 2),
+        "compile_s": round(compile_s, 2),
+        "n_nodes": m,
+        "n_iters": n_iters,
+        "dead_nodes": n_dead,
+        "displaced": displaced,
+        "moved": int(out[2]),
+        "max_load": int(loads.max()),
+        "dead_load": int(loads[:n_dead].sum()),
+        "fair_load": n_obj // (m - n_dead),
+        "pull_ms": round(pull_ms, 2),
+        "host_apply_ms": round(host_apply_ms, 2),
+    }
+
+
+def _warm_assign_rate(batch: int, n_nodes: int = N_NODES) -> dict:
+    """BASELINE row 4's single-chip half: warm incremental allocation.
+
+    The ``assign_batch`` device path (``jax_placement._place_keys``): a
+    batch of NEW objects lands via the cached node potentials from the
+    last OT solve + greedy waterfill over remaining headroom — no Sinkhorn
+    re-solve on the allocation path.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from rio_tpu.ops.assignment import build_cost_matrix, greedy_balanced_assign
+
+    m = n_nodes
+    key = jax.random.PRNGKey(3)
+    g = jax.random.normal(key, (m,), jnp.float32) * 0.1  # cached potentials
+    load = jnp.ones((m,), jnp.float32) * (batch / m)
+    cap = jnp.ones((m,), jnp.float32)
+    alive = jnp.ones((m,), jnp.float32)
+
+    @jax.jit
+    def step(g, load, cap, alive):
+        cost = build_cost_matrix(load, cap, alive) - g[None, :]
+        rows = jnp.broadcast_to(cost, (batch, m))
+        mass = jnp.ones((batch,), jnp.float32)
+        a = greedy_balanced_assign(rows, mass, cap * alive, load)
+        return a, jnp.sum(a)
+
+    def force(out):
+        float(jnp.sum(out[-1]))
+
+    t0 = time.perf_counter()
+    out = step(g, load, cap, alive)
+    jax.block_until_ready(out)
+    force(out)
+    compile_s = time.perf_counter() - t0
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = step(g, load, cap, alive)
+        force(out)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    return {
+        "rate": batch / best,
+        "full_ms": round(best * 1e3, 2),
+        "batch": batch,
+        "compile_s": round(compile_s, 2),
+    }
+
+
 def _greedy_rate(n_obj: int, n_nodes: int = N_NODES) -> dict:
     """Greedy waterfill tier on the same inputs as the OT tier."""
     import jax
@@ -413,6 +569,53 @@ def run_hier_tier(n_obj: int, deadline: float) -> None:
         sys.exit(EXIT_SOLVE_FAIL)
 
 
+def run_collapsed_tier(n_obj: int, platform: str, deadline: float) -> None:
+    """Child entry for the collapsed-rebalance (fast path) + warm tiers.
+
+    The cheapest device tier (M x M solve + two O(N) sorts), so it runs
+    FIRST among the TPU children — the headline is banked before any heavy
+    dense tier can burn the relay window.
+    """
+    start = time.monotonic()
+    init_watchdog = _arm_watchdog(deadline, EXIT_WATCHDOG)
+    probe_timer = _arm_watchdog(min(PROBE_DEADLINE_S, deadline), EXIT_INIT_FAIL)
+    import jax
+
+    try:
+        devices = jax.devices()
+    except Exception as e:
+        print(f"# backend init failed: {type(e).__name__}: {e}", file=sys.stderr)
+        sys.exit(EXIT_INIT_FAIL)
+    probe_timer.cancel()
+    print(f"# devices: {devices}", file=sys.stderr)
+    if platform == "tpu" and devices[0].platform != "tpu":
+        print(f"# expected tpu, got platform={devices[0].platform}", file=sys.stderr)
+        sys.exit(EXIT_INIT_FAIL)
+    init_watchdog.cancel()
+    _arm_watchdog(deadline - (time.monotonic() - start), EXIT_TIER_TIMEOUT)
+    try:
+        tier = _collapsed_rate(n_obj)
+    except Exception as e:
+        print(f"# collapsed tier failed: {type(e).__name__}: {e}", file=sys.stderr)
+        sys.exit(EXIT_SOLVE_FAIL)
+    result = {
+        "ok": True,
+        "kind": "collapsed",
+        "platform": platform,
+        "device": str(devices[0]),
+        "n_obj": n_obj,
+        **tier,
+    }
+    print(json.dumps(result), flush=True)  # bank before the optional extra
+    remaining = deadline - (time.monotonic() - start)
+    if remaining > 45 + 6 * tier["full_ms"] / 1e3:
+        try:
+            result["warm_assign"] = _warm_assign_rate(65_536)
+            print(json.dumps(result), flush=True)
+        except Exception as e:
+            print(f"# warm-assign tier failed: {type(e).__name__}: {e}", file=sys.stderr)
+
+
 def run_tier(n_obj: int, platform: str, deadline: float) -> None:
     """Child entry: probe backend once, run one tier, print JSON result lines.
 
@@ -499,7 +702,10 @@ def run_tier(n_obj: int, platform: str, deadline: float) -> None:
 # ---------------------------------------------------------------------------
 
 
-def _run_child(n_obj: int, platform: str, deadline: float, hier: bool = False):
+def _run_child(
+    n_obj: int, platform: str, deadline: float, hier: bool = False,
+    collapsed: bool = False,
+):
     """Run one tier child; returns (rc, parsed_json_or_None)."""
     env = os.environ.copy()
     if platform == "cpu":
@@ -520,6 +726,8 @@ def _run_child(n_obj: int, platform: str, deadline: float, hier: bool = False):
     ]
     if hier:
         cmd.append("--hier")
+    if collapsed:
+        cmd.append("--collapsed")
     try:
         proc = subprocess.run(
             cmd, stdout=subprocess.PIPE, env=env,
@@ -618,20 +826,34 @@ def main() -> None:
         hops, hop_str = None, "hops unmeasured"
 
     result = None
-    # TPU tiers, largest first. An init failure or watchdog exit means the
-    # tunnel is down/wedged — retrying would burn ~25 min per attempt in
+    collapsed = None
+    tpu_down = False
+    # The collapsed-rebalance tier is the HEADLINE (the directory's
+    # committed fast path, BASELINE row 3's <50 ms class) and the cheapest
+    # device tier — run it first so it is banked before the heavy dense
+    # tiers can burn the relay window.
+    rc, collapsed = _run_child(1_048_576, "tpu", 300.0, collapsed=True)
+    if collapsed:
+        detail["collapsed_tier"] = collapsed
+        print(f"# collapsed rebalance tier: {collapsed}", file=sys.stderr)
+    elif rc in (EXIT_INIT_FAIL, EXIT_WATCHDOG):
+        tpu_down = True
+        print("# TPU backend unavailable; falling back to CPU", file=sys.stderr)
+    # Dense OT tiers, largest first. An init failure or watchdog exit means
+    # the tunnel is down/wedged — retrying would burn ~25 min per attempt in
     # backend setup (the round-1 failure mode), so abort TPU entirely.
-    for n_obj, deadline in ((1_048_576, 420.0), (524_288, 300.0), (262_144, 240.0)):
-        rc, parsed = _run_child(n_obj, "tpu", deadline)
-        if parsed:
-            result = parsed
-            break
-        if rc in (EXIT_INIT_FAIL, EXIT_WATCHDOG):
-            print("# TPU backend unavailable; falling back to CPU", file=sys.stderr)
-            break
-        # EXIT_SOLVE_FAIL (OOM) or EXIT_TIER_TIMEOUT (healthy probe, tier
-        # too slow): a smaller tier may still fit the deadline.
-        print(f"# tier {n_obj} rc={rc}; trying smaller tier", file=sys.stderr)
+    if not tpu_down:
+        for n_obj, deadline in ((1_048_576, 420.0), (524_288, 300.0), (262_144, 240.0)):
+            rc, parsed = _run_child(n_obj, "tpu", deadline)
+            if parsed:
+                result = parsed
+                break
+            if rc in (EXIT_INIT_FAIL, EXIT_WATCHDOG):
+                print("# TPU backend unavailable; falling back to CPU", file=sys.stderr)
+                break
+            # EXIT_SOLVE_FAIL (OOM) or EXIT_TIER_TIMEOUT (healthy probe, tier
+            # too slow): a smaller tier may still fit the deadline.
+            print(f"# tier {n_obj} rc={rc}; trying smaller tier", file=sys.stderr)
     if result is not None and result.get("platform") == "tpu":
         # BASELINE row 5 (scale ceiling): hierarchical 2-level OT toward
         # 10M x 1k, in its OWN child so an overrun can't cost the banked
@@ -644,6 +866,13 @@ def main() -> None:
         rc, parsed = _run_child(131_072, "cpu", 300.0)
         if parsed:
             result = parsed
+    if collapsed is None:
+        # No TPU collapsed number: still record the fast path on CPU (the
+        # 1M x 1024 rebalance decision is ~1-2 s warm even on host).
+        rc, collapsed = _run_child(1_048_576, "cpu", 300.0, collapsed=True)
+        if collapsed:
+            detail["collapsed_tier"] = collapsed
+            print(f"# collapsed rebalance tier (cpu): {collapsed}", file=sys.stderr)
     detail["solve_tier"] = result
     try:
         with open(
@@ -653,6 +882,37 @@ def main() -> None:
             json.dump(detail, fh, indent=1)
     except OSError as e:  # never let the sidecar kill the headline line
         print(f"# BENCH_DETAIL.json write failed: {e}", file=sys.stderr)
+
+    if collapsed is not None and collapsed.get("platform") == "tpu":
+        # The headline: what the directory actually runs for a full 1M-scale
+        # rebalance (class-collapsed device pipeline) — BASELINE row 3's
+        # <50 ms-class target.  The dense general-cost solve stays visible.
+        dense_str = (
+            f"; dense OT {result['rate']:.0f}/s"
+            if result is not None and result.get("platform") == "tpu"
+            else ""
+        )
+        warm = collapsed.get("warm_assign")
+        warm_str = f"; warm assign {warm['rate']:.0f}/s" if warm else ""
+        print(
+            json.dumps(
+                {
+                    "metric": (
+                        "placements/sec (committed rebalance fast path: "
+                        "class-collapsed solve+expand+repair on device, "
+                        f"{collapsed['n_obj']} objects x {collapsed['n_nodes']} "
+                        f"nodes re-seated in {collapsed['full_ms']} ms after "
+                        f"{collapsed['dead_nodes']} node deaths, moved "
+                        f"{collapsed['moved']} (displaced {collapsed['displaced']}), "
+                        f"tpu{dense_str}{warm_str}; {hop_str})"
+                    ),
+                    "value": round(collapsed["rate"], 1),
+                    "unit": "placements/sec",
+                    "vs_baseline": round(collapsed["rate"] / baseline, 2),
+                }
+            )
+        )
+        return
 
     if result is None:
         # Solve tiers all failed: still emit a real measured number so the
@@ -677,10 +937,15 @@ def main() -> None:
     if result["platform"] == "cpu" and "greedy" in result:
         # Headline the mode a CPU deployment actually runs (greedy tier);
         # the OT rate stays visible in the metric string and the sidecar.
+        coll_str = (
+            f"; collapsed 1M-rebalance {collapsed['full_ms']:.0f}ms"
+            if collapsed is not None
+            else ""
+        )
         metric = (
             f"placements/sec (greedy tier — what mode='auto' selects off-TPU "
             f"— {result['n_obj']} objects x {N_NODES} nodes, cpu; OT solve "
-            f"{result['rate']:.0f}/s; {hop_str})"
+            f"{result['rate']:.0f}/s{coll_str}; {hop_str})"
         )
         value = result["greedy"]["rate"]
     else:
@@ -707,9 +972,12 @@ if __name__ == "__main__":
     parser.add_argument("--platform", choices=("tpu", "cpu"), default="tpu")
     parser.add_argument("--deadline", type=float, default=300.0)
     parser.add_argument("--hier", action="store_true")
+    parser.add_argument("--collapsed", action="store_true")
     args = parser.parse_args()
     if args.tier is not None and args.hier:
         run_hier_tier(args.tier, args.deadline)
+    elif args.tier is not None and args.collapsed:
+        run_collapsed_tier(args.tier, args.platform, args.deadline)
     elif args.tier is not None:
         run_tier(args.tier, args.platform, args.deadline)
     else:

@@ -646,14 +646,22 @@ class JaxObjectPlacement(ObjectPlacement):
                         eps=class_eps,
                         n_iters=self._n_iters,
                     )
-                    raw = _apply_class_quotas(np.asarray(quotas), cur_idx)
+                    # Device expansion (exact parity with the host
+                    # _apply_class_quotas, tested): the whole decision —
+                    # counts -> class solve -> expansion -> exact repair —
+                    # stays one device pipeline; the only host pull is the
+                    # final int32 assignment below. Padding rows expand to
+                    # garbage and are overridden by the repair's sentinel.
+                    from ..ops.structured import expand_class_quotas
+
+                    cur_padded = jnp.zeros((bucket,), jnp.int32).at[:n].set(
+                        jnp.asarray(cur_idx)
+                    )
+                    expanded = expand_class_quotas(quotas, cur_padded)
                     # Column sums of per-row-rounded quotas are only
                     # approximately capacity; the shared repair makes node
                     # loads exactly integer-quota (still O(N log N)).
-                    padded = jnp.zeros((bucket,), jnp.int32).at[:n].set(
-                        jnp.asarray(raw)
-                    )
-                    assignment = _repair_exact(padded)
+                    assignment = _repair_exact(expanded)
                 else:
                     base_cost = build_cost_matrix(jnp.zeros_like(load), cap, alive)
                     cost = jnp.broadcast_to(base_cost, (bucket, base_cost.shape[1]))

@@ -35,7 +35,7 @@ import jax.numpy as jnp
 
 from .sinkhorn import sinkhorn
 
-__all__ = ["class_quotas"]
+__all__ = ["class_quotas", "expand_class_quotas"]
 
 
 @functools.partial(jax.jit, static_argnames=("eps", "n_iters"))
@@ -97,3 +97,60 @@ def class_quotas(
     ].set(jnp.broadcast_to(jnp.arange(m)[None, :], (m, m)))
     quotas = (base + (rank < short[:, None])).astype(jnp.int32)
     return quotas, res.g
+
+
+@jax.jit
+def expand_class_quotas(quotas: jax.Array, cur: jax.Array) -> jax.Array:
+    """Expand (M x M) class quotas into a per-object assignment ON DEVICE.
+
+    The device counterpart of the host expansion
+    (``jax_placement._apply_class_quotas``) with identical semantics:
+    within class k (objects whose current seat is node k, ordered by their
+    stable per-class rank) the first ``quotas[k, k]`` objects stay put,
+    the rest fill the remaining columns in index order — the move-minimal
+    application of :func:`class_quotas`.  Keeping this step on device turns
+    the whole collapsed-rebalance decision (counts -> class solve ->
+    expansion -> exact repair) into one XLA pipeline with a single 4-byte/row
+    host pull at the end: O(N log N) sort + O(N log M) binary search, no
+    (N x M) materialization anywhere.
+
+    Args:
+      quotas: (M, M) int32, rows summing exactly to per-class counts.
+      cur: (B,) int32 current seats, padding rows AFTER the real rows (the
+        provider pads with zeros; stable ranking keeps real class-0 ranks
+        unaffected).  Padding rows whose rank exceeds their class count get
+        a clamped, meaningless target — callers mask them (the provider's
+        exact repair overrides padding with a sentinel column).
+
+    Returns:
+      (B,) int32 target node per object.
+    """
+    m = quotas.shape[0]
+    cols = jnp.arange(m, dtype=jnp.int32)
+    # Diag-first column order per row: [k, 0, 1, ..., k-1, k+1, ..., M-1].
+    key = jnp.where(cols[None, :] == cols[:, None], -1, cols[None, :])
+    colorder = jnp.argsort(key, axis=1).astype(jnp.int32)
+    q_re = jnp.take_along_axis(quotas, colorder, axis=1)
+    cum = jnp.cumsum(q_re, axis=1)  # inclusive; cum[k, -1] == counts[k]
+
+    from .assignment import rank_within_group
+
+    order, _, rank_sorted = rank_within_group(cur)
+    rank = jnp.zeros_like(cur).at[order].set(rank_sorted)
+
+    # Per-object binary search: smallest j with cum[cur_i, j] > rank_i
+    # (searchsorted side='right'), as log2(M) elementwise gathers instead
+    # of gathering (B, M) rows (4 GB at 1M x 1k).
+    lo = jnp.zeros_like(cur)
+    hi = jnp.full_like(cur, m)
+    n_steps = max(1, (m + 1).bit_length())
+
+    def body(_, lohi):
+        lo, hi = lohi
+        mid = (lo + hi) // 2
+        go_right = cum[cur, mid] <= rank
+        return jnp.where(go_right, mid + 1, lo), jnp.where(go_right, hi, mid)
+
+    lo, hi = jax.lax.fori_loop(0, n_steps, body, (lo, hi))
+    jpos = jnp.clip(lo, 0, m - 1)
+    return colorder[cur, jpos]

@@ -392,6 +392,41 @@ def test_apply_class_quotas_unit():
         assert stayed == quotas[k, k]
 
 
+def test_expand_class_quotas_matches_host_apply():
+    """Device quota expansion is byte-identical to the host expansion.
+
+    The collapsed rebalance now runs expansion on device
+    (``ops.structured.expand_class_quotas``); the host
+    ``_apply_class_quotas`` stays as the semantic reference. Covers
+    padding (bucket > n), empty classes, and skewed quota rows.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rio_tpu.object_placement.jax_placement import _apply_class_quotas
+    from rio_tpu.ops.structured import expand_class_quotas
+
+    rng = np.random.default_rng(7)
+    for m, n in ((3, 8), (17, 900), (64, 4000)):
+        cur = rng.integers(0, m, n).astype(np.int32)
+        cur[: n // 5] = 0  # ensure class 0 is populated (padding shares it)
+        counts = np.bincount(cur, minlength=m)
+        quotas = np.zeros((m, m), np.int32)
+        for k in range(m):
+            if counts[k]:
+                quotas[k] = rng.multinomial(counts[k], np.ones(m) / m)
+        host = _apply_class_quotas(quotas, cur)
+        bucket = 1
+        while bucket < n:
+            bucket *= 2
+        cur_pad = np.zeros(bucket, np.int32)
+        cur_pad[:n] = cur
+        dev = np.asarray(
+            expand_class_quotas(jnp.asarray(quotas), jnp.asarray(cur_pad))
+        )[:n]
+        assert (host == dev).all(), (m, n, np.nonzero(host != dev)[0][:5])
+
+
 def test_provider_construction_initializes_no_backend():
     """Constructing a provider must NEVER initialize a jax backend.
 
