@@ -86,10 +86,17 @@ class AffinityTracker:
         tracker.observe(str(object_id), serving_address, weight=1.0)
     """
 
-    def __init__(self, dim: int = _FEAT_DIM, stickiness: float = 0.75) -> None:
+    def __init__(self, dim: int = _FEAT_DIM, stickiness: float = 0.25) -> None:
         self.dim = dim
-        # EMA coefficient toward the serving node's embedding; 1.0 pins an
-        # object to its last server, 0.0 disables learning.
+        # EMA coefficient toward the serving node's embedding per unit
+        # weight; 0.0 disables learning.  The default keeps MULTI-node
+        # warmth: with interleaved traffic the feature converges to the
+        # traffic-share mix of the serving nodes' embeddings (a 3:1 split
+        # leaves a clearly detectable secondary component), while a high
+        # value (~1) degenerates to last-server-wins and erases every
+        # warm replica the moment traffic touches the primary — measured
+        # to destroy the churn-failover payoff in
+        # ``tests/test_affinity_payoff.py``.
         self.stickiness = stickiness
         self._obj: dict[str, np.ndarray] = {}
         self._node_cache: dict[str, np.ndarray] = {}
@@ -106,8 +113,9 @@ class AffinityTracker:
         """Record that ``key`` was served by ``node_address``.
 
         ``weight`` scales the pull (e.g. request count since last observe,
-        or bytes of state touched)."""
-        alpha = min(1.0, self.stickiness * weight)
+        or bytes of state touched).  Alpha is capped below 1 so a single
+        heavy observation can never fully erase accumulated warmth."""
+        alpha = min(0.95, self.stickiness * weight)
         if alpha <= 0.0:
             return
         target = self._node_vec(node_address)
@@ -248,13 +256,17 @@ class JaxObjectPlacement(ObjectPlacement):
         # balancing proxy; plug an AffinityTracker (or anything encoding
         # state size / cache warmth / request rate) to make the OT affinity
         # term carry real locality signal.
-        if (obj_features or node_features or affinity_tracker) and mode != "hierarchical":  # noqa: E501 — hooks demand hierarchical; auto never resolves to it
+        has_affinity = bool(obj_features or node_features or affinity_tracker)
+        if has_affinity and mode not in ("hierarchical", "auto"):
             # Flat modes build per-node costs only and would silently
             # ignore the hooks — fail at construction, not at solve time.
+            # mode="auto" is allowed: with a locality signal present it
+            # resolves to "hierarchical" (see _solver_mode).
             raise ValueError(
                 "obj_features/node_features/affinity_tracker are only consumed "
                 f'by mode="hierarchical" (got mode={mode!r})'
             )
+        self._has_affinity = has_affinity
         # Carrying the tracker on the provider lets the Server auto-wire
         # AffinityTracker.observe into the dispatch path (every served
         # request updates the object's locality feature — no app code).
@@ -281,16 +293,29 @@ class JaxObjectPlacement(ObjectPlacement):
     def _solver_mode(self) -> str:
         """Resolve ``mode="auto"`` on first use (first backend touch).
 
-        The dense OT solve wins on an accelerator (measured 35x the SQL
-        baseline on TPU v5e) but loses to the thing it replaces on host
-        CPUs, where the O(N log M) greedy waterfill is the right default
-        (measured ~26x the baseline). Flat OT rebalances additionally
-        collapse to O(M^2) either way (see ``rebalance``).
+        The rule (measured; see ``tests/test_affinity_payoff.py`` and
+        BENCH_DETAIL.json):
+
+        * **locality signal present** (an ``AffinityTracker`` or feature
+          hooks were wired) → ``hierarchical``: it is the only mode that
+          consumes per-object affinity, its payoff is large (~4x fewer
+          state reloads after churn on a warm-traffic workload), and its
+          O(N*(G+S+d)) cost is accelerator-independent — cheaper than the
+          dense solve everywhere.
+        * otherwise, per-node costs only: the dense OT solve wins on an
+          accelerator (measured 35x the SQL baseline on TPU v5e) but loses
+          to the thing it replaces on host CPUs, where the O(N log M)
+          greedy waterfill is the right default (measured ~26x the
+          baseline). Flat OT rebalances additionally collapse to O(M^2)
+          either way (see ``rebalance``).
         """
         if self._mode == "auto":
-            self._mode = (
-                "sinkhorn" if jax.default_backend() == "tpu" else "greedy"
-            )
+            if self._has_affinity:
+                self._mode = "hierarchical"
+            else:
+                self._mode = (
+                    "sinkhorn" if jax.default_backend() == "tpu" else "greedy"
+                )
         return self._mode
 
     # ------------------------------------------------- directory internals

@@ -51,18 +51,6 @@ class HierarchicalResult(NamedTuple):
     overflow: jax.Array    # scalar int32: objects that missed their bucket
 
 
-def _coarse_features(node_feat, node_capacity, alive, n_groups):
-    """Capacity-weighted mean feature + total capacity per group."""
-    d, m = node_feat.shape
-    s = m // n_groups
-    w = (node_capacity * alive).astype(jnp.float32)  # (M,)
-    wg = w.reshape(n_groups, s)  # (G, S)
-    fg = node_feat.reshape(d, n_groups, s)  # (d, G, S)
-    group_cap = jnp.sum(wg, axis=1)  # (G,)
-    group_feat = jnp.einsum("dgs,gs->dg", fg, wg) / jnp.maximum(group_cap, 1e-30)
-    return group_feat, group_cap
-
-
 @functools.partial(
     jax.jit,
     static_argnames=("n_groups", "bucket", "eps", "coarse_iters", "fine_iters"),
@@ -105,11 +93,36 @@ def hierarchical_assign(
     cap = node_capacity.astype(jnp.float32) * alive.astype(jnp.float32)
 
     # ---- stage 1: coarse obj -> group ------------------------------------
-    group_feat, group_cap = _coarse_features(node_feat, node_capacity, alive, n_groups)
-    coarse_cost = -(obj_feat @ group_feat)  # (N, G)
+    # Coarse affinity = the object's BEST live member in each group, not
+    # the group's mean embedding: with near-orthogonal node embeddings a
+    # mean dilutes a single warm node by 1/S (measured: it dropped the
+    # churn-failover locality hit rate to chance in
+    # tests/test_affinity_payoff.py), while the max routes the object to
+    # whichever group holds its warm state.  Computed blockwise over
+    # groups — an (N, S) temp per step, the same working-set scale as the
+    # fine stage; the (N, M) product is never materialized.
+    node_feat_grouped = node_feat.reshape(d, n_groups, s).transpose(1, 0, 2)
+    alive_grouped = (cap > 0).reshape(n_groups, s)
+    group_cap = cap.reshape(n_groups, s).sum(axis=1)  # (G,)
+
+    def _group_best(args):
+        nf_g, alive_g = args  # (d, S), (S,)
+        scores = obj_feat @ nf_g  # (N, S)
+        scores = jnp.where(alive_g[None, :], scores, -jnp.inf)
+        return jnp.max(scores, axis=1)  # (N,)
+
+    coarse_aff = jax.lax.map(_group_best, (node_feat_grouped, alive_grouped))
+    live_group = group_cap > 0  # (G,)
+    raw_cost = -coarse_aff.T  # (N, G); +inf on all-dead groups
     # Normalize the cost scale so eps is a relative knob (and the scaling
-    # solver's exp(-C/eps) stays in float range for any feature magnitude).
-    coarse_cost = coarse_cost / jnp.maximum(jnp.std(coarse_cost), 1e-6)
+    # solver's exp(-C/eps) stays in float range for any feature magnitude)
+    # — statistics over LIVE groups only, then a finite terrible cost on
+    # dead groups (their zero group_cap already excludes them from the OT
+    # marginals).
+    std = jnp.std(raw_cost, where=live_group[None, :])
+    coarse_cost = jnp.where(
+        live_group[None, :], raw_cost / jnp.maximum(std, 1e-6), 1e6
+    )
     mass = jnp.ones((n,), jnp.float32)
     res_c = scaling_sinkhorn(
         coarse_cost, mass, group_cap, eps=eps, n_iters=coarse_iters
