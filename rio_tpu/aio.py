@@ -73,6 +73,8 @@ class ServerConnProtocol(asyncio.Protocol):
         "_room",
         "_broken",
         "_lost",
+        "_out",
+        "_flush_scheduled",
     )
 
     def __init__(
@@ -97,6 +99,8 @@ class ServerConnProtocol(asyncio.Protocol):
         self._room: asyncio.Future | None = None  # reader parked on cap
         self._broken = False  # a response failed; FIFO can't recover
         self._lost = False  # connection_lost fired; writes are pointless
+        self._out: list[bytes] = []  # corked response frames (one syscall/tick)
+        self._flush_scheduled = False
 
     # -- transport callbacks -------------------------------------------------
 
@@ -172,32 +176,60 @@ class ServerConnProtocol(asyncio.Protocol):
         self._flush_ready()
 
     def _flush_ready(self) -> None:
-        """Write every completed head response, preserving request order.
+        """Queue every completed head response, preserving request order.
 
         Runs synchronously from the handler task's done-callback — only the
-        FIFO head's completion actually writes (possibly several at once),
-        so out-of-order completions cost nothing until their turn.
+        FIFO head's completion actually emits (possibly several at once),
+        so out-of-order completions cost nothing until their turn.  Frames
+        are CORKED: appended to ``_out`` and written as one syscall at the
+        end of the loop tick (``_do_flush``) — under pipelining this
+        collapses dozens of per-response ``send``s into one.
         """
         q = self._resp_q
-        transport = self._transport
-        assert transport is not None
         while q and q[0].done() and not self._broken:
             fut = q.popleft()
             if fut.cancelled() or self._lost:
                 continue  # shutdown path / dead socket; nothing to write
             try:
-                transport.write(encode_response_frame(fut.result()))
+                self._write_soon(encode_response_frame(fut.result()))
             except Exception:
                 # An unencodable/failed response would desync every later
                 # FIFO match on this connection; drop the connection.
-                log.exception("response write error; dropping connection")
-                self._broken = True
-                self._eof = True
-                self._wake()
-                transport.close()
+                log.exception("response encode error; dropping connection")
+                self._break()
                 break
         self._wake_room()
         self._maybe_resume_reading()
+
+    def _break(self) -> None:
+        self._broken = True
+        self._eof = True
+        self._out.clear()
+        self._wake()
+        assert self._transport is not None
+        self._transport.close()
+
+    def _write_soon(self, data: bytes) -> None:
+        self._out.append(data)
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            asyncio.get_running_loop().call_soon(self._do_flush)
+
+    def _do_flush(self) -> None:
+        self._flush_scheduled = False
+        out = self._out
+        if not out:
+            return
+        data = out[0] if len(out) == 1 else b"".join(out)
+        out.clear()
+        if self._lost or self._broken:
+            return
+        try:
+            assert self._transport is not None
+            self._transport.write(data)
+        except Exception:
+            log.exception("response write error; dropping connection")
+            self._break()
 
     def _wake_room(self) -> None:
         r = self._room
@@ -278,10 +310,10 @@ class ServerConnProtocol(asyncio.Protocol):
                         resp = await service.call(inbound)
                         if not self._broken:
                             try:
-                                transport.write(encode_response_frame(resp))
+                                self._write_soon(encode_response_frame(resp))
                             except Exception:
                                 log.exception(
-                                    "response write error; dropping connection"
+                                    "response encode error; dropping connection"
                                 )
                                 return
                         if self._paused:
@@ -297,6 +329,7 @@ class ServerConnProtocol(asyncio.Protocol):
                     while self._resp_q and not self._eof:
                         self._room = loop.create_future()
                         await self._room
+                    self._do_flush()  # corked responses precede the stream
                     self._streaming = True
                     await self._stream_subscription(inbound)
                     return
@@ -315,6 +348,8 @@ class ServerConnProtocol(asyncio.Protocol):
                 for fut in self._resp_q:
                     fut.cancel()
                 self._resp_q.clear()
+                self._out.clear()
+            self._do_flush()  # corked frames must beat transport.close()
             transport.close()
 
     async def _stream_subscription(self, req: SubscriptionRequest) -> None:
@@ -350,7 +385,16 @@ class ClientConnProtocol(asyncio.Protocol):
     in-flight depth for the pool's least-loaded pick.
     """
 
-    __slots__ = ("_frames", "_waiters", "_queue", "_transport", "closed", "delivered")
+    __slots__ = (
+        "_frames",
+        "_waiters",
+        "_queue",
+        "_transport",
+        "closed",
+        "delivered",
+        "_out",
+        "_flush_scheduled",
+    )
 
     def __init__(self) -> None:
         self._frames = FrameReader()
@@ -359,6 +403,8 @@ class ClientConnProtocol(asyncio.Protocol):
         self._transport: asyncio.Transport | None = None
         self.closed = False
         self.delivered = 0  # inbound frames seen (client's progress signal)
+        self._out: list[bytes] = []  # corked request frames (one syscall/tick)
+        self._flush_scheduled = False
 
     @property
     def pending(self) -> int:
@@ -396,13 +442,39 @@ class ClientConnProtocol(asyncio.Protocol):
 
     # -- conn surface ---------------------------------------------------------
 
+    def _write_soon(self, frame_bytes: bytes) -> None:
+        """Cork writes: one syscall per loop tick instead of per request.
+
+        Order safety: waiter registration order == append order == flush
+        order, and the server cannot answer a frame before it is written,
+        so FIFO matching is unaffected.
+        """
+        self._out.append(frame_bytes)
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            asyncio.get_running_loop().call_soon(self._do_flush)
+
+    def _do_flush(self) -> None:
+        self._flush_scheduled = False
+        out = self._out
+        if not out or self.closed or self._transport is None:
+            out.clear()
+            return
+        data = out[0] if len(out) == 1 else b"".join(out)
+        out.clear()
+        try:
+            self._transport.write(data)
+        except Exception:
+            log.exception("request write error; dropping connection")
+            self.close()
+
     async def roundtrip(self, frame_bytes: bytes) -> bytes:
         if self.closed:
             raise Disconnect("connection closed")
         assert self._transport is not None
         fut = asyncio.get_running_loop().create_future()
         self._waiters.append(fut)
-        self._transport.write(frame_bytes)
+        self._write_soon(frame_bytes)
         payload = await fut
         if payload is None:
             raise Disconnect("connection closed mid-request")
@@ -420,9 +492,10 @@ class ClientConnProtocol(asyncio.Protocol):
 
     def write(self, frame_bytes: bytes) -> None:
         assert self._transport is not None
-        self._transport.write(frame_bytes)
+        self._write_soon(frame_bytes)
 
     def close(self) -> None:
+        self._do_flush()  # corked frames must beat transport.close()
         self.closed = True
         if self._transport is not None:
             self._transport.close()

@@ -123,6 +123,15 @@ def serialize(value: Any) -> bytes:
         >>> codec.deserialize(codec.serialize([1, "two", b"3"]), list)
         [1, 'two', b'3']
     """
+    # Eager top-level lowering: message bodies are almost always a single
+    # dataclass, and converting it here skips one C->Python default-hook
+    # callback per message (the hook still handles nested nodes).  The
+    # dict-hit path dodges is_dataclass/isinstance for every known type.
+    names = _DC_FIELD_NAMES.get(type(value))
+    if names is not None:
+        value = [getattr(value, name) for name in names]
+    elif dataclasses.is_dataclass(value) and not isinstance(value, type):
+        value = [getattr(value, name) for name in _dc_field_names(type(value))]
     try:
         return msgpack.packb(value, use_bin_type=True, default=_pack_default)
     except (TypeError, ValueError, msgpack.exceptions.PackException) as e:
@@ -267,12 +276,15 @@ def deserialize(data: bytes, ty: Any) -> Any:
         wire = msgpack.unpackb(data, raw=False, strict_map_key=False)
     except (ValueError, msgpack.exceptions.UnpackException) as e:
         raise SerializationError(str(e)) from e
-    if isinstance(ty, type) and dataclasses.is_dataclass(ty):
+    # Dict-hit fast path for known dataclass types (skips the
+    # isinstance/is_dataclass pair on the per-message hot path).
+    dec = _DC_DECODERS.get(ty)
+    if dec is None and isinstance(ty, type) and dataclasses.is_dataclass(ty):
         dec = _dc_decoder(ty)
-        if dec is not None:
-            if not isinstance(wire, (list, tuple)):
-                raise SerializationError(f"expected array for dataclass {ty.__name__}")
-            return dec(wire)
+    if dec is not None:
+        if not isinstance(wire, (list, tuple)):
+            raise SerializationError(f"expected array for dataclass {ty.__name__}")
+        return dec(wire)
     return _from_wire(wire, ty)
 
 

@@ -399,11 +399,18 @@ def test_server_inbound_backpressure_pauses_and_resumes_reads():
                 break
         assert transport.resumes >= 1, "drain never resumed reads"
         proto.data_received(payload * (flood - fed))  # post-resume remainder
+
+        def frames_written():
+            from rio_tpu.codec import FrameReader
+
+            fr = FrameReader()
+            return sum(len(fr.feed(w)) for w in transport.writes)
+
         for _ in range(300):
             await asyncio.sleep(0)
-            if len(transport.writes) == flood:
+            if frames_written() == flood:
                 break
-        assert len(transport.writes) == flood, "every buffered frame answered"
+        assert frames_written() == flood, "every buffered frame answered"
         proto.eof_received()
         await asyncio.sleep(0)
         proto.connection_lost(None)
