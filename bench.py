@@ -27,6 +27,7 @@ Robustness design (the round-1 artifact died in backend init, rc=124):
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import os
 import sqlite3
@@ -154,13 +155,45 @@ def live_route_hops() -> dict:
 def _arm_watchdog(seconds: float, code: int) -> threading.Timer:
     """Hard in-process deadline: fires even if the main thread is stuck in C."""
 
-    t = threading.Timer(seconds, lambda: os._exit(code))
+    def fire():
+        # One stderr line before dying so a silent rc in the parent's log
+        # is attributable (r4: the hier child vanished with bare rc=99).
+        print(f"# watchdog fired after {seconds:.0f}s -> exit {code}",
+              file=sys.stderr, flush=True)
+        os._exit(code)
+
+    t = threading.Timer(seconds, fire)
     t.daemon = True
     t.start()
     return t
 
 
-def _solve_rate(n_obj: int, kernel_dtype, n_nodes: int = N_NODES, n_iters: int = 30) -> dict:
+def _time_chained(chained_fn, args, k: int) -> tuple[float, float]:
+    """Compile + best-of-2 timed runs of a k-step chained executable.
+
+    ``chained_fn(*args, k)`` must return a jit-computed scalar; the plain
+    float() pull is the sync (see _time_fn). Returns
+    (per_step_seconds, compile_seconds). One copy of the protocol so the
+    three chained tiers cannot drift.
+    """
+    t0 = time.perf_counter()
+    float(chained_fn(*args, k))
+    compile_s = time.perf_counter() - t0
+    ts = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        float(chained_fn(*args, k))
+        ts.append(time.perf_counter() - t0)
+    return min(ts) / k, compile_s
+
+
+def _solve_rate(
+    n_obj: int,
+    kernel_dtype,
+    n_nodes: int = N_NODES,
+    n_iters: int = 30,
+    chain_budget_s: float | None = None,
+) -> dict:
     """On-device OT solve throughput; returns a result dict.
 
     Uses the scaling-form core (``rio_tpu/ops/scaling.py``): K = exp(-C/eps)
@@ -233,9 +266,45 @@ def _solve_rate(n_obj: int, kernel_dtype, n_nodes: int = N_NODES, n_iters: int =
             jnp.sum(assignment),
         )
 
+    t_enter = time.perf_counter()
     cost, mass, cap = _tier_inputs(n_obj, n_nodes)
     solve_s, solve_compile, _ = _time_fn(jax.jit(solve_only), cost, mass, cap)
     full_s, full_compile, out = _time_fn(jax.jit(step), cost, mass, cap)
+
+    # Sustained solve time: K solves chained in one executable, one pull at
+    # the end — the relay's per-call dispatch+sync (~300 ms r4) divides
+    # out; see _collapsed_rate. The inter-step dependence (mass + 1e-20*u)
+    # is structurally real but bit-exact identity in fp32, so every step
+    # solves the same problem without the loop hoisting it. Budgeted from
+    # MEASURED timings of this very call (the budget arrives stale — the
+    # two compiles above already burned into it): one more compile of
+    # comparable cost + 3 chained executions must clearly fit.
+    chained_res = None
+    k_chain = int(min(8, max(2, round(6.0 / max(solve_s, 0.05)))))
+    if chain_budget_s is not None:
+        elapsed = time.perf_counter() - t_enter
+        projected = 1.5 * (solve_compile + full_compile) / 2 + 3 * k_chain * solve_s
+        if chain_budget_s - elapsed > projected:
+
+            @functools.partial(jax.jit, static_argnames=("k",))
+            def chained_solve(cost, mass, cap, k):
+                def body(_, mass_c):
+                    u, v, K, _sh = scaling_core(
+                        cost, mass_c, cap,
+                        eps=0.05, n_iters=n_iters, kernel_dtype=kernel_dtype,
+                    )
+                    return mass_c + 1e-20 * u
+                final = lax.fori_loop(0, k, body, mass)
+                return jnp.sum(final)
+
+            per_step_s, chain_compile_s = _time_chained(
+                chained_solve, (cost, mass, cap), k_chain
+            )
+            chained_res = {
+                "solve_chain_ms": round(per_step_s * 1e3, 2),
+                "solve_chain_steps": k_chain,
+                "chain_compile_s": round(chain_compile_s, 2),
+            }
     # Quality evidence from the already-computed assignment: the speed
     # number only counts if it is actually capacity-balanced.
     import numpy as np
@@ -245,9 +314,16 @@ def _solve_rate(n_obj: int, kernel_dtype, n_nodes: int = N_NODES, n_iters: int =
     # placement scores 0.50; lower is better (shows the solve optimizes
     # per-object cost, not just balance). Computed inside the jitted step.
     mean_cost = float(out[1])
-    return {
-        "rate": n_obj / full_s,
-        "full_ms": round(full_s * 1e3, 2),
+    # With a chained solve time, the per-decision latency is the sustained
+    # solve plus the rounding share. The rounding share is the DIFFERENCE
+    # of two single-call times, so the relay's per-call overhead cancels.
+    decision_s = full_s
+    if chained_res is not None:
+        decision_s = chained_res["solve_chain_ms"] / 1e3 + max(full_s - solve_s, 0.0)
+    result = {
+        "rate": n_obj / decision_s,
+        "full_ms": round(decision_s * 1e3, 2),
+        "single_shot_ms": round(full_s * 1e3, 2),
         "sinkhorn_ms": round(solve_s * 1e3, 2),
         "compile_s": round(solve_compile + full_compile, 2),
         "n_nodes": n_nodes,
@@ -257,6 +333,9 @@ def _solve_rate(n_obj: int, kernel_dtype, n_nodes: int = N_NODES, n_iters: int =
         "mean_cost": round(mean_cost, 4),
         "marginal_err": float(out[2]),
     }
+    if chained_res is not None:
+        result.update(chained_res)
+    return result
 
 
 def _tier_inputs(n_obj: int, n_nodes: int):
@@ -284,13 +363,16 @@ def _time_fn(fn, cost, mass, cap) -> tuple[float, float, object]:
     """Warm (compile) + best-of-3; the host float() pull forces completion
     (the axon tunnel's block_until_ready returns early). Returns
     (best_seconds, compile_seconds, last_output) — callers reuse the
-    output for quality checks instead of paying another on-device run."""
+    output for quality checks instead of paying another on-device run.
+
+    The pull is a PLAIN float() on the jit-computed scalar checksum —
+    never an eager-op wrapper: mixing eager ops into the sync path hung
+    indefinitely through the axon relay (r4 wedge)."""
     import jax
-    import jax.numpy as jnp
 
     def force(out):
         chk = out[-1] if isinstance(out, tuple) else out
-        float(jnp.sum(chk))
+        float(chk)
 
     t0 = time.perf_counter()
     out = fn(cost, mass, cap)
@@ -312,6 +394,7 @@ def _collapsed_rate(
     dead_frac: float = 0.03,
     n_iters: int = 30,
     move_cost: float = 0.5,
+    chain_budget_s: float | None = None,
 ) -> dict:
     """The directory's COMMITTED fast path for a full rebalance, end to end.
 
@@ -323,10 +406,13 @@ def _collapsed_rate(
     pipeline, N never materializes an (N x M) cost.  Scenario is BASELINE
     row 3/4: n_obj objects seated across n_nodes, ``dead_frac`` of nodes
     just died (churn), the solve must re-seat the displaced share and
-    nothing else.  The reported time is the full placement DECISION for
-    all n_obj objects (scalar-checksum forced); the bulk host pull and the
-    O(N) directory dict update are timed separately — they are host-side
-    bookkeeping every Python directory pays, not part of the device solve.
+    nothing else.  The headline time is the SUSTAINED per-decision latency
+    over a chain of churn re-solves compiled into one executable (each
+    step re-seats the previous step's assignment after a fresh node-death
+    wave) — the relay's per-call dispatch+sync overhead, which dwarfs the
+    device compute at this size, divides out.  The single-call time (incl.
+    one relay sync), the bulk host pull, and the O(N) directory dict
+    update are reported separately.
     """
     import jax
     import jax.numpy as jnp
@@ -336,6 +422,7 @@ def _collapsed_rate(
     from rio_tpu.ops.assignment import build_cost_matrix
     from rio_tpu.ops.structured import class_quotas, expand_class_quotas
 
+    t_enter = time.perf_counter()
     m = n_nodes
     n_dead = max(1, int(m * dead_frac))
     cur = jax.random.randint(jax.random.PRNGKey(2), (n_obj,), 0, m, jnp.int32)
@@ -346,8 +433,8 @@ def _collapsed_rate(
     # Same eps rule as the provider: off-diagonal leakage < 1e-8.
     class_eps = min(0.05, move_cost / 25.0)
 
-    @jax.jit
-    def step(cur, cap, alive):
+    def decide(cur, cap, alive):
+        """The committed rebalance decision, exactly as the provider runs it."""
         base_cost = build_cost_matrix(jnp.zeros((m,), jnp.float32), cap, alive)[0]
         counts = jnp.bincount(cur, length=m)
         quotas, g = class_quotas(
@@ -360,11 +447,21 @@ def _collapsed_rate(
         assignment = exact_quota_repair(
             expanded, expected, prefer_keep=expanded == cur
         )
+        return assignment, g
+
+    @jax.jit
+    def step(cur, cap, alive):
+        assignment, g = decide(cur, cap, alive)
         moved = jnp.sum(assignment != cur)
         return assignment, g, moved, jnp.sum(assignment)
 
     def force(out):
-        float(jnp.sum(out[-1]))
+        # Plain pull of the jit-computed scalar checksum. NOT an eager
+        # jnp.sum wrapper: mixing eager ops into the sync path hung
+        # indefinitely through the axon relay (r4), and the pull alone
+        # already forces completion (block_until_ready returns early
+        # through the tunnel, so a value pull is the only reliable sync).
+        float(out[-1])
 
     t0 = time.perf_counter()
     out = step(cur, cap, alive)
@@ -378,6 +475,45 @@ def _collapsed_rate(
         force(out)
         times.append(time.perf_counter() - t0)
     best = min(times)
+
+    # Sustained decision time: K churn re-solves CHAINED in one executable,
+    # one host sync at the end. Through the axon relay a single call's wall
+    # time is dominated by dispatch+sync (~300 ms measured r4, vs 0.6 ms of
+    # device compute for this pipeline) and block_until_ready returns
+    # early — total/K over a data-dependent chain is the only tunnel-proof
+    # device timing. Each step kills an alternating set of n_dead nodes, so
+    # every step re-seats a real displaced share (~dead_frac of objects)
+    # from the PREVIOUS step's assignment: same shapes, fresh churn, no
+    # loop-invariant hoisting.
+    chained_res = None
+    single_s = max(best, 1e-4)
+    chain_steps = int(min(64, max(8, round(20.0 / single_s))))
+    # Budget from the MEASURED single-shot compile of the same pipeline
+    # (one more compile of comparable cost) + 3 chained executions.
+    projected = 1.5 * compile_s + 3 * chain_steps * single_s
+    elapsed = time.perf_counter() - t_enter
+    if chain_budget_s is not None and chain_budget_s - elapsed > projected:
+        alive_b_np = np.ones(m, np.float32)
+        alive_b_np[n_dead : 2 * n_dead] = 0.0
+        alive_b = jnp.asarray(alive_b_np)
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def chained(cur, cap, alive_a, alive_b, k):
+            def body(i, c):
+                alive = jnp.where(i % 2 == 0, alive_a, alive_b)
+                assignment, _ = decide(c, cap, alive)
+                return assignment
+            final = jax.lax.fori_loop(0, k, body, cur)
+            return jnp.sum(final)
+
+        per_step_s, chain_compile_s = _time_chained(
+            chained, (cur, cap, alive, alive_b), chain_steps
+        )
+        chained_res = {
+            "decision_ms": round(per_step_s * 1e3, 2),
+            "chain_steps": chain_steps,
+            "chain_compile_s": round(chain_compile_s, 2),
+        }
 
     # Host-side bookkeeping, timed separately: the 4 MB assignment pull and
     # the O(N) directory dict update (what rebalance()'s apply loop does).
@@ -394,9 +530,16 @@ def _collapsed_rate(
 
     displaced = int((np.asarray(cur) < n_dead).sum())  # objects on dead nodes
     loads = np.bincount(a, minlength=m)
-    return {
-        "rate": n_obj / best,
-        "full_ms": round(best * 1e3, 2),
+    # ``full_ms`` is the per-decision latency: the sustained (chained)
+    # number when measured, else the single-shot one. ``single_shot_ms``
+    # always records the relay-inclusive single call for transparency.
+    decision_s = (
+        chained_res["decision_ms"] / 1e3 if chained_res is not None else best
+    )
+    result = {
+        "rate": n_obj / decision_s,
+        "full_ms": round(decision_s * 1e3, 2),
+        "single_shot_ms": round(best * 1e3, 2),
         "compile_s": round(compile_s, 2),
         "n_nodes": m,
         "n_iters": n_iters,
@@ -409,9 +552,14 @@ def _collapsed_rate(
         "pull_ms": round(pull_ms, 2),
         "host_apply_ms": round(host_apply_ms, 2),
     }
+    if chained_res is not None:
+        result.update(chained_res)
+    return result
 
 
-def _warm_assign_rate(batch: int, n_nodes: int = N_NODES) -> dict:
+def _warm_assign_rate(
+    batch: int, n_nodes: int = N_NODES, chain_budget_s: float | None = None
+) -> dict:
     """BASELINE row 4's single-chip half: warm incremental allocation.
 
     The ``assign_batch`` device path (``jax_placement._place_keys``): a
@@ -440,7 +588,7 @@ def _warm_assign_rate(batch: int, n_nodes: int = N_NODES) -> dict:
         return a, jnp.sum(a)
 
     def force(out):
-        float(jnp.sum(out[-1]))
+        float(out[-1])  # plain pull; see _collapsed_rate.force
 
     t0 = time.perf_counter()
     out = step(g, load, cap, alive)
@@ -454,11 +602,44 @@ def _warm_assign_rate(batch: int, n_nodes: int = N_NODES) -> dict:
         force(out)
         times.append(time.perf_counter() - t0)
     best = min(times)
+
+    # Sustained per-batch time: K allocations chained in one executable
+    # (each batch's assignment updates the load the next batch sees — the
+    # real warm-allocation sequence), one pull at the end; see
+    # _collapsed_rate for why single-call timing through the relay lies.
+    # Budget from this call's MEASURED compile + 3 chained executions.
+    k_steps = 16
+    decision_s, chain_extra = best, {}
+    if (
+        chain_budget_s is not None
+        and chain_budget_s > 1.5 * compile_s + 3 * k_steps * best
+    ):
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def chained(g, load, cap, alive, k):
+            def body(_, ld):
+                cost = build_cost_matrix(ld, cap, alive) - g[None, :]
+                rows = jnp.broadcast_to(cost, (batch, m))
+                mass = jnp.ones((batch,), jnp.float32)
+                a = greedy_balanced_assign(rows, mass, cap * alive, ld)
+                return ld + jnp.bincount(a, length=m).astype(ld.dtype)
+            final_load = jax.lax.fori_loop(0, k, body, load)
+            return jnp.sum(final_load)
+
+        decision_s, chain_compile_s = _time_chained(
+            chained, (g, load, cap, alive), k_steps
+        )
+        chain_extra = {
+            "chain_steps": k_steps,
+            "chain_compile_s": round(chain_compile_s, 2),
+        }
     return {
-        "rate": batch / best,
-        "full_ms": round(best * 1e3, 2),
+        "rate": batch / decision_s,
+        "full_ms": round(decision_s * 1e3, 2),
+        "single_shot_ms": round(best * 1e3, 2),
         "batch": batch,
         "compile_s": round(compile_s, 2),
+        **chain_extra,
     }
 
 
@@ -555,15 +736,38 @@ def run_hier_tier(n_obj: int, deadline: float) -> None:
     if devices[0].platform != "tpu":
         sys.exit(EXIT_INIT_FAIL)
     try:
-        quarter = _hier_rate(n_obj // 4)
-        result = {"ok": True, "kind": "hier", "quarter": quarter}
-        print(json.dumps(result), flush=True)
-        elapsed = time.monotonic() - start
-        projected = 4 * (4 * quarter["full_ms"] / 1e3) + 1.5 * quarter["compile_s"]
-        if elapsed + projected < 0.7 * deadline:
-            full = _hier_rate(n_obj)
-            result["full"] = full
-            print(json.dumps(result), flush=True)
+        # Ladder of sizes, each banked before the next is attempted: the r4
+        # run started straight at quarter size (2.6M), blew the deadline
+        # inside the first compile, and the watchdog exit left NO evidence
+        # at all. Small rungs are cheap insurance.
+        sizes = sorted(
+            {
+                min(n_obj, max(65_536, n_obj // 16)),
+                min(n_obj, max(131_072, n_obj // 4)),
+                n_obj,
+            }
+        )
+        result = {"ok": True, "kind": "hier", "rungs": {}}
+        prev = prev_size = None
+        for size in sizes:
+            if prev is not None:
+                ratio = size / prev_size
+                projected = (
+                    ratio * (4 * prev["full_ms"] / 1e3) + 1.5 * prev["compile_s"]
+                )
+                if time.monotonic() - start + projected > 0.7 * deadline:
+                    print(
+                        f"# hier: stopping before {size} "
+                        f"(projected {projected:.0f}s over budget)",
+                        file=sys.stderr,
+                    )
+                    break
+            tier = _hier_rate(size)
+            print(f"# hier rung {size}: {tier}", file=sys.stderr)
+            result["rungs"][str(size)] = tier
+            result["largest"] = tier
+            print(json.dumps(result), flush=True)  # bank every rung
+            prev, prev_size = tier, size
     except Exception as e:
         print(f"# hier tier failed: {type(e).__name__}: {e}", file=sys.stderr)
         sys.exit(EXIT_SOLVE_FAIL)
@@ -594,7 +798,11 @@ def run_collapsed_tier(n_obj: int, platform: str, deadline: float) -> None:
     init_watchdog.cancel()
     _arm_watchdog(deadline - (time.monotonic() - start), EXIT_TIER_TIMEOUT)
     try:
-        tier = _collapsed_rate(n_obj)
+        # Reserve ~60 s of the deadline for the warm-assign extra below.
+        tier = _collapsed_rate(
+            n_obj,
+            chain_budget_s=deadline - (time.monotonic() - start) - 60.0,
+        )
     except Exception as e:
         print(f"# collapsed tier failed: {type(e).__name__}: {e}", file=sys.stderr)
         sys.exit(EXIT_SOLVE_FAIL)
@@ -608,9 +816,12 @@ def run_collapsed_tier(n_obj: int, platform: str, deadline: float) -> None:
     }
     print(json.dumps(result), flush=True)  # bank before the optional extra
     remaining = deadline - (time.monotonic() - start)
-    if remaining > 45 + 6 * tier["full_ms"] / 1e3:
+    if remaining > 75 + 6 * tier.get("single_shot_ms", tier["full_ms"]) / 1e3:
         try:
-            result["warm_assign"] = _warm_assign_rate(65_536)
+            result["warm_assign"] = _warm_assign_rate(
+                65_536,
+                chain_budget_s=deadline - (time.monotonic() - start) - 30.0,
+            )
             print(json.dumps(result), flush=True)
         except Exception as e:
             print(f"# warm-assign tier failed: {type(e).__name__}: {e}", file=sys.stderr)
@@ -652,7 +863,11 @@ def run_tier(n_obj: int, platform: str, deadline: float) -> None:
 
     kernel_dtype = jnp.bfloat16 if platform == "tpu" else jnp.float32
     try:
-        tier = _solve_rate(n_obj, kernel_dtype)
+        # Reserve ~100 s of the deadline for the row-3 extra below.
+        tier = _solve_rate(
+            n_obj, kernel_dtype,
+            chain_budget_s=deadline - (time.monotonic() - start) - 100.0,
+        )
     except Exception as e:
         print(f"# tier {n_obj} failed: {type(e).__name__}: {e}", file=sys.stderr)
         sys.exit(EXIT_SOLVE_FAIL)
@@ -667,7 +882,7 @@ def run_tier(n_obj: int, platform: str, deadline: float) -> None:
     }
     print(json.dumps(result), flush=True)  # bank the OT result first
     remaining = deadline - (time.monotonic() - start)
-    if platform == "cpu" and remaining > 30 + 3 * tier["full_ms"] / 1e3:
+    if platform == "cpu" and remaining > 30 + 3 * tier.get("single_shot_ms", tier["full_ms"]) / 1e3:
         # A CPU-only deployment runs mode="greedy" (JaxObjectPlacement's
         # mode="auto" picks it off-TPU), not the dense OT solve — record
         # its rate on the same inputs so the fallback headline reflects
@@ -682,13 +897,16 @@ def run_tier(n_obj: int, platform: str, deadline: float) -> None:
     # one chip (a quarter of the 1k-node headline's bandwidth). Budget from
     # the MEASURED headline cost — a watchdog exit mid-TPU-op wedges the
     # relay, so a stage must never start unless it clearly fits.
-    row3_budget = 60.0 + 10.0 * tier["full_ms"] / 1e3
+    row3_budget = 90.0 + 10.0 * tier.get("single_shot_ms", tier["full_ms"]) / 1e3
     if platform == "tpu" and n_obj >= 1_048_576 and remaining > row3_budget:
         try:
             # 15 iters = 1.5x the measured convergence point for this
             # cost model (marginal err and mean_cost flat from iter 10;
             # both recorded in the tier dict as proof).
-            row3 = _solve_rate(1_048_576, kernel_dtype, n_nodes=256, n_iters=15)
+            row3 = _solve_rate(
+                1_048_576, kernel_dtype, n_nodes=256, n_iters=15,
+                chain_budget_s=deadline - (time.monotonic() - start) - 30.0,
+            )
             result["baseline_row3_1m_x_256"] = row3
             print(f"# row-3 tier (1M x 256): {row3}", file=sys.stderr)
             print(json.dumps(result), flush=True)
@@ -832,7 +1050,7 @@ def main() -> None:
     # committed fast path, BASELINE row 3's <50 ms class) and the cheapest
     # device tier — run it first so it is banked before the heavy dense
     # tiers can burn the relay window.
-    rc, collapsed = _run_child(1_048_576, "tpu", 300.0, collapsed=True)
+    rc, collapsed = _run_child(1_048_576, "tpu", 480.0, collapsed=True)
     if collapsed:
         detail["collapsed_tier"] = collapsed
         print(f"# collapsed rebalance tier: {collapsed}", file=sys.stderr)
@@ -843,7 +1061,7 @@ def main() -> None:
     # the tunnel is down/wedged — retrying would burn ~25 min per attempt in
     # backend setup (the round-1 failure mode), so abort TPU entirely.
     if not tpu_down:
-        for n_obj, deadline in ((1_048_576, 420.0), (524_288, 300.0), (262_144, 240.0)):
+        for n_obj, deadline in ((1_048_576, 560.0), (524_288, 360.0), (262_144, 240.0)):
             rc, parsed = _run_child(n_obj, "tpu", deadline)
             if parsed:
                 result = parsed
@@ -894,6 +1112,12 @@ def main() -> None:
         )
         warm = collapsed.get("warm_assign")
         warm_str = f"; warm assign {warm['rate']:.0f}/s" if warm else ""
+        sustain_str = (
+            f" sustained over {collapsed['chain_steps']} chained churn steps "
+            f"(single call incl. relay sync {collapsed['single_shot_ms']} ms)"
+            if "chain_steps" in collapsed
+            else ""
+        )
         print(
             json.dumps(
                 {
@@ -901,7 +1125,8 @@ def main() -> None:
                         "placements/sec (committed rebalance fast path: "
                         "class-collapsed solve+expand+repair on device, "
                         f"{collapsed['n_obj']} objects x {collapsed['n_nodes']} "
-                        f"nodes re-seated in {collapsed['full_ms']} ms after "
+                        f"nodes re-seated in {collapsed['full_ms']} ms"
+                        f"{sustain_str} after "
                         f"{collapsed['dead_nodes']} node deaths, moved "
                         f"{collapsed['moved']} (displaced {collapsed['displaced']}), "
                         f"tpu{dense_str}{warm_str}; {hop_str})"
