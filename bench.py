@@ -273,12 +273,16 @@ def _solve_rate(
 
     # Sustained solve time: K solves chained in one executable, one pull at
     # the end — the relay's per-call dispatch+sync (~300 ms r4) divides
-    # out; see _collapsed_rate. The inter-step dependence (mass + 1e-20*u)
-    # is structurally real but bit-exact identity in fp32, so every step
-    # solves the same problem without the loop hoisting it. Budgeted from
-    # MEASURED timings of this very call (the budget arrives stale — the
-    # two compiles above already burned into it): one more compile of
-    # comparable cost + 3 chained executions must clearly fit.
+    # out; see _collapsed_rate. Two carried perturbations keep EVERY part
+    # of the solve inside the loop against XLA's while-loop invariant code
+    # motion: the cost is shifted by 1e-30*mass_c[0] (so the kernel build
+    # K = exp(-C/eps) — a real per-solve cost — cannot hoist; it fuses into
+    # the existing exp sweep, no extra HBM traffic) and the mass carries
+    # 1e-20*u forward. Both are bit-exact identities on O(1) fp32 values,
+    # so every step solves the same problem. Budgeted from MEASURED timings
+    # of this very call (the budget arrives stale — the two compiles above
+    # already burned into it): one more compile of comparable cost + 3
+    # chained executions must clearly fit.
     chained_res = None
     k_chain = int(min(8, max(2, round(6.0 / max(solve_s, 0.05)))))
     if chain_budget_s is not None:
@@ -290,7 +294,7 @@ def _solve_rate(
             def chained_solve(cost, mass, cap, k):
                 def body(_, mass_c):
                     u, v, K, _sh = scaling_core(
-                        cost, mass_c, cap,
+                        cost + 1e-30 * mass_c[0], mass_c, cap,
                         eps=0.05, n_iters=n_iters, kernel_dtype=kernel_dtype,
                     )
                     return mass_c + 1e-20 * u

@@ -1,14 +1,27 @@
-"""On-hardware Pallas kernel validation (isolated, wedge-conscious).
+"""On-hardware Pallas kernel validation + timing (isolated, wedge-conscious).
 
 The r3 bench's in-tier Pallas smoke hung (Mosaic compile through the axon
 tunnel) and its watchdog exit wedged the relay. This runner validates each
-fused kernel in its OWN child process with a long deadline and tiny
-shapes, banking results to ``PALLAS_TPU.json`` between children, so:
+fused kernel in its OWN child process with a long deadline, banking results
+to ``PALLAS_TPU.json`` between children, so:
 
 * a hang costs one kernel's evidence, not the banked results;
 * the long (default 600 s) deadline lets a slow-but-finite Mosaic compile
   land instead of being watchdog-killed mid-op (the wedge trigger);
 * stderr shows which kernel was in flight if it does wedge.
+
+r4 additions (per the r4 wedge postmortem in CLAUDE.md):
+
+* NO eager jnp ops anywhere — syncs are plain value pulls on jit outputs
+  (an eager-op warmup hung indefinitely through the relay in r4);
+* per-iteration timing by SLOPE: each solver is timed at two iteration
+  counts and (t_hi - t_lo) / (n_hi - n_lo) isolates the per-iteration
+  device cost from the relay's ~300 ms per-call dispatch+sync overhead
+  (which cancels in the difference);
+* the XLA scaling-form solver is timed identically at the same shape, so
+  the artifact records pallas-vs-XLA ms/iter head to head — the kernels'
+  reason to exist (one HBM sweep of K per iteration, scaling.py:19-23)
+  is only proven if their slope beats XLA's two-sweep slope.
 
 Usage:  python tpu_pallas_check.py            # orchestrator
         python tpu_pallas_check.py --kernel pallas_scaling   # one child
@@ -25,14 +38,65 @@ import threading
 import time
 
 OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "PALLAS_TPU.json")
-N_OBJ, N_NODES = 8192, 256  # small: bound on-chip time, still real tiles
+N_OBJ, N_NODES = 8192, 256  # parity shape: small, bounds on-chip time
+PERF_N_OBJ, PERF_N_NODES = 262_144, 1024  # perf shape: K bf16 = 512 MB
+ITERS_LO, ITERS_HI = 20, 60
 KERNELS = ("pallas_scaling", "pallas_logdomain")
 
 
-def child(kernel: str, deadline: float) -> None:
-    t = threading.Timer(deadline, lambda: os._exit(99))
+def _watchdog(deadline: float) -> None:
+    def fire():
+        print(f"# watchdog fired after {deadline:.0f}s", file=sys.stderr, flush=True)
+        os._exit(99)
+
+    t = threading.Timer(deadline, fire)
     t.daemon = True
     t.start()
+
+
+def _time_solver(fn, n_iters_pair, label: str, t_deadline: float) -> dict:
+    """Time fn(n_iters) at two iteration counts; slope = per-iter device ms.
+
+    ``fn(n)`` must return a jittable scalar-reducing callable's OUTPUT
+    (a device scalar): the plain float() pull is the only sync. The hi
+    measurement is skipped (slope falls back to the overhead-inclusive
+    lo average, marked ``"slope": False``) unless its projected cost —
+    scaled from the MEASURED lo run plus a fresh compile — clearly fits
+    before ``t_deadline`` (watchdogs must never fire mid-op).
+    """
+    lo, hi = n_iters_pair
+    out = {}
+    for name, n in (("lo", lo), ("hi", hi)):
+        if name == "hi":
+            projected = (
+                2.5 * out["lo"]["compile_s"]
+                + 3 * (hi / lo) * out["lo"]["ms"] / 1e3
+            )
+            if time.perf_counter() + projected > t_deadline:
+                print(f"# {label}: skipping hi run (projected {projected:.0f}s "
+                      f"over budget)", file=sys.stderr, flush=True)
+                out["ms_per_iter"] = round(out["lo"]["ms"] / lo, 3)
+                out["slope"] = False
+                return out
+        t0 = time.perf_counter()
+        float(fn(n))
+        compile_s = time.perf_counter() - t0
+        times = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            float(fn(n))
+            times.append(time.perf_counter() - t0)
+        out[name] = {"n_iters": n, "ms": round(min(times) * 1e3, 2),
+                     "compile_s": round(compile_s, 1)}
+        print(f"# {label} n_iters={n}: {out[name]}", file=sys.stderr, flush=True)
+    out["ms_per_iter"] = round((out["hi"]["ms"] - out["lo"]["ms"]) / (hi - lo), 3)
+    out["slope"] = True
+    return out
+
+
+def child(kernel: str, deadline: float) -> None:
+    _watchdog(deadline)
+    t_deadline = time.perf_counter() + deadline - 30.0
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -49,38 +113,29 @@ def child(kernel: str, deadline: float) -> None:
     from rio_tpu.ops.pallas_sinkhorn import pallas_sinkhorn
     from rio_tpu.ops.scaling import pallas_scaling_sinkhorn
 
+    pallas_fn = {
+        "pallas_scaling": pallas_scaling_sinkhorn,
+        "pallas_logdomain": pallas_sinkhorn,
+    }[kernel]
+
+    # ---- parity at the small shape --------------------------------------
     key = jax.random.PRNGKey(7)
     cost = jax.random.uniform(key, (N_OBJ, N_NODES), jnp.float32)
     mass = jnp.ones((N_OBJ,), jnp.float32)
     cap = jnp.ones((N_NODES,), jnp.float32)
-    kw = dict(eps=0.05, n_iters=20)
+    kw = dict(eps=0.05, n_iters=ITERS_LO)
 
-    print(f"# reference solve...", file=sys.stderr, flush=True)
+    print("# reference solve...", file=sys.stderr, flush=True)
     ref = scaling_sinkhorn(cost, mass, cap, **kw)
-    jax.block_until_ready((ref.f, ref.g))
-    float(jnp.sum(jnp.where(jnp.isfinite(ref.g), ref.g, 0.0)))
+    g_ref = np.asarray(ref.g)  # transfer pull = sync; no eager ops
 
-    fn = {
-        "pallas_scaling": lambda: pallas_scaling_sinkhorn(
-            cost, mass, cap, interpret=False, **kw
-        ),
-        "pallas_logdomain": lambda: pallas_sinkhorn(
-            cost, mass, cap, interpret=False, **kw
-        ),
-    }[kernel]
-    print(f"# compiling+running {kernel} (interpret=False)...", file=sys.stderr, flush=True)
+    print(f"# compiling+running {kernel} (interpret=False)...",
+          file=sys.stderr, flush=True)
     t0 = time.perf_counter()
-    res = fn()
-    jax.block_until_ready((res.f, res.g))
-    float(jnp.sum(jnp.where(jnp.isfinite(res.g), res.g, 0.0)))
+    res = pallas_fn(cost, mass, cap, interpret=False, **kw)
+    g = np.asarray(res.g)
     compile_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    res = fn()
-    jax.block_until_ready((res.f, res.g))
-    float(jnp.sum(jnp.where(jnp.isfinite(res.g), res.g, 0.0)))
-    run_ms = (time.perf_counter() - t0) * 1e3
 
-    g_ref, g = np.asarray(ref.g), np.asarray(res.g)
     finite = np.isfinite(g_ref) & np.isfinite(g)
     if not finite.any():
         # A Mosaic miscompile can yield all-NaN potentials — record it as a
@@ -100,9 +155,70 @@ def child(kernel: str, deadline: float) -> None:
         "device": str(devices[0]),
         "shape": [N_OBJ, N_NODES],
         "compile_s": round(compile_s, 2),
-        "run_ms": round(run_ms, 2),
         "max_dg_vs_xla": float(np.max(np.abs(g_ref[finite] - g[finite]))),
     }
+    print(json.dumps(out), flush=True)  # bank parity before perf timing
+
+    # ---- per-iteration slope at the perf shape --------------------------
+    # K bf16 = 512 MB: XLA's two sweeps/iter = 1 GB HBM, the fused kernel's
+    # one sweep = 0.5 GB — ~0.6 vs ~1.2 ms/iter at v5e roofline. Timed by
+    # slope so the relay's per-call overhead cancels (see module docstring).
+    key = jax.random.PRNGKey(11)
+    cost_p = jax.random.uniform(key, (PERF_N_OBJ, PERF_N_NODES), jnp.float32)
+    mass_p = jnp.ones((PERF_N_OBJ,), jnp.float32)
+    cap_p = jnp.ones((PERF_N_NODES,), jnp.float32)
+
+    import functools
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def run_pallas(cost, mass, cap, n):
+        r = pallas_fn(cost, mass, cap, eps=0.05, n_iters=n, interpret=False)
+        return jnp.sum(jnp.where(jnp.isfinite(r.g), r.g, 0.0))
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def run_xla(cost, mass, cap, n):
+        r = scaling_sinkhorn(cost, mass, cap, eps=0.05, n_iters=n)
+        return jnp.sum(jnp.where(jnp.isfinite(r.g), r.g, 0.0))
+
+    out["perf_shape"] = [PERF_N_OBJ, PERF_N_NODES]
+    # Budget each lo run from MEASURED prior-stage timings (CLAUDE.md rule;
+    # the parity stage above is the only measurement we have for the first
+    # projection). 32x the data of the parity shape: assume compile scales
+    # ~4x and execution ~32x — deliberately pessimistic so a degraded
+    # relay banks what it has and exits instead of letting the watchdog
+    # fire mid-op.
+    xla_projected = 4.0 * compile_s + 10.0
+    if time.perf_counter() + xla_projected > t_deadline:
+        print(f"# skipping perf section (projected {xla_projected:.0f}s "
+              f"over budget)", file=sys.stderr, flush=True)
+        print(json.dumps(out), flush=True)
+        os._exit(0)
+    out["xla_ref"] = _time_solver(
+        lambda n: run_xla(cost_p, mass_p, cap_p, n),
+        (ITERS_LO, ITERS_HI), "xla_ref", t_deadline,
+    )
+    print(json.dumps(out), flush=True)  # bank XLA baseline before the kernel
+    # Mosaic compiles slower than XLA and is the historically hang-prone
+    # step: project from the measured XLA perf-shape timings, doubled.
+    ref_lo = out["xla_ref"].get("lo", {"compile_s": compile_s, "ms": 1e4})
+    pallas_projected = 2.0 * ref_lo["compile_s"] + 6.0 * ref_lo["ms"] / 1e3 + 10.0
+    if time.perf_counter() + pallas_projected > t_deadline:
+        print(f"# skipping pallas perf (projected {pallas_projected:.0f}s "
+              f"over budget)", file=sys.stderr, flush=True)
+        print(json.dumps(out), flush=True)
+        os._exit(0)
+    out["pallas"] = _time_solver(
+        lambda n: run_pallas(cost_p, mass_p, cap_p, n),
+        (ITERS_LO, ITERS_HI), kernel, t_deadline,
+    )
+    # Head-to-head ratio only when BOTH numbers are true slopes and
+    # positive — a slope/fallback mix or a jitter-negative slope would
+    # record an apples-to-oranges or negative headline.
+    xr, pr = out["xla_ref"], out["pallas"]
+    if xr.get("slope") and pr.get("slope") and xr["ms_per_iter"] > 0 and pr["ms_per_iter"] > 0:
+        out["pallas_vs_xla"] = round(xr["ms_per_iter"] / pr["ms_per_iter"], 2)
+    else:
+        out["pallas_vs_xla"] = None
     print(json.dumps(out), flush=True)
     os._exit(0)
 
@@ -133,9 +249,11 @@ def main(deadline: float) -> None:
         parsed = None
         for line in proc.stdout.decode(errors="replace").splitlines():
             try:
-                parsed = json.loads(line)
+                candidate = json.loads(line)
             except json.JSONDecodeError:
                 continue
+            if isinstance(candidate, dict):
+                parsed = candidate  # last banked line wins
         results[kernel] = parsed or {"kernel": kernel, "rc": proc.returncode,
                                      "error": "no result (hang/wedge?)"}
         with open(OUT, "w") as fh:  # bank after every child
