@@ -174,7 +174,7 @@ def _time_chained(chained_fn, args, k: int) -> tuple[float, float]:
     ``chained_fn(*args, k)`` must return a jit-computed scalar; the plain
     float() pull is the sync (see _time_fn). Returns
     (per_step_seconds, compile_seconds). One copy of the protocol so the
-    three chained tiers cannot drift.
+    chained tiers cannot drift; the gate lives in _maybe_time_chain.
     """
     t0 = time.perf_counter()
     float(chained_fn(*args, k))
@@ -185,6 +185,38 @@ def _time_chained(chained_fn, args, k: int) -> tuple[float, float]:
         float(chained_fn(*args, k))
         ts.append(time.perf_counter() - t0)
     return min(ts) / k, compile_s
+
+
+def _maybe_time_chain(
+    chained_fn,
+    args,
+    k: int,
+    chain_budget_s: float | None,
+    t_enter: float,
+    compile_s: float,
+    step_s: float,
+) -> tuple[float | None, dict]:
+    """The chain-gate + timing protocol, in ONE place for every tier.
+
+    Projects one more compile of comparable cost (1.5x the tier's MEASURED
+    single-shot compile) plus 3 chained executions scaled from the MEASURED
+    single-shot step time, after subtracting the time the tier has already
+    burned since ``t_enter`` — ``chain_budget_s`` arrives stale, computed
+    at the child's call site before the tier's own compiles ran. Skipping
+    is silent-but-safe: a watchdog must never fire mid-TPU-op (CLAUDE.md).
+    Returns ``(per_step_seconds | None, extras_dict)``.
+    """
+    if chain_budget_s is None:
+        return None, {}
+    elapsed = time.perf_counter() - t_enter
+    projected = 1.5 * compile_s + 3 * k * step_s
+    if chain_budget_s - elapsed <= projected:
+        return None, {}
+    per_step_s, chain_compile_s = _time_chained(chained_fn, args, k)
+    return per_step_s, {
+        "chain_steps": k,
+        "chain_compile_s": round(chain_compile_s, 2),
+    }
 
 
 def _solve_rate(
@@ -283,32 +315,25 @@ def _solve_rate(
     # of this very call (the budget arrives stale — the two compiles above
     # already burned into it): one more compile of comparable cost + 3
     # chained executions must clearly fit.
-    chained_res = None
-    k_chain = int(min(8, max(2, round(6.0 / max(solve_s, 0.05)))))
-    if chain_budget_s is not None:
-        elapsed = time.perf_counter() - t_enter
-        projected = 1.5 * (solve_compile + full_compile) / 2 + 3 * k_chain * solve_s
-        if chain_budget_s - elapsed > projected:
-
-            @functools.partial(jax.jit, static_argnames=("k",))
-            def chained_solve(cost, mass, cap, k):
-                def body(_, mass_c):
-                    u, v, K, _sh = scaling_core(
-                        cost + 1e-30 * mass_c[0], mass_c, cap,
-                        eps=0.05, n_iters=n_iters, kernel_dtype=kernel_dtype,
-                    )
-                    return mass_c + 1e-20 * u
-                final = lax.fori_loop(0, k, body, mass)
-                return jnp.sum(final)
-
-            per_step_s, chain_compile_s = _time_chained(
-                chained_solve, (cost, mass, cap), k_chain
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def chained_solve(cost, mass, cap, k):
+        def body(_, mass_c):
+            u, v, K, _sh = scaling_core(
+                cost + 1e-30 * mass_c[0], mass_c, cap,
+                eps=0.05, n_iters=n_iters, kernel_dtype=kernel_dtype,
             )
-            chained_res = {
-                "solve_chain_ms": round(per_step_s * 1e3, 2),
-                "solve_chain_steps": k_chain,
-                "chain_compile_s": round(chain_compile_s, 2),
-            }
+            return mass_c + 1e-20 * u
+        final = lax.fori_loop(0, k, body, mass)
+        return jnp.sum(final)
+
+    k_chain = int(min(8, max(2, round(6.0 / max(solve_s, 0.05)))))
+    per_step_s, chain_extra = _maybe_time_chain(
+        chained_solve, (cost, mass, cap), k_chain, chain_budget_s,
+        t_enter, (solve_compile + full_compile) / 2, solve_s,
+    )
+    chained_res = None
+    if per_step_s is not None:
+        chained_res = {"solve_chain_ms": round(per_step_s * 1e3, 2), **chain_extra}
     # Quality evidence from the already-computed assignment: the speed
     # number only counts if it is actually capacity-balanced.
     import numpy as np
@@ -490,35 +515,28 @@ def _collapsed_rate(
     # every step re-seats a real displaced share (~dead_frac of objects)
     # from the PREVIOUS step's assignment: same shapes, fresh churn, no
     # loop-invariant hoisting.
-    chained_res = None
+    alive_b_np = np.ones(m, np.float32)
+    alive_b_np[n_dead : 2 * n_dead] = 0.0
+    alive_b = jnp.asarray(alive_b_np)
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def chained(cur, cap, alive_a, alive_b, k):
+        def body(i, c):
+            alive = jnp.where(i % 2 == 0, alive_a, alive_b)
+            assignment, _ = decide(c, cap, alive)
+            return assignment
+        final = jax.lax.fori_loop(0, k, body, cur)
+        return jnp.sum(final)
+
     single_s = max(best, 1e-4)
     chain_steps = int(min(64, max(8, round(20.0 / single_s))))
-    # Budget from the MEASURED single-shot compile of the same pipeline
-    # (one more compile of comparable cost) + 3 chained executions.
-    projected = 1.5 * compile_s + 3 * chain_steps * single_s
-    elapsed = time.perf_counter() - t_enter
-    if chain_budget_s is not None and chain_budget_s - elapsed > projected:
-        alive_b_np = np.ones(m, np.float32)
-        alive_b_np[n_dead : 2 * n_dead] = 0.0
-        alive_b = jnp.asarray(alive_b_np)
-
-        @functools.partial(jax.jit, static_argnames=("k",))
-        def chained(cur, cap, alive_a, alive_b, k):
-            def body(i, c):
-                alive = jnp.where(i % 2 == 0, alive_a, alive_b)
-                assignment, _ = decide(c, cap, alive)
-                return assignment
-            final = jax.lax.fori_loop(0, k, body, cur)
-            return jnp.sum(final)
-
-        per_step_s, chain_compile_s = _time_chained(
-            chained, (cur, cap, alive, alive_b), chain_steps
-        )
-        chained_res = {
-            "decision_ms": round(per_step_s * 1e3, 2),
-            "chain_steps": chain_steps,
-            "chain_compile_s": round(chain_compile_s, 2),
-        }
+    per_step_s, chain_extra = _maybe_time_chain(
+        chained, (cur, cap, alive, alive_b), chain_steps, chain_budget_s,
+        t_enter, compile_s, single_s,
+    )
+    chained_res = None
+    if per_step_s is not None:
+        chained_res = {"decision_ms": round(per_step_s * 1e3, 2), **chain_extra}
 
     # Host-side bookkeeping, timed separately: the 4 MB assignment pull and
     # the directory dict update as rebalance() actually applies it — one
@@ -580,6 +598,7 @@ def _warm_assign_rate(
 
     from rio_tpu.ops.assignment import build_cost_matrix, greedy_balanced_assign
 
+    t_enter = time.perf_counter()
     m = n_nodes
     key = jax.random.PRNGKey(3)
     g = jax.random.normal(key, (m,), jnp.float32) * 0.1  # cached potentials
@@ -615,32 +634,23 @@ def _warm_assign_rate(
     # (each batch's assignment updates the load the next batch sees — the
     # real warm-allocation sequence), one pull at the end; see
     # _collapsed_rate for why single-call timing through the relay lies.
-    # Budget from this call's MEASURED compile + 3 chained executions.
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def chained(g, load, cap, alive, k):
+        def body(_, ld):
+            cost = build_cost_matrix(ld, cap, alive) - g[None, :]
+            rows = jnp.broadcast_to(cost, (batch, m))
+            mass = jnp.ones((batch,), jnp.float32)
+            a = greedy_balanced_assign(rows, mass, cap * alive, ld)
+            return ld + jnp.bincount(a, length=m).astype(ld.dtype)
+        final_load = jax.lax.fori_loop(0, k, body, load)
+        return jnp.sum(final_load)
+
     k_steps = 16
-    decision_s, chain_extra = best, {}
-    if (
-        chain_budget_s is not None
-        and chain_budget_s > 1.5 * compile_s + 3 * k_steps * best
-    ):
-
-        @functools.partial(jax.jit, static_argnames=("k",))
-        def chained(g, load, cap, alive, k):
-            def body(_, ld):
-                cost = build_cost_matrix(ld, cap, alive) - g[None, :]
-                rows = jnp.broadcast_to(cost, (batch, m))
-                mass = jnp.ones((batch,), jnp.float32)
-                a = greedy_balanced_assign(rows, mass, cap * alive, ld)
-                return ld + jnp.bincount(a, length=m).astype(ld.dtype)
-            final_load = jax.lax.fori_loop(0, k, body, load)
-            return jnp.sum(final_load)
-
-        decision_s, chain_compile_s = _time_chained(
-            chained, (g, load, cap, alive), k_steps
-        )
-        chain_extra = {
-            "chain_steps": k_steps,
-            "chain_compile_s": round(chain_compile_s, 2),
-        }
+    per_step_s, chain_extra = _maybe_time_chain(
+        chained, (g, load, cap, alive), k_steps, chain_budget_s,
+        t_enter, compile_s, best,
+    )
+    decision_s = per_step_s if per_step_s is not None else best
     return {
         "rate": batch / decision_s,
         "full_ms": round(decision_s * 1e3, 2),
@@ -674,18 +684,29 @@ def _greedy_rate(n_obj: int, n_nodes: int = N_NODES) -> dict:
     }
 
 
-def _hier_rate(n_obj: int, n_nodes: int = N_NODES, n_groups: int = 32, d: int = 16) -> dict:
+def _hier_rate(
+    n_obj: int,
+    n_nodes: int = N_NODES,
+    n_groups: int = 32,
+    d: int = 16,
+    chain_budget_s: float | None = None,
+) -> dict:
     """BASELINE row-5 tier: hierarchical 2-level OT at the scale ceiling.
 
     10M x 1k cannot materialize a flat cost (40 GB fp32); the two-level
     solve runs in O(N*(G+S+d)) memory (~2.6 GB at 10M) — see
-    ``rio_tpu/parallel/hierarchical.py``.
+    ``rio_tpu/parallel/hierarchical.py``. When the budget allows, the
+    per-solve time is also measured over a K-chain (see _collapsed_rate:
+    single-call times through the relay are mostly dispatch+sync at the
+    smaller rung sizes); a carried 1e-30-scale feature perturbation keeps
+    every solve inside the loop against invariant hoisting.
     """
     import jax
     import jax.numpy as jnp
 
     from rio_tpu.parallel.hierarchical import hierarchical_assign
 
+    t_enter = time.perf_counter()
     key = jax.random.PRNGKey(1)
     k1, k2 = jax.random.split(key)
     obj_feat = jax.random.normal(k1, (n_obj, d), jnp.float32)
@@ -710,14 +731,35 @@ def _hier_rate(n_obj: int, n_nodes: int = N_NODES, n_groups: int = 32, d: int = 
         int(ovf)
         times.append(time.perf_counter() - t0)
     best = min(times)
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def chained(obj_feat, node_feat, cap, alive, k):
+        def body(_, carry):
+            res = hierarchical_assign(
+                obj_feat + carry, node_feat, cap, alive, n_groups=n_groups
+            )
+            # 1e-30 * sum(assignment) is ~1e-22 against O(1) features:
+            # bit-exact identity, structurally loop-carried.
+            return 1e-30 * jnp.sum(res.assignment).astype(jnp.float32)
+        final = jax.lax.fori_loop(0, k, body, jnp.float32(0.0))
+        return final
+
+    k_chain = int(min(8, max(2, round(4.0 / max(best, 0.05)))))
+    per_step_s, chain_extra = _maybe_time_chain(
+        chained, (obj_feat, node_feat, cap, alive), k_chain, chain_budget_s,
+        t_enter, compile_s, best,
+    )
+    decision_s = per_step_s if per_step_s is not None else best
     return {
-        "rate": n_obj / best,
-        "full_ms": round(best * 1e3, 2),
+        "rate": n_obj / decision_s,
+        "full_ms": round(decision_s * 1e3, 2),
+        "single_shot_ms": round(best * 1e3, 2),
         "n_obj": n_obj,
         "n_nodes": n_nodes,
         "n_groups": n_groups,
         "overflow": overflow,
         "compile_s": round(compile_s, 2),
+        **chain_extra,
     }
 
 
@@ -760,8 +802,12 @@ def run_hier_tier(n_obj: int, deadline: float) -> None:
         for size in sizes:
             if prev is not None:
                 ratio = size / prev_size
+                # Project from the single-call time (the chained decision
+                # time is smaller and would undercount) + compile cushion
+                # covering both the plain and chained executables.
+                prev_single = prev.get("single_shot_ms", prev["full_ms"])
                 projected = (
-                    ratio * (4 * prev["full_ms"] / 1e3) + 1.5 * prev["compile_s"]
+                    ratio * (4 * prev_single / 1e3) + 2.5 * prev["compile_s"]
                 )
                 if time.monotonic() - start + projected > 0.7 * deadline:
                     print(
@@ -770,7 +816,10 @@ def run_hier_tier(n_obj: int, deadline: float) -> None:
                         file=sys.stderr,
                     )
                     break
-            tier = _hier_rate(size)
+            tier = _hier_rate(
+                size,
+                chain_budget_s=deadline - (time.monotonic() - start) - 30.0,
+            )
             print(f"# hier rung {size}: {tier}", file=sys.stderr)
             result["rungs"][str(size)] = tier
             result["largest"] = tier
