@@ -1,0 +1,29 @@
+"""rio_tpu.utils.loop: graceful degradation without uvloop installed."""
+
+from __future__ import annotations
+
+import asyncio
+
+from rio_tpu.utils.loop import install_uvloop, loop_flavor
+
+
+def test_install_uvloop_graceful_without_uvloop():
+    # The CI image has no uvloop: install must return False (not raise)
+    # and leave the stock policy working.
+    try:
+        import uvloop  # noqa: F401
+
+        have_uvloop = True
+    except ImportError:
+        have_uvloop = False
+
+    installed = install_uvloop()
+    assert installed == have_uvloop
+    assert loop_flavor() == ("uvloop" if have_uvloop else "asyncio")
+    # The policy still produces a usable loop either way.
+    assert asyncio.run(_probe()) == 42
+
+
+async def _probe() -> int:
+    await asyncio.sleep(0)
+    return 42
