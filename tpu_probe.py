@@ -1,12 +1,19 @@
-"""Lightweight TPU-availability probe (safe under a wedged axon relay).
+"""Lightweight TPU-availability + relay-health probe (wedge-safe).
 
 Runs jax.devices() in THIS process under a hard os._exit watchdog, so a
 hung PJRT init through the axon tunnel cannot orphan a chip grant: the
 process dies cleanly before touching any TPU op.  Exit codes:
 
-  0  — TPU present (prints device list)
+  0  — TPU present (prints device list + latency health)
   97 — backend init failed (relay down / fell back to non-tpu)
   99 — watchdog fired during init (relay wedged)
+
+Besides up/down, the probe prints LATENCY HEALTH — per-call dispatch+pull
+round trip and a 4 MB device→host pull — because the relay DEGRADES
+before it dies (r4: compile_s 66→106 and pull_ms 349→747 across
+healthy-looking runs preceded the wedge). Treat rising numbers as "stop
+launching TPU children now", not as noise. All syncs are jit + plain
+value pulls: an eager-op sync hung indefinitely through the relay in r4.
 
 Run it as a child:  python tpu_probe.py   (never import this in-process).
 """
@@ -35,13 +42,38 @@ def main(deadline: float = 120.0) -> None:
     print(f"devices={devices} init_s={dt:.1f}", flush=True)
     if devices[0].platform != "tpu":
         os._exit(97)
-    # Tiny smoke op to confirm the chip actually executes (still under the
+    # Smoke op to confirm the chip actually executes (still under the
     # watchdog; a wedged relay typically hangs here, not at devices()).
     import jax.numpy as jnp
+    import numpy as np
+
+    @jax.jit
+    def smoke(x):
+        return jnp.sum(x @ x)
 
     x = jnp.ones((128, 128))
-    val = float((x @ x).sum())
+    val = float(smoke(x))
     print(f"smoke matmul ok: {val}", flush=True)
+
+    # Latency health: best-of-3 dispatch+pull round trip on the tiny op,
+    # then one 4 MB pull (first forced complete via a scalar pull so the
+    # transfer, not the fill, is what's timed).
+    ts = []
+    for _ in range(3):
+        t1 = time.monotonic()
+        float(smoke(x))
+        ts.append((time.monotonic() - t1) * 1e3)
+    big = jnp.ones((1024, 1024), jnp.float32)  # 4 MB
+
+    @jax.jit
+    def chk(a):
+        return jnp.sum(a)
+
+    float(chk(big))
+    t1 = time.monotonic()
+    np.asarray(big)
+    pull_ms = (time.monotonic() - t1) * 1e3
+    print(f"roundtrip_ms={min(ts):.1f} pull4mb_ms={pull_ms:.1f}", flush=True)
     t.cancel()
     os._exit(0)
 
