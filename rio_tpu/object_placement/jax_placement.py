@@ -167,6 +167,27 @@ def _next_bucket(n: int, minimum: int = 256) -> int:
     return b
 
 
+def _guard_sentinel_spill(repaired, real, m_axis: int, cap_alive):
+    """Reseat real objects the quota repair left on the padding sentinel.
+
+    The bucket-shaped repair routes padding rows through a sentinel column
+    (index ``m_axis``) whose quota is the padding count. That quota comes
+    out of a float32 largest-remainder distribution, and at 2^24-scale
+    buckets fp32 drift can hand the sentinel one unit more than the
+    padding count — refilling one REAL object onto the sentinel, which is
+    not a node (observed r4: 10M objects, bucket 16,777,216 = exactly the
+    fp32 integer-precision boundary, lookup IndexError). The drift is at
+    most a unit or two, so reseating spilled rows on the
+    highest-capacity live node preserves exact balance within that drift.
+    The root fix (no global rescale in ``exact_quota_repair``) makes the
+    sentinel's remainder exactly zero, so this guard is belt-and-braces
+    for callers whose expected marginals are not exact integers.
+    """
+    spill = real & (repaired >= m_axis)
+    fallback = jnp.argmax(cap_alive).astype(repaired.dtype)
+    return jnp.where(spill, fallback, repaired)
+
+
 def _apply_class_quotas(quotas: np.ndarray, cur_idx: np.ndarray) -> np.ndarray:
     """Expand (M x M) class quotas into a per-object assignment, O(N + M^2).
 
@@ -472,7 +493,20 @@ class JaxObjectPlacement(ObjectPlacement):
                 self._place_keys(unplaced)
             return [self._node_order[self._placements[k]] for k in keys]
 
+    # Bounds the (bucket x node_axis) working set of one placement solve:
+    # 262,144 x 1,024 fp32 is ~1 GB of sort/cumsum temps. A single
+    # unchunked 10M-key batch padded its bucket to 16.7M rows and
+    # materialized ~100 GB of temps on the CPU backend (r4) — chunking
+    # keeps any batch size at a constant footprint, and the waterfill
+    # carries the updated node load into the next chunk so balance holds
+    # across the whole batch.
+    _MAX_PLACE_CHUNK = 262_144
+
     def _place_keys(self, keys: list[str]) -> None:
+        for start in range(0, len(keys), self._MAX_PLACE_CHUNK):
+            self._place_chunk(keys[start : start + self._MAX_PLACE_CHUNK])
+
+    def _place_chunk(self, keys: list[str]) -> None:
         load, cap, alive = self._node_vectors()
         n = len(keys)
         cost = build_cost_matrix(load, cap, alive)  # (1, n_nodes)
@@ -626,10 +660,13 @@ class JaxObjectPlacement(ObjectPlacement):
                     cur_full = jnp.zeros((bucket,), jnp.int32).at[:n].set(
                         jnp.asarray(cur_idx)
                     )
-                    return exact_quota_repair(
+                    repaired = exact_quota_repair(
                         idx_full,
                         expected,
                         prefer_keep=jnp.where(real, idx_full == cur_full, True),
+                    )
+                    return _guard_sentinel_spill(
+                        repaired, real, m_axis, cap_alive
                     )
 
                 if mode == "hierarchical":

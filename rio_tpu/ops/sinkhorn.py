@@ -214,12 +214,20 @@ def exact_quota_repair(
     m = expected_counts.shape[0]
     counts = jnp.bincount(idx, length=m)
     scaled = jnp.maximum(expected_counts.astype(jnp.float32), 0.0)
-    # Normalize to sum exactly n so the largest-remainder distribution can
-    # always place every object (guards float drift in the marginals).
-    scaled = scaled * (n / jnp.maximum(jnp.sum(scaled), 1e-30))
+    # NO global rescale to sum-n here: multiplying every column by
+    # n/sum(scaled) perturbs each by the fp32 summation error, and at
+    # 2^24-scale totals that flips floor/remainder units on EXACT-integer
+    # columns — observed r4 as a padding-sentinel column whose quota came
+    # out one above the padding count, seating a real object on a
+    # non-node. Raw marginals keep integer columns' floors exact (their
+    # remainder is 0, so they never draw a largest-remainder bonus), and
+    # the integer shortfall below absorbs caller drift exactly. The clip
+    # guards the documented "sums to ~n" contract: a wildly undershooting
+    # caller now underfills (refill clamps) instead of being silently
+    # renormalized.
     base = jnp.floor(scaled).astype(jnp.int32)
     rem = scaled - base
-    short = n - jnp.sum(base)
+    short = jnp.clip(n - jnp.sum(base), 0, m)
     # Largest remainders get the leftover units; remainder ties prefer the
     # MORE-occupied column (awarding a tied bonus to an empty column would
     # displace a seated object for no quota reason — churn, not repair).

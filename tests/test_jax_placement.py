@@ -456,3 +456,23 @@ def test_provider_construction_initializes_no_backend():
     )
     assert proc.returncode == 0, proc.stderr.decode()
     assert b"CLEAN" in proc.stdout
+
+
+def test_guard_sentinel_spill_reseats_on_live_capacity():
+    """fp32 largest-remainder drift can park a real object on the padding
+    sentinel column (r4: bucket=2^24 == the fp32 integer boundary); the
+    guard must reseat exactly those rows on the best live node and leave
+    everything else untouched."""
+    import jax.numpy as jnp
+
+    from rio_tpu.object_placement.jax_placement import _guard_sentinel_spill
+
+    m_axis = 4
+    #             real rows --------------  padding
+    repaired = jnp.asarray([0, m_axis, 2, 1, m_axis, m_axis], jnp.int32)
+    real = jnp.asarray([True, True, True, True, False, False])
+    cap_alive = jnp.asarray([1.0, 0.0, 2.0, 1.0], jnp.float32)  # node 1 dead
+    out = _guard_sentinel_spill(repaired, real, m_axis, cap_alive)
+    # Row 1 (real, spilled) reseats on node 2 (max live capacity); padding
+    # rows keep the sentinel; everyone else is untouched.
+    assert out.tolist() == [0, 2, 2, 1, m_axis, m_axis]

@@ -61,15 +61,16 @@ def main(deadline: float = 120.0) -> None:
     # Latency health: best-of-3 dispatch+pull round trip on the tiny op
     # (already compiled above — the health phase adds NO compiles, so the
     # watchdog budget is unchanged from the pre-health probe), then one
-    # 4 MB device→host pull. The ones-fill is <1 ms of device work, so
-    # the pull time is effectively the transfer.
+    # 4 MB device→host pull. The pull is the ONLY sync on the buffer (no
+    # block_until_ready, no eager reductions — the r4 hang pattern); it
+    # includes the ones-fill, which is <1 ms of device work, so the time
+    # is effectively the transfer.
     ts = []
     for _ in range(3):
         t1 = time.monotonic()
         float(smoke(x))
         ts.append((time.monotonic() - t1) * 1e3)
     big = jnp.ones((1024, 1024), jnp.float32)  # 4 MB
-    jax.block_until_ready(big)
     t1 = time.monotonic()
     np.asarray(big)
     pull_ms = (time.monotonic() - t1) * 1e3
