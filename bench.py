@@ -588,7 +588,7 @@ def _warm_assign_rate(
 ) -> dict:
     """BASELINE row 4's single-chip half: warm incremental allocation.
 
-    The ``assign_batch`` device path (``jax_placement._place_keys``): a
+    The ``assign_batch`` device path (``jax_placement._solve_chunk``): a
     batch of NEW objects lands via the cached node potentials from the
     last OT solve + greedy waterfill over remaining headroom — no Sinkhorn
     re-solve on the allocation path.

@@ -490,7 +490,7 @@ class JaxObjectPlacement(ObjectPlacement):
             keys = [str(o) for o in object_ids]
             unplaced = [k for k in keys if k not in self._placements]
             if unplaced:
-                self._place_keys(unplaced)
+                await self._place_keys_async(unplaced)
             return [self._node_order[self._placements[k]] for k in keys]
 
     # Bounds the (bucket x node_axis) working set of one placement solve:
@@ -502,27 +502,49 @@ class JaxObjectPlacement(ObjectPlacement):
     # across the whole batch.
     _MAX_PLACE_CHUNK = 262_144
 
-    def _place_keys(self, keys: list[str]) -> None:
-        for start in range(0, len(keys), self._MAX_PLACE_CHUNK):
-            self._place_chunk(keys[start : start + self._MAX_PLACE_CHUNK])
+    async def _place_keys_async(self, keys: list[str]) -> None:
+        """Chunked placement with the device solve OFF the event loop.
 
-    def _place_chunk(self, keys: list[str]) -> None:
-        load, cap, alive = self._node_vectors()
+        Snapshot-solve-apply per chunk, the same discipline as
+        ``rebalance``: the node vectors and cached potentials are
+        snapshotted ON the event loop (so lock-free mutators like
+        ``sync_members``/``register_node``, which run on the loop, can
+        never tear them mid-read), the solve runs in a thread against
+        only those snapshots, and the cheap host apply runs back on the
+        loop. The caller holds ``self._lock`` across the awaits, so no
+        other locked mutator interleaves; lock-free dict reads
+        (``lookup``) stay live throughout.
+        """
+        for start in range(0, len(keys), self._MAX_PLACE_CHUNK):
+            chunk = keys[start : start + self._MAX_PLACE_CHUNK]
+            # Per-chunk snapshot: the previous chunk's apply changed load.
+            load, cap, alive = self._node_vectors()
+            g = self._g
+            assignment = await asyncio.to_thread(
+                self._solve_chunk, chunk, load, cap, alive, g
+            )
+            self._apply_chunk(chunk, assignment)
+
+    def _solve_chunk(self, keys, load, cap, alive, g) -> np.ndarray:
+        """Device solve for one placement chunk over loop-side snapshots;
+        reads NO live provider state, mutates nothing (thread-safe)."""
         n = len(keys)
         cost = build_cost_matrix(load, cap, alive)  # (1, n_nodes)
-        if self._g is not None:
+        if g is not None:
             # Warm path: bias the score by the cached node potentials from the
             # last OT solve, then waterfill (balance even under cost ties).
-            g = jnp.where(jnp.isfinite(self._g), self._g, -1e9)
+            g = jnp.where(jnp.isfinite(g), g, -1e9)
             cost = cost - g[None, :]
         bucket = _next_bucket(n)
         rows = jnp.broadcast_to(cost, (bucket, cost.shape[1]))
         mass = jnp.concatenate(
             [jnp.ones((n,), jnp.float32), jnp.zeros((bucket - n,), jnp.float32)]
         )
-        assignment = np.asarray(
+        return np.asarray(
             greedy_balanced_assign(rows, mass, cap * alive, load)
         )[:n]
+
+    def _apply_chunk(self, keys: list[str], assignment: np.ndarray) -> None:
         for k, idx in zip(keys, assignment.tolist()):
             self._set_placement(k, int(idx))
             self._nodes[self._node_order[idx]].load += 1.0
