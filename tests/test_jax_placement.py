@@ -476,3 +476,47 @@ def test_guard_sentinel_spill_reseats_on_live_capacity():
     # Row 1 (real, spilled) reseats on node 2 (max live capacity); padding
     # rows keep the sentinel; everyone else is untouched.
     assert out.tolist() == [0, 2, 2, 1, m_axis, m_axis]
+
+
+async def test_assign_batch_concurrent_with_membership_churn():
+    """The chunked off-loop solve must tolerate loop-side membership
+    mutations between/during chunks: sync_members and register_node run
+    lock-free on the event loop while the solver thread reads only
+    snapshots (r4 race fix). The batch spans multiple chunks; memberships
+    flip while it runs; every object must land on a known node."""
+    import asyncio
+
+    placement = JaxObjectPlacement(mode="greedy")
+    base = [f"10.1.0.{i}:70" for i in range(8)]
+    placement.sync_members(base)
+
+    churn_done = asyncio.Event()
+
+    async def churner():
+        extra = 8
+        while not churn_done.is_set():
+            # Flip a member out and in, and grow the node set (which can
+            # double the node axis mid-batch).
+            placement.sync_members(base[1:])
+            await asyncio.sleep(0)
+            placement.sync_members(base + [f"10.1.1.{extra}:70"])
+            extra += 1
+            await asyncio.sleep(0)
+
+    # Shrink the chunk so the batch needs several solve round trips.
+    old_chunk = JaxObjectPlacement._MAX_PLACE_CHUNK
+    JaxObjectPlacement._MAX_PLACE_CHUNK = 1024
+    try:
+        task = asyncio.create_task(churner())
+        ids = [ObjectId("Race", str(i)) for i in range(6000)]
+        where = await placement.assign_batch(ids)
+    finally:
+        churn_done.set()
+        await task
+        JaxObjectPlacement._MAX_PLACE_CHUNK = old_chunk
+    assert len(where) == len(ids)
+    known = set(placement._node_order)
+    assert all(w in known for w in where)
+    # The directory answers for every object afterwards.
+    looked = await placement.lookup_batch(ids)
+    assert all(w is not None for w in looked)
