@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import asyncio
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 
 import jax
 import jax.numpy as jnp
@@ -237,7 +237,12 @@ class SolveStats:
     epoch: int = 0
     mode: str = "none"
     discarded: bool = False
+    # Bounded record of prior completed solves (most recent last, each with
+    # an empty history of its own) — lets the daemon/operators see churn
+    # cadence and whether solve/apply cost or move counts drift over time.
     history: list = field(default_factory=list)
+
+    HISTORY_LIMIT = 32
 
 
 class JaxObjectPlacement(ObjectPlacement):
@@ -339,6 +344,17 @@ class JaxObjectPlacement(ObjectPlacement):
                     "sinkhorn" if jax.default_backend() == "tpu" else "greedy"
                 )
         return self._mode
+
+    def _archived_history(self) -> list:
+        """Current stats (if any solve/attempt happened) appended to its
+        own history, flattened and bounded — the record the NEXT stats
+        object carries. Lock held by callers."""
+        prior = self.stats
+        if not prior.epoch:  # the never-solved default carries no event
+            return []
+        return (prior.history + [replace(prior, history=[])])[
+            -SolveStats.HISTORY_LIMIT:
+        ]
 
     # ------------------------------------------------- directory internals
     def _set_placement(self, key: str, idx: int) -> bool:
@@ -839,7 +855,20 @@ class JaxObjectPlacement(ObjectPlacement):
 
         async with self._lock:
             if self._epoch != snapshot_epoch:
-                self.stats.discarded = True
+                # Record the discarded ATTEMPT as its own stats event (the
+                # next completed solve archives it into history like any
+                # other) instead of mutating the prior completed solve's
+                # record in place — that flag-flip misrepresented a
+                # finished solve as discarded once history kept it.
+                self.stats = SolveStats(
+                    n_objects=n,
+                    n_nodes=len(self._node_order),
+                    solve_ms=solve_ms,
+                    epoch=self._epoch,
+                    mode=solved_as,
+                    discarded=True,
+                    history=self._archived_history(),
+                )
                 return 0
             # Touch only the movers: non-movers are _set_placement no-ops
             # by definition (epoch unchanged => directory equals the
@@ -847,6 +876,7 @@ class JaxObjectPlacement(ObjectPlacement):
             # apply from an O(N) Python loop under the lock (~0.3 s/1M,
             # the dominant host cost of a churn rebalance) into
             # O(movers) — typically the displaced few percent.
+            hist = self._archived_history()
             t_apply = time.perf_counter()
             mover_pos = np.nonzero(assignment != cur_idx)[0]
             moved = 0
@@ -866,5 +896,6 @@ class JaxObjectPlacement(ObjectPlacement):
                 epoch=self._epoch,
                 mode=solved_as,
                 discarded=False,
+                history=hist,
             )
             return moved

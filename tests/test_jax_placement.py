@@ -520,3 +520,18 @@ async def test_assign_batch_concurrent_with_membership_churn():
     # The directory answers for every object afterwards.
     looked = await placement.lookup_batch(ids)
     assert all(w is not None for w in looked)
+
+
+async def test_solve_stats_history_records_prior_solves():
+    placement = JaxObjectPlacement(mode="greedy")
+    placement.sync_members([f"10.2.0.{i}:80" for i in range(4)])
+    ids = [ObjectId("Hist", str(i)) for i in range(200)]
+    await placement.assign_batch(ids)
+    await placement.rebalance()
+    first_epoch = placement.stats.epoch
+    assert placement.stats.history == []  # nothing completed before it
+    await placement.rebalance()
+    hist = placement.stats.history
+    assert [h.epoch for h in hist] == [first_epoch]
+    assert hist[0].history == []  # entries are flat, never nested
+    assert placement.stats.epoch > first_epoch
