@@ -127,7 +127,13 @@ async def main() -> None:
     print(f"[demo] killing {victim.local_address}")
     victim.admin_sender().queue.put_nowait(AdminCommand.server_exit())
     for _ in range(600):  # the daemon's first real solve includes jit compile
-        if placement.stats.epoch != epoch0 and placement.stats.n_objects:
+        # A discarded attempt is a stats event too — wait for a COMPLETED
+        # solve (the daemon retries after a discard).
+        if (
+            placement.stats.epoch != epoch0
+            and placement.stats.n_objects
+            and not placement.stats.discarded
+        ):
             break
         await asyncio.sleep(0.05)
     else:

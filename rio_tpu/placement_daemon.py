@@ -36,6 +36,7 @@ class PlacementDaemonStats:
     liveness_changes: int = 0
     rebalances: int = 0
     rebalances_skipped: int = 0  # sibling daemon on a shared provider won
+    rebalances_discarded: int = 0  # lost an epoch race; retried next poll
     moves: int = 0
     errors: int = 0
 
@@ -72,6 +73,7 @@ class PlacementDaemon:
         self.config = config or PlacementDaemonConfig()
         self.stats = PlacementDaemonStats()
         self._last_liveness: frozenset[tuple[str, bool]] | None = None
+        self._retry_solve = False  # last solve was epoch-discarded
 
     @property
     def supported(self) -> bool:
@@ -84,8 +86,22 @@ class PlacementDaemon:
         return frozenset((m.address, bool(m.active)) for m in members), members
 
     def _solve_epoch(self):
-        """The provider's committed-solve epoch, when it exposes one."""
-        return getattr(getattr(self.placement, "stats", None), "epoch", None)
+        """The provider's last COMMITTED-solve epoch, when it exposes one.
+
+        Discarded attempts are stats events too (SolveStats history), so
+        scan the current stats' history backwards for the last
+        non-discarded entry — archived entries are flattened (their own
+        history is empty), so recursing into them would dead-end after
+        two consecutive discards and misreport "no committed solve"."""
+        stats = getattr(self.placement, "stats", None)
+        if stats is None:
+            return None
+        if not getattr(stats, "discarded", False):
+            return getattr(stats, "epoch", None)
+        for prior in reversed(getattr(stats, "history", None) or []):
+            if not getattr(prior, "discarded", False):
+                return getattr(prior, "epoch", None)
+        return None
 
     async def run(self) -> None:
         """Poll loop; runs until cancelled (a Server.run child task)."""
@@ -102,8 +118,14 @@ class PlacementDaemon:
             try:
                 liveness, members = await self._liveness()
                 self.stats.polls += 1
-                if liveness != self._last_liveness:
-                    first_sync = self._last_liveness is None
+                retry = self._retry_solve
+                changed = liveness != self._last_liveness
+                if changed or retry:
+                    # NOTE _retry_solve is NOT cleared here: every exit of
+                    # this branch sets it explicitly, so a transient
+                    # exception mid-retry leaves the flag armed and the
+                    # still-unserved churn event is retried next poll.
+                    first_sync = self._last_liveness is None and not retry
                     self._last_liveness = liveness
                     self.placement.sync_members(members)
                     if first_sync:
@@ -111,7 +133,8 @@ class PlacementDaemon:
                         # solving — nothing is displaced yet.
                         await asyncio.sleep(cfg.poll_interval)
                         continue
-                    self.stats.liveness_changes += 1
+                    if changed:  # a pure retry serves an already-counted event
+                        self.stats.liveness_changes += 1
                     solve_epoch = self._solve_epoch()
                     # Debounce a churn burst into one solve; the random
                     # jitter staggers the daemons of co-located servers
@@ -127,18 +150,40 @@ class PlacementDaemon:
                         # A sibling daemon on the SAME provider already
                         # solved this churn event — don't dispatch another
                         # device solve just to have it epoch-discarded.
+                        self._retry_solve = False  # event served by sibling
                         self.stats.rebalances_skipped += 1
                         await asyncio.sleep(cfg.poll_interval)
                         continue
+                    stats_before = getattr(self.placement, "stats", None)
                     moved = await self.placement.rebalance(mode=cfg.mode)
                     last_rebalance = loop.time()
-                    self.stats.rebalances += 1
-                    self.stats.moves += int(moved)
-                    log.info(
-                        "churn re-solve: %d objects moved (%d liveness changes seen)",
-                        moved,
-                        self.stats.liveness_changes,
+                    stats_now = getattr(self.placement, "stats", None)
+                    # Attribute a discard to OUR attempt only when the
+                    # stats object actually changed under the call — a
+                    # stale discarded flag (e.g. rebalance early-returned
+                    # on an empty directory without touching stats) must
+                    # not re-arm the retry forever.
+                    ours_discarded = (
+                        stats_now is not stats_before
+                        and getattr(stats_now, "discarded", False)
                     )
+                    self._retry_solve = ours_discarded
+                    if ours_discarded:
+                        # The solve lost an epoch race (concurrent churn or
+                        # allocation landed mid-solve): the liveness change
+                        # is still unserved — retry on the next poll rather
+                        # than waiting for ANOTHER churn event.
+                        self.stats.rebalances_discarded += 1
+                        log.info("churn re-solve discarded (epoch race); retrying")
+                    else:
+                        self.stats.rebalances += 1
+                        self.stats.moves += int(moved)
+                        log.info(
+                            "churn re-solve: %d objects moved "
+                            "(%d liveness changes seen)",
+                            moved,
+                            self.stats.liveness_changes,
+                        )
             except asyncio.CancelledError:
                 raise
             except Exception:

@@ -191,3 +191,79 @@ def test_shared_config_stats_isolated_per_daemon():
     d2 = PlacementDaemon(members, placement, cfg)
     assert d1.stats is not d2.stats
     assert not d1.supported  # CRUD-only provider: daemon parks
+
+
+def test_daemon_retries_after_epoch_discarded_solve():
+    """A rebalance that loses the epoch race (stats.discarded) must be
+    retried on the next poll — the churn event is still unserved — and a
+    discarded attempt must never satisfy the sibling-skip epoch check."""
+    from dataclasses import dataclass, field
+
+    @dataclass
+    class FakeStats:
+        epoch: int = 0
+        discarded: bool = False
+        history: list = field(default_factory=list)
+
+    class FlakyPlacement:
+        """First rebalance is epoch-discarded; second commits."""
+
+        def __init__(self):
+            self.stats = FakeStats()
+            self.rebalances = 0
+
+        def sync_members(self, members):
+            pass
+
+        async def rebalance(self, *, mode=None):
+            self.rebalances += 1
+            prior = self.stats
+            if self.rebalances == 1:
+                archived = (
+                    prior.history
+                    + [FakeStats(epoch=prior.epoch, discarded=prior.discarded)]
+                    if prior.epoch
+                    else []
+                )
+                self.stats = FakeStats(
+                    epoch=prior.epoch + 1, discarded=True, history=archived
+                )
+                return 0
+            self.stats = FakeStats(epoch=prior.epoch + 1)
+            return 7
+
+    async def run():
+        storage = LocalStorage()
+        placement = FlakyPlacement()
+        daemon = PlacementDaemon(
+            storage,
+            placement,
+            PlacementDaemonConfig(
+                poll_interval=0.05, debounce=0.01, min_rebalance_interval=0.0
+            ),
+        )
+        from rio_tpu.cluster.storage import Member
+
+        await storage.push(Member.from_address("10.3.0.1:90", active=True))
+        await storage.push(Member.from_address("10.3.0.2:90", active=True))
+        task = asyncio.create_task(daemon.run())
+        try:
+            await asyncio.sleep(0.2)  # first sync (no solve)
+            # Churn: one node dies.
+            await storage.set_inactive("10.3.0.2", 90)
+            for _ in range(100):
+                if daemon.stats.rebalances >= 1:
+                    break
+                await asyncio.sleep(0.05)
+        finally:
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+        # The discarded attempt was recorded AND retried to completion.
+        assert daemon.stats.rebalances_discarded == 1
+        assert daemon.stats.rebalances == 1
+        assert daemon.stats.moves == 7
+        assert placement.rebalances == 2
+        # One churn event, even though it took two attempts.
+        assert daemon.stats.liveness_changes == 1
+
+    asyncio.run(asyncio.wait_for(run(), 30))
