@@ -80,20 +80,67 @@ async def _valid_roundtrip(host: str, port: int, tag: int) -> None:
             pass
 
 
+async def _fuzz_against(host: str, port: int) -> None:
+    rng = random.Random(0xF022)
+    for batch in range(8):
+        payloads = list(_MAGIC_BAD) + [_random_garbage(rng) for _ in range(25)]
+        await asyncio.gather(*[_poke_garbage(host, port, p) for p in payloads])
+        # The node must still serve well-formed traffic.
+        await _valid_roundtrip(host, port, tag=batch)
+
+
 def test_server_survives_garbage_frames():
     async def run():
         server, task, host, port = await _boot()
-        rng = random.Random(0xF022)
         try:
-            for batch in range(8):
-                payloads = list(_MAGIC_BAD) + [
-                    _random_garbage(rng) for _ in range(25)
-                ]
-                await asyncio.gather(
-                    *[_poke_garbage(host, port, p) for p in payloads]
-                )
-                # The node must still serve well-formed traffic.
-                await _valid_roundtrip(host, port, tag=batch)
+            await _fuzz_against(host, port)
+        finally:
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+
+    asyncio.run(asyncio.wait_for(run(), 60))
+
+
+def test_native_server_survives_garbage_frames():
+    """Same batches against the C++ epoll engine's framer/decoder — the
+    native data plane must match the asyncio transport's refuse-and-keep-
+    serving posture byte for byte (CLAUDE.md wire invariant)."""
+    from rio_tpu import native
+
+    if native.get() is None:
+        import pytest
+
+        pytest.skip("native library unavailable")
+
+    async def run():
+        from rio_tpu import (
+            LocalObjectPlacement,
+            LocalStorage,
+            Registry,
+            Server,
+        )
+        from rio_tpu.cluster.membership_protocol import LocalClusterProvider
+
+        from tests.test_aio_transport import SleepyActor
+
+        members = LocalStorage()
+        server = Server(
+            address="127.0.0.1:0",
+            registry=Registry().add_type(SleepyActor),
+            cluster_provider=LocalClusterProvider(members),
+            object_placement_provider=LocalObjectPlacement(),
+            transport="native",
+        )
+        await server.prepare()
+        addr = await server.bind()
+        task = asyncio.create_task(server.run())
+        for _ in range(100):
+            if await members.active_members():
+                break
+            await asyncio.sleep(0.02)
+        host, _, port = addr.rpartition(":")
+        try:
+            await _fuzz_against(host, int(port))
         finally:
             task.cancel()
             await asyncio.gather(task, return_exceptions=True)
