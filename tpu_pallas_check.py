@@ -170,9 +170,17 @@ def child(kernel: str, deadline: float) -> None:
 
     import functools
 
+    # Optional layout experiment knob for the perf phase: the grid walks
+    # n/block_rows steps per iteration, so if per-step overhead (not HBM)
+    # dominates, a larger block should show it immediately in the slope.
+    block_rows = int(os.environ.get("RIO_TPU_PALLAS_BLOCK_ROWS", "0"))
+    pallas_kw = {"block_rows": block_rows} if block_rows else {}
+
     @functools.partial(jax.jit, static_argnames=("n",))
     def run_pallas(cost, mass, cap, n):
-        r = pallas_fn(cost, mass, cap, eps=0.05, n_iters=n, interpret=False)
+        r = pallas_fn(
+            cost, mass, cap, eps=0.05, n_iters=n, interpret=False, **pallas_kw
+        )
         return jnp.sum(jnp.where(jnp.isfinite(r.g), r.g, 0.0))
 
     @functools.partial(jax.jit, static_argnames=("n",))
@@ -181,6 +189,8 @@ def child(kernel: str, deadline: float) -> None:
         return jnp.sum(jnp.where(jnp.isfinite(r.g), r.g, 0.0))
 
     out["perf_shape"] = [PERF_N_OBJ, PERF_N_NODES]
+    if block_rows:
+        out["block_rows"] = block_rows
     # Budget each lo run from MEASURED prior-stage timings (CLAUDE.md rule;
     # the parity stage above is the only measurement we have for the first
     # projection). 32x the data of the parity shape: assume compile scales
