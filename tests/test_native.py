@@ -368,3 +368,45 @@ def test_native_client_subscription():
             body, registry_builder=build_registry, num_servers=2, transport="native"
         )
     )
+
+
+def test_native_frame_reader_fuzz_parity():
+    """Seeded fuzz: random valid frames interleaved with random garbage,
+    fed in random chunk sizes — the C++ reader must match the Python
+    reader byte for byte, including WHERE the oversize error fires
+    (garbage bytes routinely parse as absurd length prefixes)."""
+    import random
+
+    from rio_tpu.errors import SerializationError
+
+    rng = random.Random(0xBEEF)
+    for _trial in range(25):
+        parts = []
+        for _ in range(rng.randrange(1, 12)):
+            if rng.random() < 0.6:
+                body = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 300)))
+                parts.append(codec.frame(body))
+            else:
+                parts.append(bytes(rng.randrange(256) for _ in range(rng.randrange(1, 40))))
+        stream = b"".join(parts)
+        nat = native.NativeFrameReader(lib)
+        py = codec.FrameReader()
+        i = 0
+        while i < len(stream):
+            n = rng.randrange(1, 97)
+            chunk = stream[i : i + n]
+            i += n
+            err_nat = err_py = False
+            out_nat = out_py = None
+            try:
+                out_nat = nat.feed(chunk)
+            except SerializationError:
+                err_nat = True
+            try:
+                out_py = py.feed(chunk)
+            except SerializationError:
+                err_py = True
+            assert err_nat == err_py, f"error divergence at byte {i}"
+            if err_nat:
+                break
+            assert out_nat == out_py, f"frame divergence at byte {i}"
