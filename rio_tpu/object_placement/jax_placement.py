@@ -168,24 +168,12 @@ def _next_bucket(n: int, minimum: int = 256) -> int:
 
 
 def _guard_sentinel_spill(repaired, real, m_axis: int, cap_alive):
-    """Reseat real objects the quota repair left on the padding sentinel.
+    """Shared guard (see :func:`rio_tpu.ops.sinkhorn.route_sentinel_spill`);
+    r4 trigger here: 10M objects, bucket 16,777,216 = exactly the fp32
+    integer-precision boundary, lookup IndexError."""
+    from ..ops.sinkhorn import route_sentinel_spill
 
-    The bucket-shaped repair routes padding rows through a sentinel column
-    (index ``m_axis``) whose quota is the padding count. That quota comes
-    out of a float32 largest-remainder distribution, and at 2^24-scale
-    buckets fp32 drift can hand the sentinel one unit more than the
-    padding count — refilling one REAL object onto the sentinel, which is
-    not a node (observed r4: 10M objects, bucket 16,777,216 = exactly the
-    fp32 integer-precision boundary, lookup IndexError). The drift is at
-    most a unit or two, so reseating spilled rows on the
-    highest-capacity live node preserves exact balance within that drift.
-    The root fix (no global rescale in ``exact_quota_repair``) makes the
-    sentinel's remainder exactly zero, so this guard is belt-and-braces
-    for callers whose expected marginals are not exact integers.
-    """
-    spill = real & (repaired >= m_axis)
-    fallback = jnp.argmax(cap_alive).astype(repaired.dtype)
-    return jnp.where(spill, fallback, repaired)
+    return route_sentinel_spill(repaired, real, m_axis, cap_alive)
 
 
 def _apply_class_quotas(quotas: np.ndarray, cur_idx: np.ndarray) -> np.ndarray:
@@ -502,12 +490,29 @@ class JaxObjectPlacement(ObjectPlacement):
         falling back to a greedy balanced solve. This is the replacement for
         the reference's one-SQL-roundtrip-per-object allocate
         (``service.rs:241-253``).
+
+        The lock is taken PER CHUNK, not across the whole batch (ADVICE r4):
+        a 10M-key batch solves for ~46 s, and holding ``self._lock`` across
+        it starved ``update``/``remove``/``clean_server``/``rebalance`` and
+        every other ``assign_batch`` caller for the duration. Each chunk
+        re-checks membership under its lock hold (two callers racing on
+        overlapping keys place each key once), and the final address
+        resolution re-validates: a concurrent ``remove``/``clean_server``
+        between chunks may have dropped keys placed earlier, so stragglers
+        are re-placed under one last lock hold — no unlocked await separates
+        that re-place from the read, so the resolution cannot miss.
         """
+        keys = [str(o) for o in object_ids]
+        for start in range(0, len(keys), self._MAX_PLACE_CHUNK):
+            chunk = keys[start : start + self._MAX_PLACE_CHUNK]
+            async with self._lock:
+                unplaced = [k for k in chunk if k not in self._placements]
+                if unplaced:
+                    await self._place_chunk_locked(unplaced)
         async with self._lock:
-            keys = [str(o) for o in object_ids]
-            unplaced = [k for k in keys if k not in self._placements]
-            if unplaced:
-                await self._place_keys_async(unplaced)
+            missing = [k for k in keys if k not in self._placements]
+            if missing:
+                await self._place_keys_async(missing)
             return [self._node_order[self._placements[k]] for k in keys]
 
     # Bounds the (bucket x node_axis) working set of one placement solve:
@@ -520,27 +525,30 @@ class JaxObjectPlacement(ObjectPlacement):
     _MAX_PLACE_CHUNK = 262_144
 
     async def _place_keys_async(self, keys: list[str]) -> None:
-        """Chunked placement with the device solve OFF the event loop.
-
-        Snapshot-solve-apply per chunk, the same discipline as
-        ``rebalance``: the node vectors and cached potentials are
-        snapshotted ON the event loop (so lock-free mutators like
-        ``sync_members``/``register_node``, which run on the loop, can
-        never tear them mid-read), the solve runs in a thread against
-        only those snapshots, and the cheap host apply runs back on the
-        loop. The caller holds ``self._lock`` across the awaits, so no
-        other locked mutator interleaves; lock-free dict reads
-        (``lookup``) stay live throughout.
-        """
+        """Chunked placement under a CALLER-held lock (straggler path)."""
         for start in range(0, len(keys), self._MAX_PLACE_CHUNK):
-            chunk = keys[start : start + self._MAX_PLACE_CHUNK]
-            # Per-chunk snapshot: the previous chunk's apply changed load.
-            load, cap, alive = self._node_vectors()
-            g = self._g
-            assignment = await asyncio.to_thread(
-                self._solve_chunk, chunk, load, cap, alive, g
-            )
-            self._apply_chunk(chunk, assignment)
+            await self._place_chunk_locked(keys[start : start + self._MAX_PLACE_CHUNK])
+
+    async def _place_chunk_locked(self, chunk: list[str]) -> None:
+        """One chunk's placement with the device solve OFF the event loop.
+
+        Snapshot-solve-apply, the same discipline as ``rebalance``: the
+        node vectors and cached potentials are snapshotted ON the event
+        loop (so lock-free mutators like ``sync_members``/``register_node``,
+        which run on the loop, can never tear them mid-read), the solve
+        runs in a thread against only those snapshots, and the cheap host
+        apply runs back on the loop. The caller holds ``self._lock`` across
+        the awaits, so no other locked mutator interleaves within a chunk;
+        lock-free dict reads (``lookup``) stay live throughout.
+        """
+        # Snapshot here, not at batch start: the previous chunk's apply
+        # (and, between lock holds, any interleaved mutator) changed load.
+        load, cap, alive = self._node_vectors()
+        g = self._g
+        assignment = await asyncio.to_thread(
+            self._solve_chunk, chunk, load, cap, alive, g
+        )
+        self._apply_chunk(chunk, assignment)
 
     def _solve_chunk(self, keys, load, cap, alive, g) -> np.ndarray:
         """Device solve for one placement chunk over loop-side snapshots;

@@ -263,6 +263,40 @@ def exact_quota_repair(
     return jnp.zeros_like(idx).at[order].set(col_sorted)
 
 
+def route_sentinel_spill(
+    idx: jax.Array, is_real: jax.Array, sentinel: int, capacity: jax.Array
+) -> jax.Array:
+    """Reseat real rows that quota repair left on the padding sentinel.
+
+    Bucket-shaped solves route padding rows through a sentinel column
+    (index ``sentinel``) whose quota is the padding count. Two drifts can
+    seat a REAL row there instead: a float32 largest-remainder quota one
+    unit above the padding count (observed r4 at the 2^24 bucket boundary
+    — the root fix in :func:`exact_quota_repair` keeps integer columns
+    exact, so this is belt-and-braces for callers whose expected marginals
+    are not exact integers), and the repair refill's clip spilling into
+    the last column when caller marginals undershoot. Downstream index
+    lookups would otherwise crash (flat path) or silently clamp onto a
+    possibly-dead neighbor (``take_along_axis`` in the hierarchical fine
+    stage). The drift is at most a unit or two, so reseating spilled rows
+    on the highest-capacity live column preserves balance within that
+    drift. ONE implementation shared by every bucket-shaped caller
+    (``JaxObjectPlacement`` and the hierarchical fine stage) — the guard
+    semantics must never diverge between solvers.
+
+    Args:
+      idx: (n,) int32 assignment after quota repair.
+      is_real: (n,) bool — real rows (padding rows keep the sentinel; they
+        are dropped or sliced off by the caller).
+      sentinel: first non-column index; anything >= it is a spill.
+      capacity: (m,) effective capacity (zero on dead columns) used to
+        pick the fallback seat.
+    """
+    spill = is_real & (idx >= sentinel)
+    fallback = jnp.argmax(capacity).astype(idx.dtype)
+    return jnp.where(spill, fallback, idx)
+
+
 def sinkhorn_assign(
     cost: jax.Array,
     row_mass: jax.Array,

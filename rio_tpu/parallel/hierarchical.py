@@ -40,7 +40,11 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.scaling import scaling_sinkhorn
-from ..ops.sinkhorn import exact_quota_repair, plan_rounded_assign
+from ..ops.sinkhorn import (
+    exact_quota_repair,
+    plan_rounded_assign,
+    route_sentinel_spill,
+)
 
 __all__ = ["HierarchicalResult", "hierarchical_assign", "sharded_hierarchical_assign"]
 
@@ -176,7 +180,11 @@ def hierarchical_assign(
         expected = jnp.concatenate(
             [b / jnp.maximum(jnp.sum(b), 1e-30) * n_real, pad_count]
         )
-        return exact_quota_repair(local, expected)
+        repaired = exact_quota_repair(local, expected)
+        # Real rows spilled onto the sentinel column (quota drift / refill
+        # clip) would be take_along_axis-clamped onto member s-1, which may
+        # be dead — route them to the group's best live member instead.
+        return route_sentinel_spill(repaired, a > 0, s, b)
 
     fine_local = jax.vmap(solve_one)(fine_cost, fine_mass, cap_g)  # (G, B) in [0,S]
     members = jnp.arange(m, dtype=jnp.int32).reshape(n_groups, s)

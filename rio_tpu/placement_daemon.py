@@ -37,6 +37,7 @@ class PlacementDaemonStats:
     rebalances: int = 0
     rebalances_skipped: int = 0  # sibling daemon on a shared provider won
     rebalances_discarded: int = 0  # lost an epoch race; retried next poll
+    retries_abandoned: int = 0  # discard-retry budget exhausted; wait for churn
     moves: int = 0
     errors: int = 0
 
@@ -56,6 +57,15 @@ class PlacementDaemonConfig:
     # Floor between full re-solves, so a flapping node can't make the
     # daemon spin the device.
     min_rebalance_interval: float = 1.0
+    # Epoch-discard retries back off exponentially (min_rebalance_interval
+    # * 2^k, capped below) and give up after this many CONSECUTIVE discards
+    # — under sustained allocation traffic that bumps the epoch during
+    # every solve, unbounded retries would dispatch a full device solve per
+    # poll forever, each one discarded (livelock doing no useful work). The
+    # lazy request-path re-seat still covers displaced objects; the next
+    # liveness change re-arms the daemon.
+    max_discard_retries: int = 5
+    retry_backoff_max: float = 30.0
     mode: str | None = None  # solver mode override for daemon rebalances
 
 
@@ -74,6 +84,8 @@ class PlacementDaemon:
         self.stats = PlacementDaemonStats()
         self._last_liveness: frozenset[tuple[str, bool]] | None = None
         self._retry_solve = False  # last solve was epoch-discarded
+        self._consecutive_discards = 0
+        self._retry_not_before = float("-inf")  # backoff gate (loop time)
 
     @property
     def supported(self) -> bool:
@@ -118,8 +130,13 @@ class PlacementDaemon:
             try:
                 liveness, members = await self._liveness()
                 self.stats.polls += 1
-                retry = self._retry_solve
+                retry = self._retry_solve and loop.time() >= self._retry_not_before
                 changed = liveness != self._last_liveness
+                if changed:
+                    # Fresh churn: the backoff ladder was about the OLD
+                    # event's epoch races — start over.
+                    self._consecutive_discards = 0
+                    self._retry_not_before = float("-inf")
                 if changed or retry:
                     # NOTE _retry_solve is NOT cleared here: every exit of
                     # this branch sets it explicitly, so a transient
@@ -167,15 +184,41 @@ class PlacementDaemon:
                         stats_now is not stats_before
                         and getattr(stats_now, "discarded", False)
                     )
-                    self._retry_solve = ours_discarded
                     if ours_discarded:
                         # The solve lost an epoch race (concurrent churn or
                         # allocation landed mid-solve): the liveness change
-                        # is still unserved — retry on the next poll rather
-                        # than waiting for ANOTHER churn event.
+                        # is still unserved — retry, but on an exponential
+                        # backoff, and give up after max_discard_retries
+                        # consecutive losses (sustained allocation traffic
+                        # would otherwise livelock the device: one discarded
+                        # solve per poll forever).
                         self.stats.rebalances_discarded += 1
-                        log.info("churn re-solve discarded (epoch race); retrying")
+                        self._consecutive_discards += 1
+                        if self._consecutive_discards > cfg.max_discard_retries:
+                            self._retry_solve = False
+                            self.stats.retries_abandoned += 1
+                            log.warning(
+                                "churn re-solve discarded %d times in a row; "
+                                "abandoning retries until the next liveness "
+                                "change (lazy re-seat still covers requests)",
+                                self._consecutive_discards,
+                            )
+                        else:
+                            self._retry_solve = True
+                            self._retry_not_before = loop.time() + min(
+                                cfg.min_rebalance_interval
+                                * 2 ** (self._consecutive_discards - 1),
+                                cfg.retry_backoff_max,
+                            )
+                            log.info(
+                                "churn re-solve discarded (epoch race); "
+                                "retry %d/%d backed off",
+                                self._consecutive_discards,
+                                cfg.max_discard_retries,
+                            )
                     else:
+                        self._retry_solve = False
+                        self._consecutive_discards = 0
                         self.stats.rebalances += 1
                         self.stats.moves += int(moved)
                         log.info(

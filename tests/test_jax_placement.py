@@ -522,6 +522,63 @@ async def test_assign_batch_concurrent_with_membership_churn():
     assert all(w is not None for w in looked)
 
 
+async def test_assign_batch_releases_lock_between_chunks():
+    """ADVICE r4: a huge batch must not hold the provider lock for its
+    whole runtime. A locked mutator (remove of a chunk-0 key) queues on the
+    lock WHILE chunk 0 is still held, so FIFO fairness serves it in the
+    between-chunk gap — it must complete while the batch is still running
+    (the old whole-batch hold blocked it until the end), and the batch's
+    final resolution pass must re-place the removed straggler."""
+    import asyncio
+
+    placement = JaxObjectPlacement(mode="greedy")
+    placement.sync_members([f"10.5.0.{i}:70" for i in range(4)])
+
+    chunk0_done = asyncio.Event()
+    batch_done = False
+    removed_while_batch_ran = None
+    orig = JaxObjectPlacement._place_chunk_locked
+
+    async def chunk_and_signal(self, chunk):
+        await orig(self, chunk)
+        if not chunk0_done.is_set():
+            chunk0_done.set()
+            # Still holding the lock: yield so the mutator wakes and QUEUES
+            # its lock request behind us — FIFO then guarantees it runs in
+            # the gap before chunk 1, not after the whole batch.
+            for _ in range(5):
+                await asyncio.sleep(0)
+
+    ids = [ObjectId("Big", str(i)) for i in range(4000)]
+    straggler = ids[3]  # placed in chunk 0
+
+    async def mutator():
+        nonlocal removed_while_batch_ran
+        await chunk0_done.wait()
+        await placement.remove(straggler)
+        removed_while_batch_ran = not batch_done
+
+    old_chunk = JaxObjectPlacement._MAX_PLACE_CHUNK
+    JaxObjectPlacement._MAX_PLACE_CHUNK = 512
+    JaxObjectPlacement._place_chunk_locked = chunk_and_signal
+    try:
+        task = asyncio.create_task(mutator())
+        where = await placement.assign_batch(ids)
+        batch_done = True
+        await asyncio.wait_for(task, 30)
+    finally:
+        JaxObjectPlacement._MAX_PLACE_CHUNK = old_chunk
+        JaxObjectPlacement._place_chunk_locked = orig
+    # The remove interleaved mid-batch (lock released between chunks)...
+    assert removed_while_batch_ran is True
+    # ...and the final resolution re-placed it: every key resolves.
+    assert len(where) == len(ids)
+    known = set(placement._node_order)
+    assert all(w in known for w in where)
+    looked = await placement.lookup_batch(ids)
+    assert all(w is not None for w in looked)
+
+
 async def test_solve_stats_history_records_prior_solves():
     placement = JaxObjectPlacement(mode="greedy")
     placement.sync_members([f"10.2.0.{i}:80" for i in range(4)])

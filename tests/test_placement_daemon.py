@@ -267,3 +267,78 @@ def test_daemon_retries_after_epoch_discarded_solve():
         assert daemon.stats.liveness_changes == 1
 
     asyncio.run(asyncio.wait_for(run(), 30))
+
+
+def test_daemon_abandons_retries_after_consecutive_discards():
+    """Sustained epoch races must not livelock the device: after
+    max_discard_retries consecutive discards the daemon stops dispatching
+    solves until the NEXT liveness change, which re-arms it."""
+    from dataclasses import dataclass, field
+
+    @dataclass
+    class FakeStats:
+        epoch: int = 0
+        discarded: bool = False
+        history: list = field(default_factory=list)
+
+    class AlwaysDiscarded:
+        """Every rebalance loses the epoch race (e.g. allocation traffic)."""
+
+        def __init__(self):
+            self.stats = FakeStats()
+            self.rebalances = 0
+
+        def sync_members(self, members):
+            pass
+
+        async def rebalance(self, *, mode=None):
+            self.rebalances += 1
+            self.stats = FakeStats(epoch=self.stats.epoch + 1, discarded=True)
+            return 0
+
+    async def run():
+        storage = LocalStorage()
+        placement = AlwaysDiscarded()
+        daemon = PlacementDaemon(
+            storage,
+            placement,
+            PlacementDaemonConfig(
+                poll_interval=0.02,
+                debounce=0.01,
+                min_rebalance_interval=0.0,  # zero backoff: tests the CAP
+                max_discard_retries=2,
+            ),
+        )
+        from rio_tpu.cluster.storage import Member
+
+        await storage.push(Member.from_address("10.4.0.1:90", active=True))
+        await storage.push(Member.from_address("10.4.0.2:90", active=True))
+        await storage.push(Member.from_address("10.4.0.3:90", active=True))
+        task = asyncio.create_task(daemon.run())
+        try:
+            await asyncio.sleep(0.2)  # first sync (no solve)
+            await storage.set_inactive("10.4.0.2", 90)
+            for _ in range(100):
+                if daemon.stats.retries_abandoned >= 1:
+                    break
+                await asyncio.sleep(0.05)
+            assert daemon.stats.retries_abandoned == 1
+            # Initial attempt + exactly max_discard_retries retries, then
+            # silence: no further solves while liveness is stable.
+            assert placement.rebalances == 3
+            await asyncio.sleep(0.3)
+            assert placement.rebalances == 3, "daemon kept solving after giving up"
+            # A NEW churn event re-arms the daemon (and resets the ladder).
+            await storage.set_inactive("10.4.0.3", 90)
+            for _ in range(100):
+                if placement.rebalances > 3:
+                    break
+                await asyncio.sleep(0.05)
+            assert placement.rebalances > 3, "new churn did not re-arm the daemon"
+        finally:
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+        assert daemon.stats.rebalances == 0  # every attempt was discarded
+        assert daemon.stats.rebalances_discarded >= 3
+
+    asyncio.run(asyncio.wait_for(run(), 30))

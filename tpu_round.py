@@ -1,0 +1,234 @@
+"""Wedge-aware TPU round orchestrator (VERDICT r4 item 8).
+
+Encodes the round's TPU schedule as a priority-ordered pipeline so a
+mid-round relay wedge costs only the stages not yet run — never the
+high-priority evidence:
+
+  probe -> bench (chained headline -> BENCH_DETAIL.tpu.json)
+        -> probe -> pallas slope head-to-head (PALLAS_TPU.json verdict)
+        -> probe -> hier ladder (row 5, banked rung by rung)
+
+Every TPU touch happens in a CHILD process with its own os._exit
+watchdog (bench.py / tpu_pallas_check.py / tpu_probe.py already armor
+themselves); this orchestrator never imports jax. Between stages it
+re-probes and compares latency health against the FIRST green probe:
+the relay degrades before it dies (r4: compile 66->106 s, pull 349->747
+ms preceded the wedge), so rising numbers mean "stop launching now" and
+the orchestrator halts with whatever is already banked.
+
+Usage:
+  python tpu_round.py             # one probe; run stages if green
+  python tpu_round.py --wait      # probe every 15 min until green (<= 11 h)
+  python tpu_round.py --status    # print the status file and exit
+
+Status (machine-readable, updated after every transition):
+  TPU_ROUND_STATUS.json — {phase, probes, stages: {name: rc/summary}, halted_reason}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+STATUS_PATH = os.path.join(HERE, "TPU_ROUND_STATUS.json")
+PROBE_DEADLINE_S = 150.0
+WAIT_INTERVAL_S = 15 * 60
+# Absolute health ceilings (r4 data: healthy pull4mb ~350 ms, wedge-preceding
+# ~750 ms) and relative degradation vs the first green probe of this run.
+PULL4MB_MAX_MS = 1200.0
+ROUNDTRIP_MAX_MS = 1500.0
+DEGRADE_FACTOR = 2.5
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+class Status:
+    def __init__(self) -> None:
+        self.data = {
+            "started": _now(),
+            "phase": "init",
+            "probes": [],
+            "stages": {},
+            "halted_reason": None,
+        }
+        self.save()
+
+    def save(self) -> None:
+        self.data["updated"] = _now()
+        tmp = STATUS_PATH + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(self.data, fh, indent=1)
+        os.replace(tmp, STATUS_PATH)
+
+    def set(self, **kw) -> None:
+        self.data.update(kw)
+        self.save()
+
+
+def probe(status: Status) -> dict:
+    """One child probe; returns {rc, init_s?, roundtrip_ms?, pull4mb_ms?}."""
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(HERE, "tpu_probe.py"), str(PROBE_DEADLINE_S)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            cwd=HERE,
+            timeout=PROBE_DEADLINE_S + 30,
+        )
+        rc, out = proc.returncode, proc.stdout.decode(errors="replace")
+    except subprocess.TimeoutExpired:
+        rc, out = 99, "(parent backstop timeout)"
+    rec: dict = {"t": _now(), "rc": rc, "wall_s": round(time.monotonic() - t0, 1)}
+    m = re.search(r"init_s=([\d.]+)", out)
+    if m:
+        rec["init_s"] = float(m.group(1))
+    m = re.search(r"roundtrip_ms=([\d.]+) pull4mb_ms=([\d.]+)", out)
+    if m:
+        rec["roundtrip_ms"] = float(m.group(1))
+        rec["pull4mb_ms"] = float(m.group(2))
+    status.data["probes"].append(rec)
+    status.save()
+    print(f"# probe: {rec}", file=sys.stderr, flush=True)
+    return rec
+
+
+def health_ok(rec: dict, baseline: dict | None) -> str | None:
+    """None when healthy, else a halt reason string."""
+    if rec["rc"] != 0:
+        return f"probe rc={rec['rc']}"
+    rt, pull = rec.get("roundtrip_ms"), rec.get("pull4mb_ms")
+    if rt is None or pull is None:
+        return "probe green but no latency line"
+    if pull > PULL4MB_MAX_MS or rt > ROUNDTRIP_MAX_MS:
+        return f"latency over ceiling (roundtrip {rt} ms, pull4mb {pull} ms)"
+    if baseline is not None:
+        base_rt = baseline.get("roundtrip_ms")
+        base_pull = baseline.get("pull4mb_ms")
+        if base_pull and pull > DEGRADE_FACTOR * base_pull:
+            return f"pull degraded {base_pull} -> {pull} ms"
+        if base_rt and rt > DEGRADE_FACTOR * base_rt:
+            return f"roundtrip degraded {base_rt} -> {rt} ms"
+    return None
+
+
+def run_stage(status: Status, name: str, cmd: list[str], budget_s: float) -> int:
+    """Run one stage child, teeing output to TPU_ROUND_<name>.log."""
+    log_path = os.path.join(HERE, f"TPU_ROUND_{name}.log")
+    status.set(phase=f"stage:{name}")
+    t0 = time.monotonic()
+    with open(log_path, "w") as log:
+        try:
+            proc = subprocess.run(
+                cmd, stdout=log, stderr=subprocess.STDOUT, cwd=HERE,
+                timeout=budget_s,
+            )
+            rc = proc.returncode
+        except subprocess.TimeoutExpired:
+            # The stage children arm their own watchdogs well inside this
+            # backstop; hitting it means a child wedged mid-op — do not
+            # start anything else.
+            rc = -1
+    status.data["stages"][name] = {
+        "rc": rc,
+        "wall_s": round(time.monotonic() - t0, 1),
+        "log": os.path.basename(log_path),
+    }
+    status.save()
+    print(f"# stage {name}: rc={rc}", file=sys.stderr, flush=True)
+    return rc
+
+
+STAGES = [
+    # (name, cmd, budget_s) in strict priority order. bench.py is FIRST:
+    # its collapsed chained tier is the round's #1 deliverable and it banks
+    # BENCH_DETAIL.tpu.json clobber-proof. Budgets are parent backstops
+    # sized ~1.3x the children's own summed watchdog deadlines.
+    ("bench", [sys.executable, "bench.py"], 3600.0),
+    ("pallas", [sys.executable, "tpu_pallas_check.py", "--deadline", "600"], 1500.0),
+    (
+        "hier_ladder",
+        [
+            sys.executable, "bench.py", "--tier", "10485760", "--hier",
+            "--deadline", "600",
+        ],
+        800.0,
+    ),
+]
+
+
+def run_round(status: Status, wait: bool, max_wait_s: float) -> int:
+    waited = 0.0
+    status.set(phase="probing")
+    baseline = None
+    while True:
+        rec = probe(status)
+        reason = health_ok(rec, None)
+        if reason is None:
+            baseline = rec
+            break
+        if not wait or waited >= max_wait_s:
+            status.set(phase="no_window", halted_reason=reason)
+            print(f"# no healthy window: {reason}", file=sys.stderr)
+            return 2
+        status.set(phase=f"waiting ({reason})")
+        time.sleep(WAIT_INTERVAL_S)
+        waited += WAIT_INTERVAL_S
+
+    for i, (name, cmd, budget) in enumerate(STAGES):
+        rc = run_stage(status, name, cmd, budget)
+        if rc == -1:
+            status.set(phase="halted", halted_reason=f"stage {name} hit parent backstop")
+            return 3
+        if i == len(STAGES) - 1:
+            # No stage left to gate: a degraded post-run probe must not
+            # flip a fully banked round to "halted" (the signal means
+            # "don't launch MORE work", and there is none). Record health
+            # for the next orchestrator run, but finish as done.
+            rec = probe(status)
+            note = health_ok(rec, baseline)
+            status.set(
+                phase="done",
+                halted_reason=None,
+                final_probe_note=note,
+            )
+            if note is not None:
+                print(f"# done; post-run health note: {note}", file=sys.stderr)
+            return 0
+        rec = probe(status)
+        reason = health_ok(rec, baseline)
+        if reason is not None:
+            status.set(phase="halted", halted_reason=f"after {name}: {reason}")
+            print(f"# halting after {name}: {reason}", file=sys.stderr)
+            return 3
+    status.set(phase="done", halted_reason=None)
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--wait", action="store_true")
+    ap.add_argument("--max-wait-hours", type=float, default=11.0)
+    ap.add_argument("--status", action="store_true")
+    args = ap.parse_args()
+    if args.status:
+        try:
+            with open(STATUS_PATH) as fh:
+                print(fh.read())
+        except OSError:
+            print("{}")
+        return 0
+    status = Status()
+    return run_round(status, args.wait, args.max_wait_hours * 3600.0)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
