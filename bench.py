@@ -1211,7 +1211,7 @@ def _detail_platform(detail: dict) -> str:
     return "cpu"
 
 
-def _write_detail(detail: dict) -> None:
+def _write_detail(detail: dict, here: str | None = None) -> None:
     """Bank the sidecar clobber-proof.
 
     Hardware evidence is scarce (the relay can wedge for a whole round) so a
@@ -1221,7 +1221,8 @@ def _write_detail(detail: dict) -> None:
     or the existing file doesn't (r4 lost its working-tree TPU capture to
     exactly this overwrite).
     """
-    here = os.path.dirname(os.path.abspath(__file__))
+    if here is None:
+        here = os.path.dirname(os.path.abspath(__file__))
     plat = _detail_platform(detail)
     targets = [os.path.join(here, f"BENCH_DETAIL.{plat}.json")]
     legacy = os.path.join(here, "BENCH_DETAIL.json")
@@ -1268,38 +1269,19 @@ def _pin_orchestrator_to_cpu() -> None:
 def main() -> None:
     _pin_orchestrator_to_cpu()
     detail: dict = {}
-    baseline = sqlite_baseline_rate()
+    baseline = sqlite_baseline_rate()  # ~2 s; needed for every ratio below
     detail["sqlite_baseline_rate"] = round(baseline)
-    try:
-        detail["rpc_msgs_per_sec"] = rpc_throughput(baseline)
-    except Exception as e:
-        print(f"# rpc throughput failed: {e!r}", file=sys.stderr)
-    try:
-        detail["scaled_routing"] = scaled_route_hops()
-    except Exception as e:
-        print(f"# scaled routing failed: {e!r}", file=sys.stderr)
-    try:
-        detail["row2_jax_provider"] = row2_jax_provider_live()
-    except Exception as e:
-        print(f"# row-2 live measurement failed: {e!r}", file=sys.stderr)
-    try:
-        hops = live_route_hops()
-        detail["route_hops"] = hops
-        hop_str = (
-            f"measured p99 hops {hops['ours']['p99']:.0f} "
-            f"vs {hops['reference']['p99']:.0f}"
-        )
-    except Exception as e:
-        print(f"# live hop measurement failed: {e!r}", file=sys.stderr)
-        hops, hop_str = None, "hops unmeasured"
 
     result = None
     collapsed = None
     tpu_down = False
-    # The collapsed-rebalance tier is the HEADLINE (the directory's
-    # committed fast path, BASELINE row 3's <50 ms class) and the cheapest
-    # device tier — run it first so it is banked before the heavy dense
-    # tiers can burn the relay window.
+    # TPU FIRST (r5): a healthy relay window is the scarcest resource in
+    # the whole bench — it can degrade to a wedge in minutes (r4) — so
+    # every device tier runs before the ~10 min of host-side stages (rpc,
+    # routing, live clusters), not after. Within the device tiers, the
+    # collapsed-rebalance tier is the HEADLINE (the directory's committed
+    # fast path, BASELINE row 3's <50 ms class) and the cheapest — it goes
+    # first so it is banked before the heavy dense tiers.
     rc, collapsed = _run_child(1_048_576, "tpu", 480.0, collapsed=True)
     if collapsed:
         detail["collapsed_tier"] = collapsed
@@ -1330,6 +1312,37 @@ def main() -> None:
         if hier:
             detail["baseline_row5_hier"] = hier
             print(f"# row-5 hier tier: {hier}", file=sys.stderr)
+    # Device tiers are done — bank them NOW, before the host-side stages
+    # (a crash in a live-cluster stage must not cost banked TPU evidence).
+    detail["solve_tier"] = result
+    if collapsed is not None or result is not None:
+        _write_detail(detail)
+
+    # Host-side stages (in-process live clusters; the orchestrator is
+    # CPU-pinned so none of these can touch the relay).
+    try:
+        detail["rpc_msgs_per_sec"] = rpc_throughput(baseline)
+    except Exception as e:
+        print(f"# rpc throughput failed: {e!r}", file=sys.stderr)
+    try:
+        detail["scaled_routing"] = scaled_route_hops()
+    except Exception as e:
+        print(f"# scaled routing failed: {e!r}", file=sys.stderr)
+    try:
+        detail["row2_jax_provider"] = row2_jax_provider_live()
+    except Exception as e:
+        print(f"# row-2 live measurement failed: {e!r}", file=sys.stderr)
+    try:
+        hops = live_route_hops()
+        detail["route_hops"] = hops
+        hop_str = (
+            f"measured p99 hops {hops['ours']['p99']:.0f} "
+            f"vs {hops['reference']['p99']:.0f}"
+        )
+    except Exception as e:
+        print(f"# live hop measurement failed: {e!r}", file=sys.stderr)
+        hops, hop_str = None, "hops unmeasured"
+
     if result is None:
         rc, parsed = _run_child(131_072, "cpu", 300.0)
         if parsed:
