@@ -887,7 +887,7 @@ def _hier_rate(
     }
 
 
-def run_hier_tier(n_obj: int, deadline: float) -> None:
+def run_hier_tier(n_obj: int, deadline: float, platform: str = "tpu") -> None:
     """Child entry for the BASELINE row-5 (hierarchical) tier.
 
     Adaptive sizing against the relay-wedge hazard: measure a quarter-size
@@ -895,10 +895,21 @@ def run_hier_tier(n_obj: int, deadline: float) -> None:
     — shapes differ, nothing is cached), and only attempt the full size
     when it fits well inside the deadline. Whatever completed last is the
     reported tier.
+
+    ``platform="cpu"`` is the REHEARSAL mode (pins the CPU backend before
+    any jax init, like the pallas debug mode): the ladder's projection /
+    banking / chain-gate logic has historically failed exactly when a
+    healthy window finally opened (r4: the first rung's compile blew the
+    deadline and the watchdog exit left no evidence), so it must be
+    executable end-to-end without hardware.
     """
     start = time.monotonic()
     _arm_watchdog(deadline, EXIT_WATCHDOG)
     probe_timer = _arm_watchdog(min(PROBE_DEADLINE_S, deadline), EXIT_INIT_FAIL)
+    if platform == "cpu":
+        from rio_tpu.utils.jaxenv import force_cpu
+
+        force_cpu()
     import jax
 
     try:
@@ -907,7 +918,7 @@ def run_hier_tier(n_obj: int, deadline: float) -> None:
         print(f"# backend init failed: {type(e).__name__}: {e}", file=sys.stderr)
         sys.exit(EXIT_INIT_FAIL)
     probe_timer.cancel()
-    if devices[0].platform != "tpu":
+    if platform == "tpu" and devices[0].platform != "tpu":
         sys.exit(EXIT_INIT_FAIL)
     try:
         # Ladder of sizes, each banked before the next is attempted: the r4
@@ -1456,7 +1467,7 @@ if __name__ == "__main__":
     parser.add_argument("--collapsed", action="store_true")
     args = parser.parse_args()
     if args.tier is not None and args.hier:
-        run_hier_tier(args.tier, args.deadline)
+        run_hier_tier(args.tier, args.deadline, args.platform)
     elif args.tier is not None and args.collapsed:
         run_collapsed_tier(args.tier, args.platform, args.deadline)
     elif args.tier is not None:
