@@ -41,6 +41,12 @@ def __getattr__(name):
         from . import hierarchical
 
         return getattr(hierarchical, name)
+    if name == "multihost":
+        # importlib, not `from . import`: the from-import re-enters this
+        # __getattr__ while the attribute is still unset (RecursionError).
+        import importlib
+
+        return importlib.import_module(".multihost", __name__)
     raise AttributeError(name)
 
 

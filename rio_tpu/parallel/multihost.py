@@ -1,0 +1,146 @@
+"""Multi-host bring-up for the solver plane (SPMD over ICI + DCN).
+
+The reference's cross-host scaling story is tokio TCP + SQL rendezvous for
+the control plane and nothing for compute (``rio-rs/src/service.rs:370-378``
+is its transport ceiling). rio-tpu's compute plane scales the TPU way
+instead: every host runs the SAME program, :func:`initialize` wires the
+hosts into one multi-controller jax runtime, and the mesh/shard_map code in
+:mod:`rio_tpu.parallel` then spans all hosts unchanged — ``jax.devices()``
+becomes the global device set, XLA routes the Sinkhorn ``psum``/``pmax``
+collectives over ICI within a slice and DCN across slices, and no solver
+code differs between 1 and N hosts. (This replaces what NCCL/MPI init +
+communicator plumbing does for the reference stack's GPU cousins.)
+
+Per-host data feeding: each host holds only its own objects (its servers'
+directory shard). :func:`distributed_array` assembles the global sharded
+array from per-host shards without ever materializing the global array on
+any one host — the multi-host analog of ``jax.device_put``.
+
+Bring-up recipe (one process per host, e.g. under a process manager or the
+TPU pod runtime):
+
+    from rio_tpu.parallel import make_mesh, multihost
+
+    multihost.initialize()          # env-driven on TPU pods; explicit
+                                    # coordinator args elsewhere
+    mesh = make_mesh()              # spans ALL hosts' devices
+    obj_feat = multihost.distributed_array(
+        mesh, P("obj", None), local_obj_feat)   # this host's rows only
+    res = sharded_hierarchical_assign(mesh, obj_feat, ...)
+
+Single-process (tests, one chip, CPU mesh) every function degrades to the
+local equivalent, so the same program text runs everywhere.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+log = logging.getLogger("rio_tpu.parallel.multihost")
+
+__all__ = ["initialize", "is_multihost", "distributed_array", "process_rows"]
+
+
+def _already_initialized() -> bool:
+    """Whether jax.distributed.initialize has run, WITHOUT initializing
+    the backend (the public probes all do)."""
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client is not None
+    except Exception:  # internal layout changed; assume not initialized
+        return False
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    local_device_ids=None,
+) -> bool:
+    """Idempotent :func:`jax.distributed.initialize` wrapper.
+
+    With no arguments, jax reads the cluster environment (TPU pod runtime,
+    SLURM, etc.); pass explicit coordinator args everywhere else. Safe to
+    call unconditionally at server startup:
+
+    * already initialized -> no-op;
+    * single-process with no cluster env and no args -> no-op (jax would
+      otherwise raise on the missing coordinator);
+    * returns True iff the runtime is multi-process afterwards.
+
+    NOTE this function must not touch the jax backend before calling
+    ``jax.distributed.initialize`` — even ``jax.process_count()``
+    initializes the single-process backend and silently breaks the
+    multi-controller bring-up — hence the internal-state probe.
+    """
+    if _already_initialized():
+        return jax.process_count() > 1
+    explicit = coordinator_address is not None
+    cluster_env = any(
+        os.environ.get(k)
+        for k in (
+            "JAX_COORDINATOR_ADDRESS",
+            "COORDINATOR_ADDRESS",
+            "TPU_WORKER_HOSTNAMES",
+            "SLURM_JOB_ID",
+        )
+    )
+    if not explicit and not cluster_env:
+        log.debug("no coordinator configured; staying single-process")
+        return False
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        )
+    except RuntimeError as e:
+        # Double-initialize (e.g. two Servers in one process) is benign.
+        if "already" not in str(e).lower():
+            raise
+    return jax.process_count() > 1
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
+def process_rows(n_global: int, mesh: Mesh, axis: str = "obj") -> slice:
+    """The global row range this PROCESS must supply for an ``axis``-sharded
+    array of ``n_global`` rows (rows are laid out in mesh-axis order, the
+    same order :func:`distributed_array` assembles them).
+    """
+    axis_size = mesh.shape[axis]
+    per_shard, rem = divmod(n_global, axis_size)
+    assert rem == 0, (n_global, axis_size)
+    # Which shard indices along `axis` live on this process's devices?
+    axis_pos = list(mesh.axis_names).index(axis)
+    local = set()
+    import numpy as np
+
+    dev_grid = np.asarray(mesh.devices)
+    for idx in np.ndindex(dev_grid.shape):
+        if dev_grid[idx].process_index == jax.process_index():
+            local.add(idx[axis_pos])
+    lo, hi = min(local), max(local)
+    assert local == set(range(lo, hi + 1)), "non-contiguous process shards"
+    return slice(lo * per_shard, (hi + 1) * per_shard)
+
+
+def distributed_array(mesh: Mesh, spec: P, local_data) -> jax.Array:
+    """Assemble a globally-sharded array from per-process local shards.
+
+    ``local_data`` is this process's slice (see :func:`process_rows`);
+    no host ever materializes the global array. Single-process this is
+    exactly ``jax.device_put(local_data, NamedSharding(mesh, spec))``.
+    """
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(local_data, sharding)
+    return jax.make_array_from_process_local_data(sharding, local_data)

@@ -1,0 +1,138 @@
+"""Multi-host bring-up helpers, single-process degradation contract.
+
+A real multi-controller run needs N processes (impossible in this image);
+what IS testable — and what the bring-up recipe relies on — is that every
+helper degrades to the exact local equivalent in one process, so the same
+program text runs on a laptop, one chip, and a pod.
+"""
+
+import os
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from rio_tpu.parallel import make_mesh
+from rio_tpu.parallel import multihost
+
+
+def test_initialize_is_noop_without_coordinator(monkeypatch):
+    for k in (
+        "JAX_COORDINATOR_ADDRESS",
+        "COORDINATOR_ADDRESS",
+        "TPU_WORKER_HOSTNAMES",
+        "SLURM_JOB_ID",
+    ):
+        monkeypatch.delenv(k, raising=False)
+    assert multihost.initialize() is False
+    assert multihost.is_multihost() is False
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_process_rows_covers_everything_single_process():
+    mesh = make_mesh(jax.devices()[:8])
+    n = 64 * mesh.shape["obj"]
+    rows = multihost.process_rows(n, mesh)
+    # One process owns every shard.
+    assert (rows.start, rows.stop) == (0, n)
+
+
+def test_two_process_multicontroller_solve_parity(tmp_path):
+    """REAL multi-controller run: two OS processes, 2 CPU devices each,
+    joined by jax.distributed over loopback (gloo — the DCN analog), one
+    4-device mesh spanning both. Each process feeds only ITS object rows
+    via distributed_array; the sharded hierarchical solve runs with real
+    cross-process collectives; the gathered global assignment must EQUAL
+    the single-process per-shard reference (the same mechanism-parity
+    standard as the dryrun) and avoid the dead node."""
+    import socket
+    import subprocess
+    import sys as _sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    repo = str(Path(__file__).resolve().parent.parent)
+    child = str(Path(__file__).resolve().parent / "multihost_child.py")
+    env = {
+        # A clean env: the ambient axon sitecustomize must not leak into
+        # the children (it would re-register the TPU plugin; a wedged
+        # relay then hangs the solve). The child pins its own platform.
+        "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+        "HOME": os.environ.get("HOME", "/tmp"),
+        "PYTHONPATH": repo,
+    }
+    procs = [
+        subprocess.Popen(
+            [_sys.executable, child, str(pid), "2", str(port), str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out.decode(errors="replace"))
+    finally:
+        for p in procs:
+            p.kill()
+    assert all(p.returncode == 0 for p in procs), outs
+    a = np.load(tmp_path / "assignment.npy")
+    overflow, n_shards = np.load(tmp_path / "meta.npy").tolist()
+    assert a.shape == (256,) and overflow == 0 and n_shards == 4
+    assert not (a == 3).any(), "dead node attracted objects"
+    # Mechanism parity: the cross-process solve must equal the concat of
+    # per-shard local solves on identical inputs (shard-local by design).
+    import jax.numpy as jnp
+
+    from rio_tpu.parallel.hierarchical import hierarchical_assign
+
+    key = jax.random.PRNGKey(3)
+    k1, k2 = jax.random.split(key)
+    obj_all = np.asarray(jax.random.normal(k1, (256, 8), jnp.float32))
+    node_feat = np.asarray(jax.random.normal(k2, (8, 16), jnp.float32)) * 0.2
+    cap = jnp.ones((16,), jnp.float32)
+    alive = jnp.ones((16,), jnp.float32).at[3].set(0.0)
+    shard = 256 // n_shards
+    ref = np.concatenate(
+        [
+            np.asarray(
+                hierarchical_assign(
+                    obj_all[k * shard : (k + 1) * shard], node_feat, cap,
+                    alive, n_groups=4, coarse_iters=8, fine_iters=8,
+                ).assignment
+            )
+            for k in range(n_shards)
+        ]
+    )
+    flips = float(np.mean(a != ref))
+    assert flips <= 0.01, f"cross-process solve diverges on {flips:.1%} of rows"
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_distributed_array_matches_device_put_and_feeds_solver():
+    mesh = make_mesh(jax.devices()[:8])
+    n_obj = 64 * mesh.shape["obj"]
+    rows = multihost.process_rows(n_obj, mesh)
+    local = np.arange(n_obj * 4, dtype=np.float32).reshape(n_obj, 4)[rows]
+    arr = multihost.distributed_array(mesh, P("obj", None), local)
+    assert arr.shape == (n_obj, 4)
+    np.testing.assert_array_equal(np.asarray(arr), local)
+    # And it is genuinely sharded input for the mesh solvers.
+    from rio_tpu.parallel.hierarchical import sharded_hierarchical_assign
+
+    d, m, g = 4, 16, 4
+    node_feat = jnp.ones((d, m), jnp.float32) * 0.1
+    cap = jnp.ones((m,), jnp.float32)
+    alive = jnp.ones((m,), jnp.float32)
+    res = sharded_hierarchical_assign(
+        mesh, arr, node_feat, cap, alive, n_groups=g,
+        coarse_iters=4, fine_iters=4,
+    )
+    a = np.asarray(res.assignment)
+    assert a.shape == (n_obj,)
+    assert a.min() >= 0 and a.max() < m
