@@ -19,7 +19,10 @@ from rio_tpu.parallel import make_mesh
 from rio_tpu.parallel import multihost
 
 
-def test_initialize_is_noop_without_coordinator(monkeypatch):
+def test_initialize_is_noop_with_backend_already_up(monkeypatch):
+    """In a long-lived single process (this test runner: conftest booted
+    the backend long ago), an env-driven initialize() stays single-process
+    via the RuntimeError 'before' branch instead of raising."""
     for k in (
         "JAX_COORDINATOR_ADDRESS",
         "COORDINATOR_ADDRESS",
@@ -29,6 +32,22 @@ def test_initialize_is_noop_without_coordinator(monkeypatch):
         monkeypatch.delenv(k, raising=False)
     assert multihost.initialize() is False
     assert multihost.is_multihost() is False
+
+
+def test_initialize_treats_no_cluster_valueerror_as_single_process(monkeypatch):
+    """Fresh-process path: jax's cluster auto-detection raising its
+    'coordinator_address should be defined' ValueError means "no cluster",
+    not an error — pin the message-match against jax upgrades."""
+    monkeypatch.setattr(multihost, "_already_initialized", lambda: False)
+
+    def fake_initialize(**kw):
+        raise ValueError("coordinator_address should be defined.")
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_initialize)
+    assert multihost.initialize() is False
+    # An explicit coordinator with the same failure is a REAL error.
+    with pytest.raises(ValueError):
+        multihost.initialize("127.0.0.1:1", num_processes=2, process_id=0)
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
@@ -109,8 +128,9 @@ def test_two_process_multicontroller_solve_parity(tmp_path):
             for k in range(n_shards)
         ]
     )
-    flips = float(np.mean(a != ref))
-    assert flips <= 0.01, f"cross-process solve diverges on {flips:.1%} of rows"
+    # EXACT equality (same numerics on the CPU children): the docs claim
+    # exact mechanism parity, so the test must hold exactly that.
+    np.testing.assert_array_equal(a, ref)
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
