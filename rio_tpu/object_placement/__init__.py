@@ -56,6 +56,14 @@ class ObjectPlacement(abc.ABC):
         for item in items:
             await self.update(item)
 
+    async def items(self) -> list[ObjectPlacementItem]:
+        """Every directory row (optional trait method, like the state
+        loaders' optional surface): required of a provider used as the
+        durable BACKING store behind
+        :class:`~rio_tpu.object_placement.persistent.PersistentJaxObjectPlacement`,
+        whose warm restart reloads the whole directory."""
+        raise NotImplementedError(f"{type(self).__name__} cannot enumerate")
+
 
 class LocalObjectPlacement(ObjectPlacement):
     """In-memory directory; clones alias the same dict.
@@ -84,6 +92,12 @@ class LocalObjectPlacement(ObjectPlacement):
 
     async def remove(self, object_id: ObjectId) -> None:
         self._placements.pop(str(object_id), None)
+
+    async def items(self) -> list[ObjectPlacementItem]:
+        return [
+            ObjectPlacementItem(ObjectId(*k.split(".", 1)), v)
+            for k, v in self._placements.items()
+        ]
 
     def count(self) -> int:
         return len(self._placements)

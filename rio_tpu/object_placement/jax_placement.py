@@ -461,8 +461,11 @@ class JaxObjectPlacement(ObjectPlacement):
             slot.load = 0.0  # its placements are gone; keep fair-share math honest
             # O(objects-on-node) via the per-node index — a full-directory
             # scan here would be a multi-second GIL stall at the 10M tier.
-            for k in self._by_node.pop(slot.index, set()):
-                self._placements.pop(k, None)
+            # Dropped through _drop_placement (the single mirror-mutation
+            # seam) so subclasses tracking writes see every key.
+            for k in list(self._by_node.get(slot.index, ())):
+                self._drop_placement(k)
+            self._by_node.pop(slot.index, None)
             self._epoch += 1
             self._g = None
 

@@ -94,5 +94,24 @@ class RedisObjectPlacement(ObjectPlacement):
         )
         return [r.decode() if isinstance(r, bytes) else None for r in raws]
 
+    async def items(self) -> list[ObjectPlacementItem]:
+        """Enumerate via KEYS on the placement prefix + one pipelined MGET
+        pass. KEYS is O(keyspace) and blocking — acceptable for the warm
+        RESTART path this exists for (PersistentJaxObjectPlacement.prepare
+        runs once, before traffic), not for request-path use."""
+        prefix = self._obj_key("")
+        raw_keys = await self.client.execute("KEYS", prefix + "*")
+        keys = [k.decode()[len(prefix):] for k in raw_keys or []]
+        if not keys:
+            return []
+        raws = await self.client.execute_pipeline(
+            [("GET", self._obj_key(k)) for k in keys]
+        )
+        return [
+            ObjectPlacementItem(ObjectId(*k.split(".", 1)), r.decode())
+            for k, r in zip(keys, raws)
+            if isinstance(r, bytes)
+        ]
+
     def close(self) -> None:
         self.client.close()

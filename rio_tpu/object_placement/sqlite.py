@@ -60,5 +60,14 @@ class SqliteObjectPlacement(ObjectPlacement):
             object_id.type_name, object_id.id,
         )
 
+    async def items(self) -> list[ObjectPlacementItem]:
+        rows = await self.db.execute(
+            "SELECT struct_name, object_id, server_address "
+            "FROM object_placement WHERE server_address IS NOT NULL"
+        )
+        return [
+            ObjectPlacementItem(ObjectId(t, i), addr) for t, i, addr in rows
+        ]
+
     def close(self) -> None:
         self.db.close()

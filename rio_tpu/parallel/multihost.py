@@ -92,9 +92,16 @@ def initialize(
         # — which IS the single-process answer, not an error. (Env-var
         # sniffing is not a substitute: e.g. this image's sitecustomize
         # exports TPU_WORKER_HOSTNAMES=localhost without any cluster.)
-        if coordinator_address is None and "coordinator_address" in str(e):
+        if (
+            coordinator_address is None
+            and num_processes is None
+            and process_id is None
+            and "coordinator_address" in str(e)
+        ):
             log.debug("no cluster detected; staying single-process")
             return False
+        # Any explicit multi-process intent (world size / rank given but
+        # the coordinator missing) must fail loudly, not downgrade.
         raise
     except RuntimeError as e:
         msg = str(e).lower()

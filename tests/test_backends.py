@@ -108,6 +108,15 @@ async def check_placement(p: ObjectPlacement):
     ids = [ObjectId("Svc", f"x{i}") for i in range(5)]
     await p.update_batch([ObjectPlacementItem(i, "h9:9") for i in ids])
     assert await p.lookup_batch(ids) == ["h9:9"] * 5
+    # enumeration (the persistent-bridge restore hook); ids may contain
+    # dots — the key form splits on the FIRST dot only
+    await p.update(ObjectPlacementItem(ObjectId("Svc", "dotted.id.0"), "h4:4"))
+    rows = {str(i.object_id): i.server_address for i in await p.items()}
+    assert rows[str(ids[0])] == "h9:9"
+    assert rows["Svc.dotted.id.0"] == "h4:4"
+    assert len(rows) == 6  # 5 batch rows + the dotted one
+    restored = {(i.object_id.type_name, i.object_id.id) for i in await p.items()}
+    assert ("Svc", "dotted.id.0") in restored
 
 
 @pytest.mark.asyncio

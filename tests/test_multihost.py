@@ -45,9 +45,13 @@ def test_initialize_treats_no_cluster_valueerror_as_single_process(monkeypatch):
 
     monkeypatch.setattr(jax.distributed, "initialize", fake_initialize)
     assert multihost.initialize() is False
-    # An explicit coordinator with the same failure is a REAL error.
+    # Any EXPLICIT multi-process intent with the same failure is a real
+    # error — a launcher passing world size but missing the coordinator
+    # must not silently run as 1 of 1.
     with pytest.raises(ValueError):
         multihost.initialize("127.0.0.1:1", num_processes=2, process_id=0)
+    with pytest.raises(ValueError):
+        multihost.initialize(None, num_processes=2, process_id=0)
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
