@@ -1,0 +1,113 @@
+"""Cluster restart on a persistent directory, end to end.
+
+The full migration story for a rio-rs SqliteObjectPlacement user: run a
+live cluster on PersistentJaxObjectPlacement over SQLite, stop it, boot a
+FRESH cluster (new ephemeral addresses) on the same database. The restored
+directory initially points every object at ghost addresses — the restart
+UX contract is:
+
+* the restored population is visible immediately (no empty directory);
+* ghost nodes never capture NEW allocations (restore quarantine);
+* traffic to restored objects recovers via the reactive re-seat path
+  (dead-owner detection -> clean -> re-allocate), exactly the machinery
+  that covers node death in steady state.
+"""
+
+import asyncio
+
+from rio_tpu import AppData, ObjectId, Registry, ServiceObject, handler, message
+from rio_tpu.commands import ServerInfo
+from rio_tpu.object_placement.persistent import PersistentJaxObjectPlacement
+from rio_tpu.object_placement.sqlite import SqliteObjectPlacement
+
+from .server_utils import Cluster, run_integration_test
+
+N_OBJECTS = 40
+
+
+@message
+class Poke:
+    pass
+
+
+@message
+class Where:
+    address: str = ""
+
+
+class Pin(ServiceObject):
+    @handler
+    async def poke(self, msg: Poke, ctx: AppData) -> Where:
+        return Where(address=ctx.get(ServerInfo).address)
+
+
+def build_registry() -> Registry:
+    return Registry().add_type(Pin)
+
+
+def _placement(db_path):
+    return PersistentJaxObjectPlacement(
+        SqliteObjectPlacement(str(db_path)), mode="greedy", flush_interval=0.01
+    )
+
+
+def test_cluster_restart_restores_and_reseats(tmp_path):
+    db = tmp_path / "directory.db"
+    placement1 = _placement(db)
+
+    async def first_life(cluster: Cluster):
+        client = cluster.client()
+        try:
+            for i in range(N_OBJECTS):
+                out = await client.send(Pin, f"o{i}", Poke(), returns=Where)
+                assert out.address in cluster.addresses
+            assert placement1.count() == N_OBJECTS
+            await placement1.flush()
+            backing_rows = await placement1._backing.items()
+            assert len(backing_rows) == N_OBJECTS
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(
+            first_life,
+            registry_builder=build_registry,
+            num_servers=3,
+            placement=placement1,
+        )
+    )
+
+    placement2 = _placement(db)
+
+    async def second_life(cluster: Cluster):
+        # Server.prepare() ran the warm restore: the directory is full and
+        # every restored seat is a ghost (first life's ephemeral ports).
+        assert placement2.count() == N_OBJECTS
+        ghosts = set()
+        for i in range(N_OBJECTS):
+            addr = await placement2.lookup(ObjectId("Pin", f"o{i}"))
+            assert addr is not None
+            ghosts.add(addr)
+        assert ghosts.isdisjoint(set(cluster.addresses))
+
+        client = cluster.client()
+        try:
+            # Traffic recovers every restored object onto a live node.
+            for i in range(N_OBJECTS):
+                out = await client.send(Pin, f"o{i}", Poke(), returns=Where)
+                assert out.address in cluster.addresses, f"o{i} -> {out.address}"
+            # And NEW allocations never land on a ghost.
+            for i in range(10):
+                out = await client.send(Pin, f"new{i}", Poke(), returns=Where)
+                assert out.address in cluster.addresses
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(
+            second_life,
+            registry_builder=build_registry,
+            num_servers=3,
+            placement=placement2,
+        )
+    )
