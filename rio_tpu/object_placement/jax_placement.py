@@ -209,6 +209,7 @@ class _NodeSlot:
     address: str
     capacity: float = 1.0
     alive: bool = True
+    cordoned: bool = False  # drained: serving, but priced out of the solver
     load: float = 0.0
     index: int = 0
 
@@ -418,6 +419,50 @@ class JaxObjectPlacement(ObjectPlacement):
             self._epoch += 1
             self._g = None  # potentials are stale once liveness changes
 
+    # --------------------------------------------------------------- drain
+    def cordon(self, address: str) -> None:
+        """Drain a node gracefully (the kubectl-cordon analog; no reference
+        counterpart — its only exit is death + lazy re-allocation).
+
+        The node keeps serving its current objects, but the solver prices
+        it like a dead node: no NEW allocations land there, and the next
+        ``rebalance()`` re-seats its population onto the remaining nodes —
+        moving exactly that share, per the stay-put discount. Then stop the
+        server with nothing displaced. Loop-side and lock-free, like
+        ``sync_members`` (the snapshot-solve-apply discipline covers it).
+        """
+        slot = self._nodes.get(address)
+        if slot is None:
+            raise KeyError(f"unknown node {address!r}")
+        if slot.cordoned:
+            return
+        others = any(
+            s.alive and not s.cordoned and s.capacity > 0
+            for a, s in self._nodes.items()
+            if a != address
+        )
+        if not others:
+            raise RuntimeError(
+                f"refusing to cordon {address!r}: no other schedulable "
+                f"node would remain"
+            )
+        slot.cordoned = True
+        self._epoch += 1
+        self._g = None
+
+    def uncordon(self, address: str) -> None:
+        slot = self._nodes.get(address)
+        if slot is None:
+            raise KeyError(f"unknown node {address!r}")
+        if slot.cordoned:
+            slot.cordoned = False
+            self._epoch += 1
+            self._g = None
+
+    @property
+    def cordoned(self) -> set[str]:
+        return {a for a, s in self._nodes.items() if s.cordoned}
+
     # ------------------------------------------------------- device vectors
     def _node_vectors(self) -> tuple[jax.Array, jax.Array, jax.Array]:
         n = self._node_axis
@@ -428,7 +473,10 @@ class JaxObjectPlacement(ObjectPlacement):
             s = self._nodes[addr]
             load[s.index] = s.load
             cap[s.index] = s.capacity
-            alive[s.index] = 1.0 if s.alive else 0.0
+            # Cordoned nodes price exactly like dead ones (no NEW seats; a
+            # rebalance drains them) — but their directory rows stand and
+            # they keep serving until the operator stops them.
+            alive[s.index] = 1.0 if (s.alive and not s.cordoned) else 0.0
         return jnp.asarray(load), jnp.asarray(cap), jnp.asarray(alive)
 
     def _recount_loads(self) -> None:

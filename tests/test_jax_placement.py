@@ -579,6 +579,53 @@ async def test_assign_batch_releases_lock_between_chunks():
     assert all(w is not None for w in looked)
 
 
+async def test_cordon_drains_node_gracefully():
+    """kubectl-cordon analog: a cordoned node takes no NEW seats, a
+    rebalance re-seats exactly ~its population (not a global reshuffle),
+    and uncordon makes it schedulable again."""
+    import asyncio  # noqa: F401  (parity with sibling tests)
+
+    p = JaxObjectPlacement(mode="greedy", move_cost=0.5)
+    nodes = [f"10.6.0.{i}:70" for i in range(4)]
+    p.sync_members(nodes)
+    ids = [ObjectId("D", str(i)) for i in range(400)]
+    await p.assign_batch(ids)
+    victim = await p.lookup(ids[0])
+    on_victim = sum(1 for w in await p.lookup_batch(ids) if w == victim)
+    assert on_victim > 0
+
+    p.cordon(victim)
+    assert p.cordoned == {victim}
+    # New allocations avoid it...
+    where_new = await p.assign_batch([ObjectId("D", f"n{i}") for i in range(60)])
+    assert victim not in where_new
+    # ...its existing rows still resolve (it keeps serving)...
+    assert await p.lookup(ids[0]) == victim
+    # ...and a rebalance drains it, moving ~only its population.
+    moved = await p.rebalance()
+    where = await p.lookup_batch(ids)
+    assert victim not in where
+    assert moved <= on_victim + 460 // 3, (moved, on_victim)
+
+    p.uncordon(victim)
+    refill = await p.assign_batch([ObjectId("D", f"m{i}") for i in range(200)])
+    assert victim in refill  # the drained node is schedulable (and emptiest)
+
+
+async def test_cordon_refuses_last_schedulable_node():
+    p = JaxObjectPlacement(mode="greedy")
+    p.sync_members(["10.6.1.0:70", "10.6.1.1:70"])
+    p.cordon("10.6.1.0:70")
+    import pytest
+
+    with pytest.raises(RuntimeError):
+        p.cordon("10.6.1.1:70")
+    with pytest.raises(KeyError):
+        p.cordon("10.6.9.9:70")
+    p.uncordon("10.6.1.0:70")
+    assert p.cordoned == set()
+
+
 async def test_solve_stats_history_records_prior_solves():
     placement = JaxObjectPlacement(mode="greedy")
     placement.sync_members([f"10.2.0.{i}:80" for i in range(4)])
