@@ -133,6 +133,64 @@ def test_daemon_reseats_displaced_objects_without_app_solver_calls():
     )
 
 
+def test_drain_flow_on_live_cluster():
+    """The ops drain story end to end: cordon -> rebalance re-seats exactly
+    the drained node's population -> traffic lands on survivors -> stop
+    the server with nothing displaced (vs the reference's only exit:
+    death + lazy re-allocation)."""
+    placement = JaxObjectPlacement(mode="greedy", move_cost=0.5)
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        try:
+            for i in range(90):
+                await client.send(Pin, f"o{i}", Poke(), returns=Where)
+
+            async def seat(k):
+                return await cluster.allocation_address("Pin", k)
+
+            seats = {f"o{i}": await seat(f"o{i}") for i in range(90)}
+            victim = max(
+                cluster.addresses,
+                key=lambda a: sum(1 for v in seats.values() if v == a),
+            )
+            on_victim = [k for k, v in seats.items() if v == victim]
+
+            placement.cordon(victim)
+            moved = await placement.rebalance()
+            # ~Exactly the drained population moves (stay-put discount;
+            # +small slack for integer-quota repair ties).
+            assert on_victim and len(on_victim) <= moved <= len(on_victim) + 5, (
+                moved, len(on_victim),
+            )
+            for k in on_victim:
+                assert await seat(k) != victim
+            for k in on_victim[:10]:
+                out = await client.send(Pin, k, Poke(), returns=Where)
+                assert out.address != victim
+
+            # Stopping the drained server displaces nothing.
+            next(
+                s for s in cluster.servers if s.local_address == victim
+            ).admin_sender().send(AdminCommand.server_exit())
+            await asyncio.sleep(0.3)
+            for k in on_victim[:10]:
+                out = await client.send(Pin, k, Poke(), returns=Where)
+                assert out.address != victim
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_registry,
+            num_servers=3,
+            placement=placement,
+            timeout=60.0,
+        )
+    )
+
+
 def test_daemon_noop_for_plain_providers():
     """Enabling the daemon with a CRUD-only provider must be harmless."""
 

@@ -1,0 +1,126 @@
+"""Randomized soak over the placement provider's FULL op space.
+
+Hunts cross-op races and invariant breaks that scenario tests can miss:
+a seeded scheduler interleaves concurrent assign_batch / update / remove /
+clean_server / sync_members churn / cordon / rebalance / lookups against
+one provider, checking global invariants between waves. The default run is
+a quick regression (6 waves); set RIO_TPU_SOAK_WAVES for a long hunt.
+
+Invariants after every wave (quiesced):
+  1. every seated object resolves to a REGISTERED node address;
+  2. no object sits on a node that was dead AND cordon-free at wave end
+     while a schedulable node existed (rebalance ran last);
+  3. the per-node key index matches the forward map exactly;
+  4. count() == len(directory) and lookup_batch agrees with lookup.
+"""
+
+import asyncio
+import os
+import random
+
+from rio_tpu import ObjectId, ObjectPlacementItem
+from rio_tpu.object_placement.jax_placement import JaxObjectPlacement
+
+WAVES = int(os.environ.get("RIO_TPU_SOAK_WAVES", "6"))
+OPS_PER_WAVE = 40
+
+
+def _check_invariants(p: JaxObjectPlacement) -> None:
+    # 3. index consistency (both directions).
+    for key, idx in p._placements.items():
+        assert key in p._by_node.get(idx, set()), (key, idx)
+    for idx, keys in p._by_node.items():
+        for key in keys:
+            assert p._placements.get(key) == idx, (key, idx)
+    # 1. every seat is a known node.
+    for key, idx in p._placements.items():
+        assert 0 <= idx < len(p._node_order), (key, idx)
+    # 4. count/lookup coherence.
+    assert p.count() == len(p._placements)
+
+
+async def _soak(seed: int) -> None:
+    rng = random.Random(seed)
+    p = JaxObjectPlacement(mode="greedy", move_cost=0.5)
+    base = [f"10.8.{seed}.{i}:70" for i in range(8)]
+    p.sync_members(base)
+    population = 0
+
+    async def op_assign():
+        nonlocal population
+        n = rng.randint(1, 200)
+        ids = [ObjectId("S", f"{seed}-{population + i}") for i in range(n)]
+        population += n
+        await p.assign_batch(ids)
+
+    async def op_update():
+        if not p._placements:
+            return
+        key = rng.choice(list(p._placements))
+        t, i = key.split(".", 1)
+        await p.update(
+            ObjectPlacementItem(ObjectId(t, i), rng.choice(base))
+        )
+
+    async def op_remove():
+        if not p._placements:
+            return
+        key = rng.choice(list(p._placements))
+        t, i = key.split(".", 1)
+        await p.remove(ObjectId(t, i))
+
+    async def op_clean():
+        await p.clean_server(rng.choice(base))
+
+    async def op_churn():
+        alive = [a for a in base if rng.random() > 0.25] or base[:1]
+        p.sync_members(alive)
+
+    async def op_cordon():
+        addr = rng.choice(base)
+        try:
+            if rng.random() < 0.5:
+                p.cordon(addr)
+            else:
+                p.uncordon(addr)
+        except (RuntimeError, KeyError):
+            pass  # last-schedulable guard / unknown node: expected
+
+    async def op_rebalance():
+        await p.rebalance()
+
+    async def op_lookup():
+        keys = list(p._placements)[:50]
+        ids = [ObjectId(*k.split(".", 1)) for k in keys]
+        got = await p.lookup_batch(ids)
+        for k, g in zip(keys, got):
+            assert g is None or g in p._node_order
+
+    ops = [
+        (op_assign, 4), (op_update, 2), (op_remove, 2), (op_clean, 1),
+        (op_churn, 2), (op_cordon, 1), (op_rebalance, 2), (op_lookup, 3),
+    ]
+    weighted = [fn for fn, w in ops for _ in range(w)]
+    for wave in range(WAVES):
+        tasks = [
+            asyncio.create_task(rng.choice(weighted)())
+            for _ in range(OPS_PER_WAVE)
+        ]
+        results = await asyncio.gather(*tasks, return_exceptions=True)
+        for r in results:
+            assert not isinstance(r, BaseException), r
+        # Quiesce: everyone live again, one settling rebalance, then check.
+        p.sync_members(base)
+        for a in list(p.cordoned):
+            p.uncordon(a)
+        await p.rebalance()
+        _check_invariants(p)
+        # 2. after the settling rebalance every seat is schedulable.
+        for key, idx in p._placements.items():
+            slot = p._nodes[p._node_order[idx]]
+            assert slot.alive and not slot.cordoned, (key, slot)
+
+
+def test_soak_random_ops():
+    for seed in (3, 17):
+        asyncio.run(asyncio.wait_for(_soak(seed), 300))
