@@ -17,6 +17,7 @@ from typing import Any
 class AdminCommandKind(Enum):
     SERVER_EXIT = "server_exit"
     SHUTDOWN_OBJECT = "shutdown_object"
+    DRAIN_SERVER = "drain_server"
 
 
 @dataclasses.dataclass
@@ -28,6 +29,17 @@ class AdminCommand:
     @classmethod
     def server_exit(cls) -> "AdminCommand":
         return cls(AdminCommandKind.SERVER_EXIT)
+
+    @classmethod
+    def drain(cls) -> "AdminCommand":
+        """Graceful exit: cordon this node in the placement provider,
+        re-solve so its population re-seats on the survivors, run the
+        shutdown lifecycle for local instances, then exit — one admin
+        message for the whole ops drain flow. Degrades to ``server_exit``
+        semantics (plus lifecycle hooks) on providers without a solver
+        surface. The reference's only exit is immediate
+        (``server.rs:30-34``)."""
+        return cls(AdminCommandKind.DRAIN_SERVER)
 
     @classmethod
     def shutdown(cls, type_name: str, object_id: str) -> "AdminCommand":

@@ -267,8 +267,52 @@ class Server:
                 log.info("%s: AdminCommand::ServerExit", self._local_addr)
                 self._stopped.set()
                 return
+            if cmd.kind == AdminCommandKind.DRAIN_SERVER:
+                log.info("%s: AdminCommand::DrainServer", self._local_addr)
+                await self._drain_and_exit()
+                return
             if cmd.kind == AdminCommandKind.SHUTDOWN_OBJECT:
                 await self.shutdown_object(cmd.type_name, cmd.object_id)
+
+    async def _drain_and_exit(self) -> None:
+        """The graceful exit flow behind ``AdminCommand.drain()``.
+
+        1. Cordon this address in the placement provider (solver providers
+           only) so no NEW objects land here, and trigger one re-solve —
+           the stay-put discount moves exactly our population onto the
+           survivors.
+        2. Run the SHUTDOWN lifecycle for every locally activated instance
+           (``before_shutdown`` hooks get their chance to persist state).
+           A re-seated object's directory row now points at its new owner
+           — only rows still pointing HERE are removed, so the drain never
+           deletes another node's placement.
+        3. Exit the serve loop.
+        """
+        placement = self.object_placement
+        if hasattr(placement, "cordon"):
+            try:
+                placement.cordon(self._local_addr)
+            except (RuntimeError, KeyError) as e:
+                # Last schedulable node / never registered: nowhere to
+                # drain to — fall through to the lifecycle + exit.
+                log.warning("%s: drain degraded to exit (%s)", self._local_addr, e)
+            else:
+                if hasattr(placement, "rebalance"):
+                    with contextlib.suppress(Exception):
+                        await placement.rebalance()
+        for oid in self.registry.object_ids():
+            with contextlib.suppress(Exception):
+                await self.registry.send(
+                    oid.type_name,
+                    oid.id,
+                    LifecycleMessage(kind=LifecycleKind.SHUTDOWN),
+                    self.app_data,
+                )
+            self.registry.remove(oid.type_name, oid.id)
+            with contextlib.suppress(Exception):
+                if await placement.lookup(oid) == self._local_addr:
+                    await placement.remove(oid)
+        self._stopped.set()
 
     async def shutdown_object(self, type_name: str, object_id: str) -> None:
         """Run ``before_shutdown``, drop the instance, delete its placement.

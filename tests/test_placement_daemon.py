@@ -191,6 +191,75 @@ def test_drain_flow_on_live_cluster():
     )
 
 
+def test_admin_drain_command_full_flow():
+    """AdminCommand.drain(): one admin message = cordon + re-solve +
+    before_shutdown hooks for local instances + exit; re-seated rows are
+    NEVER deleted (only rows still pointing at the draining node are)."""
+    placement = JaxObjectPlacement(mode="greedy", move_cost=0.5)
+
+    shutdowns: list[str] = []
+
+    class DrainPin(ServiceObject):
+        @handler
+        async def poke(self, msg: Poke, ctx: AppData) -> Where:
+            return Where(address=ctx.get(ServerInfo).address)
+
+        async def before_shutdown(self, ctx: AppData) -> None:
+            shutdowns.append(self.id)
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        try:
+            for i in range(60):
+                await client.send(DrainPin, f"o{i}", Poke(), returns=Where)
+
+            seats = {
+                f"o{i}": await cluster.allocation_address("DrainPin", f"o{i}")
+                for i in range(60)
+            }
+            victim = max(
+                cluster.addresses,
+                key=lambda a: sum(1 for v in seats.values() if v == a),
+            )
+            on_victim = [k for k, v in seats.items() if v == victim]
+            victim_server = next(
+                s for s in cluster.servers if s.local_address == victim
+            )
+            victim_server.admin_sender().send(AdminCommand.drain())
+
+            # The server exits on its own once drained...
+            deadline = asyncio.get_event_loop().time() + 15.0
+            while asyncio.get_event_loop().time() < deadline:
+                if victim_server._stopped.is_set():
+                    break
+                await asyncio.sleep(0.05)
+            assert victim_server._stopped.is_set(), "drain never completed"
+            # ...having run before_shutdown for ITS local instances...
+            assert set(shutdowns) >= set(on_victim), (
+                sorted(set(on_victim) - set(shutdowns))
+            )
+            # ...and the full population still resolves: re-seated rows
+            # survived the lifecycle cleanup (nothing was over-deleted).
+            for k, old in seats.items():
+                addr = await cluster.allocation_address("DrainPin", k)
+                assert addr is not None and addr != victim, (k, addr)
+            for k in on_victim[:8]:
+                out = await client.send(DrainPin, k, Poke(), returns=Where)
+                assert out.address != victim
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=lambda: Registry().add_type(DrainPin),
+            num_servers=3,
+            placement=placement,
+            timeout=60.0,
+        )
+    )
+
+
 def test_daemon_noop_for_plain_providers():
     """Enabling the daemon with a CRUD-only provider must be harmless."""
 
