@@ -96,6 +96,18 @@ class ServerInfo:
 
 
 @dataclasses.dataclass
+class ServerDraining:
+    """Shared drain flag, injected into AppData by the Server.
+
+    While ``active``, the service layer refuses NEW activations (already-
+    seated objects keep being served) so the drain's lifecycle pass cannot
+    race fresh self-assignments — see ``Server._drain_and_exit``.
+    """
+
+    active: bool = False
+
+
+@dataclasses.dataclass
 class DispatchObserver:
     """AppData-injectable hook called after every successfully served request.
 

@@ -23,6 +23,7 @@ from .commands import (
     DispatchObserver,
     InternalClientSender,
     SendCommand,
+    ServerDraining,
     ServerInfo,
 )
 from .errors import ServerError
@@ -114,6 +115,7 @@ class Server:
         self._local_addr: str | None = None
         self._admin = AdminSender()
         self._internal = InternalClientSender()
+        self._draining = ServerDraining()
         self._stopped = asyncio.Event()
         self._conn_tasks: set[asyncio.Task] = set()
 
@@ -127,6 +129,7 @@ class Server:
         # Inject framework handles (reference server.rs wiring of AppData).
         self.app_data.set(self._admin)
         self.app_data.set(self._internal)
+        self.app_data.set(self._draining)
         self.app_data.get_or_default(MessageRouter)
         self.app_data.set(self.members_storage, as_type=MembershipStorage)
         self.app_data.set(self.object_placement, as_type=ObjectPlacement)
@@ -277,30 +280,80 @@ class Server:
     async def _drain_and_exit(self) -> None:
         """The graceful exit flow behind ``AdminCommand.drain()``.
 
-        1. Cordon this address in the placement provider (solver providers
-           only) so no NEW objects land here, and trigger one re-solve —
-           the stay-put discount moves exactly our population onto the
-           survivors.
-        2. Run the SHUTDOWN lifecycle for every locally activated instance
-           (``before_shutdown`` hooks get their chance to persist state).
-           A re-seated object's directory row now points at its new owner
-           — only rows still pointing HERE are removed, so the drain never
-           deletes another node's placement.
-        3. Exit the serve loop.
+        1. Raise the shared :class:`~rio_tpu.commands.ServerDraining` flag:
+           the service layer refuses NEW activations from here on (seated
+           objects keep being served), so the lifecycle pass below cannot
+           race fresh self-assignments.
+        2. Cordon this address in the placement provider (solver providers
+           only) and trigger one re-solve — the stay-put discount moves
+           exactly our population onto the survivors.
+        3. Run the SHUTDOWN lifecycle for every locally activated instance
+           (``before_shutdown`` hooks get their chance to persist state),
+           looping until the registry is empty — an in-flight request may
+           still be mid-activation from before the flag went up. Only
+           directory rows still pointing HERE are removed (a re-seated
+           row belongs to its new owner).
+        4. Flush a write-behind placement provider: drain IS the planned
+           shutdown its ``flush()`` contract names — exiting with dirty
+           marks would lose the re-seats from durable storage.
+        5. Exit the serve loop — guaranteed by the ``finally`` even if a
+           provider surprises us with an exception (a failed drain must
+           degrade to an exit, never to a wedged server).
         """
         placement = self.object_placement
-        if hasattr(placement, "cordon"):
-            try:
-                placement.cordon(self._local_addr)
-            except (RuntimeError, KeyError) as e:
-                # Last schedulable node / never registered: nowhere to
-                # drain to — fall through to the lifecycle + exit.
-                log.warning("%s: drain degraded to exit (%s)", self._local_addr, e)
+        try:
+            self._draining.active = True
+            if hasattr(placement, "cordon"):
+                try:
+                    placement.cordon(self._local_addr)
+                except Exception as e:
+                    # Last schedulable node / never registered / provider
+                    # quirk: nowhere to drain to — lifecycle + exit.
+                    log.warning(
+                        "%s: drain degraded to exit (%r)", self._local_addr, e
+                    )
+                else:
+                    if hasattr(placement, "rebalance"):
+                        with contextlib.suppress(Exception):
+                            await placement.rebalance()
+            for _pass in range(10):
+                remaining = self.registry.object_ids()
+                if not remaining:
+                    break
+                for oid in remaining:
+                    await self._teardown_local(oid, only_if_local_row=True)
             else:
-                if hasattr(placement, "rebalance"):
-                    with contextlib.suppress(Exception):
-                        await placement.rebalance()
-        for oid in self.registry.object_ids():
+                log.warning(
+                    "%s: registry not empty after drain passes (%d left)",
+                    self._local_addr,
+                    len(self.registry.object_ids()),
+                )
+            if hasattr(placement, "flush"):
+                with contextlib.suppress(Exception):
+                    await placement.flush()
+        except Exception:
+            log.exception("%s: drain failed; exiting anyway", self._local_addr)
+        finally:
+            self._stopped.set()
+
+    async def shutdown_object(self, type_name: str, object_id: str) -> None:
+        """Run ``before_shutdown``, drop the instance, delete its placement.
+
+        Reference ``server.rs:338-363``.
+        """
+        await self._teardown_local(
+            ObjectId(type_name, object_id), only_if_local_row=False
+        )
+
+    async def _teardown_local(
+        self, oid: ObjectId, *, only_if_local_row: bool
+    ) -> None:
+        """ONE lifecycle-teardown sequence for both the admin shutdown and
+        the drain pass: SHUTDOWN hook (suppressed), registry drop, then the
+        placement row. ``only_if_local_row`` (the drain pass) removes the
+        row only when it still points HERE — a re-seated row belongs to
+        its new owner and must survive."""
+        if self.registry.has(oid.type_name, oid.id):
             with contextlib.suppress(Exception):
                 await self.registry.send(
                     oid.type_name,
@@ -308,27 +361,13 @@ class Server:
                     LifecycleMessage(kind=LifecycleKind.SHUTDOWN),
                     self.app_data,
                 )
-            self.registry.remove(oid.type_name, oid.id)
+        self.registry.remove(oid.type_name, oid.id)
+        if only_if_local_row:
             with contextlib.suppress(Exception):
-                if await placement.lookup(oid) == self._local_addr:
-                    await placement.remove(oid)
-        self._stopped.set()
-
-    async def shutdown_object(self, type_name: str, object_id: str) -> None:
-        """Run ``before_shutdown``, drop the instance, delete its placement.
-
-        Reference ``server.rs:338-363``.
-        """
-        if self.registry.has(type_name, object_id):
-            with contextlib.suppress(Exception):
-                await self.registry.send(
-                    type_name,
-                    object_id,
-                    LifecycleMessage(kind=LifecycleKind.SHUTDOWN),
-                    self.app_data,
-                )
-        self.registry.remove(type_name, object_id)
-        await self.object_placement.remove(ObjectId(type_name, object_id))
+                if await self.object_placement.lookup(oid) == self._local_addr:
+                    await self.object_placement.remove(oid)
+        else:
+            await self.object_placement.remove(oid)
 
     # ------------------------------------------------------------------
 

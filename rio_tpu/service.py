@@ -22,7 +22,7 @@ import logging
 
 from .app_data import AppData
 from .cluster.storage import MembershipStorage
-from .commands import DispatchObserver
+from .commands import DispatchObserver, ServerDraining
 from .errors import HandlerNotFound, ObjectNotFound, SerializationError, TypeNotFound
 from .message_router import MessageRouter
 from .object_placement import ObjectPlacement, ObjectPlacementItem
@@ -69,6 +69,26 @@ class Service:
     # ------------------------------------------------------------------
     # Placement (reference service.rs:193-298)
     # ------------------------------------------------------------------
+
+    async def _refuse_if_draining(self, object_id: ObjectId) -> ResponseError | None:
+        """Refuse NEW activations while this node drains.
+
+        Objects already activated here keep being served until the drain's
+        lifecycle pass tears them down; anything else is bounced with
+        ``DeallocateServiceObject`` (the client's retry path re-resolves
+        and a healthy server re-seats it). A directory row still pointing
+        HERE is removed first, or the retry would redirect straight back
+        into the draining node forever.
+        """
+        drain = self.app_data.try_get(ServerDraining)
+        if drain is None or not drain.active:
+            return None
+        if self.registry.has(object_id.type_name, object_id.id):
+            return None
+        addr = await self.object_placement.lookup(object_id)
+        if addr == self.address:
+            await self.object_placement.remove(object_id)
+        return ResponseError.deallocate()
 
     async def get_or_create_placement(self, object_id: ObjectId) -> str:
         """Resolve the owning server for ``object_id``, self-assigning if free."""
@@ -141,6 +161,9 @@ class Service:
         if not self.registry.has_type(req.handler_type):
             return ResponseEnvelope.err(ResponseError.not_supported(req.handler_type))
 
+        refusal = await self._refuse_if_draining(object_id)
+        if refusal is not None:
+            return ResponseEnvelope.err(refusal)
         addr = await self.get_or_create_placement(object_id)
         mismatch = await self.check_address_mismatch(addr)
         if mismatch is not None:
@@ -200,6 +223,9 @@ class Service:
         object_id = ObjectId(req.handler_type, req.handler_id)
         if not self.registry.has_type(req.handler_type):
             return ResponseError.not_supported(req.handler_type)
+        refusal = await self._refuse_if_draining(object_id)
+        if refusal is not None:
+            return refusal
         addr = await self.get_or_create_placement(object_id)
         mismatch = await self.check_address_mismatch(addr)
         if mismatch is not None:

@@ -51,6 +51,57 @@ def _placement(db_path):
     )
 
 
+def test_drain_flushes_write_behind_before_exit(tmp_path):
+    """AdminCommand.drain() on a persistent provider must flush the
+    write-behind before the server exits: flush_interval is set far above
+    the test duration, so ONLY the drain's explicit flush can explain the
+    backing store holding the re-seated rows."""
+    from rio_tpu.commands import AdminCommand
+
+    placement = PersistentJaxObjectPlacement(
+        SqliteObjectPlacement(str(tmp_path / "dir.db")),
+        mode="greedy",
+        flush_interval=30.0,  # background flusher can't fire in-test
+    )
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        try:
+            for i in range(30):
+                await client.send(Pin, f"o{i}", Poke(), returns=Where)
+            victim_addr = await cluster.allocation_address("Pin", "o0")
+            victim = next(
+                s for s in cluster.servers if s.local_address == victim_addr
+            )
+            victim.admin_sender().send(AdminCommand.drain())
+            deadline = asyncio.get_event_loop().time() + 15.0
+            while asyncio.get_event_loop().time() < deadline:
+                if victim._stopped.is_set():
+                    break
+                await asyncio.sleep(0.05)
+            assert victim._stopped.is_set()
+            # The backing store already reflects the drain: every row
+            # points away from the drained node, with zero manual flushes.
+            rows = {
+                str(i.object_id): i.server_address
+                for i in await placement._backing.items()
+            }
+            assert rows, "backing store empty after drain"
+            assert all(a != victim_addr for a in rows.values()), rows
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_registry,
+            num_servers=3,
+            placement=placement,
+            timeout=60.0,
+        )
+    )
+
+
 def test_cluster_restart_restores_and_reseats(tmp_path):
     db = tmp_path / "directory.db"
     placement1 = _placement(db)

@@ -260,6 +260,43 @@ def test_admin_drain_command_full_flow():
     )
 
 
+def test_draining_node_refuses_new_activations_but_serves_seated():
+    """The quiesce gate behind drain: with the flag up, a node keeps
+    serving objects already activated on it, but NEW objects bounce
+    (deallocate -> client retry) and land on the other node."""
+    placement = JaxObjectPlacement(mode="greedy")
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        try:
+            seeded = []
+            for i in range(24):
+                out = await client.send(Pin, f"s{i}", Poke(), returns=Where)
+                seeded.append((f"s{i}", out.address))
+            draining = cluster.servers[0]
+            draining._draining.active = True
+            # Seated objects on the draining node still serve...
+            for k, addr in seeded:
+                out = await client.send(Pin, k, Poke(), returns=Where)
+                assert out.address == addr, (k, out.address, addr)
+            # ...but every NEW object lands on the OTHER node.
+            for i in range(24):
+                out = await client.send(Pin, f"n{i}", Poke(), returns=Where)
+                assert out.address != draining.local_address, f"n{i}"
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_registry,
+            num_servers=2,
+            placement=placement,
+            timeout=60.0,
+        )
+    )
+
+
 def test_daemon_noop_for_plain_providers():
     """Enabling the daemon with a CRUD-only provider must be harmless."""
 
