@@ -253,11 +253,15 @@ def child(kernel: str, deadline: float) -> None:
     os._exit(0)
 
 
-def main(deadline: float) -> None:
+def main(deadline: float, only: str | None = None) -> None:
     global OUT
     if os.environ.get("RIO_TPU_PALLAS_DEBUG_CPU") == "1":
         # Mechanics-validation artifacts must never clobber hardware evidence.
         OUT = OUT.replace("PALLAS_TPU", "PALLAS_DEBUG")
+    # A block-rows sweep (RIO_TPU_PALLAS_BLOCK_ROWS) banks under its OWN
+    # key so it can never replace the default-layout hardware result.
+    block_rows = os.environ.get("RIO_TPU_PALLAS_BLOCK_ROWS", "")
+    suffix = f"_br{block_rows}" if block_rows else ""
     results = {}
     if os.path.exists(OUT):
         try:
@@ -266,7 +270,10 @@ def main(deadline: float) -> None:
         except (json.JSONDecodeError, OSError):
             results = {}  # prior run died mid-write; start fresh
     for kernel in KERNELS:
-        print(f"=== {kernel}", file=sys.stderr)
+        if only is not None and kernel != only:
+            continue
+        rkey = kernel + suffix
+        print(f"=== {rkey}", file=sys.stderr)
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--kernel", kernel,
@@ -274,7 +281,7 @@ def main(deadline: float) -> None:
                 stdout=subprocess.PIPE, timeout=deadline + 60,
             )
         except subprocess.TimeoutExpired:
-            results[kernel] = {"kernel": kernel, "error": "parent backstop timeout"}
+            results[rkey] = {"kernel": kernel, "error": "parent backstop timeout"}
             with open(OUT, "w") as fh:
                 json.dump(results, fh, indent=1)
             print("=== parent backstop fired; relay likely wedged; stopping",
@@ -290,7 +297,7 @@ def main(deadline: float) -> None:
                 parsed = candidate  # last banked line wins
         fresh = parsed or {"kernel": kernel, "rc": proc.returncode,
                            "error": "no result (hang/wedge?)"}
-        prior = results.get(kernel)
+        prior = results.get(rkey)
         if (
             isinstance(prior, dict)
             and prior.get("ok")
@@ -305,12 +312,12 @@ def main(deadline: float) -> None:
             print(f"=== {kernel}: keeping prior ok result; new attempt "
                   f"failed ({fresh.get('error', fresh.get('rc'))})",
                   file=sys.stderr)
-            results[kernel] = {**prior, "last_failed_attempt": fresh}
+            results[rkey] = {**prior, "last_failed_attempt": fresh}
         else:
-            results[kernel] = fresh
+            results[rkey] = fresh
         with open(OUT, "w") as fh:  # bank after every child
             json.dump(results, fh, indent=1)
-        print(f"=== {kernel}: {results[kernel]}", file=sys.stderr)
+        print(f"=== {rkey}: {results[rkey]}", file=sys.stderr)
         if proc.returncode == 99:
             print("=== watchdog fired: relay likely wedged; stopping", file=sys.stderr)
             break
@@ -323,9 +330,11 @@ def main(deadline: float) -> None:
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--kernel", choices=KERNELS)
+    ap.add_argument("--only", choices=KERNELS, default=None,
+                    help="orchestrator mode: run a single kernel")
     ap.add_argument("--deadline", type=float, default=600.0)
     args = ap.parse_args()
     if args.kernel:
         child(args.kernel, args.deadline)
     else:
-        main(args.deadline)
+        main(args.deadline, args.only)

@@ -120,16 +120,23 @@ def health_ok(rec: dict, baseline: dict | None) -> str | None:
     return None
 
 
-def run_stage(status: Status, name: str, cmd: list[str], budget_s: float) -> int:
+def run_stage(
+    status: Status, name: str, cmd: list[str], budget_s: float,
+    env_extra: dict | None = None,
+) -> int:
     """Run one stage child, teeing output to TPU_ROUND_<name>.log."""
     log_path = os.path.join(HERE, f"TPU_ROUND_{name}.log")
     status.set(phase=f"stage:{name}")
     t0 = time.monotonic()
+    env = None
+    if env_extra:
+        env = dict(os.environ)
+        env.update(env_extra)
     with open(log_path, "w") as log:
         try:
             proc = subprocess.run(
                 cmd, stdout=log, stderr=subprocess.STDOUT, cwd=HERE,
-                timeout=budget_s,
+                timeout=budget_s, env=env,
             )
             rc = proc.returncode
         except subprocess.TimeoutExpired:
@@ -148,12 +155,17 @@ def run_stage(status: Status, name: str, cmd: list[str], budget_s: float) -> int
 
 
 STAGES = [
-    # (name, cmd, budget_s) in strict priority order. bench.py is FIRST:
-    # its collapsed chained tier is the round's #1 deliverable and it banks
-    # BENCH_DETAIL.tpu.json clobber-proof. Budgets are parent backstops
-    # sized ~1.3x the children's own summed watchdog deadlines.
-    ("bench", [sys.executable, "bench.py"], 3600.0),
-    ("pallas", [sys.executable, "tpu_pallas_check.py", "--deadline", "600"], 1500.0),
+    # (name, cmd, budget_s, env_extra) in strict priority order. bench.py
+    # is FIRST: its collapsed chained tier is the round's #1 deliverable
+    # and it banks BENCH_DETAIL.tpu.json clobber-proof. Budgets are parent
+    # backstops sized ~1.3x the children's own summed watchdog deadlines.
+    ("bench", [sys.executable, "bench.py"], 3600.0, None),
+    (
+        "pallas",
+        [sys.executable, "tpu_pallas_check.py", "--deadline", "600"],
+        1500.0,
+        None,
+    ),
     (
         "hier_ladder",
         [
@@ -161,6 +173,19 @@ STAGES = [
             "--deadline", "600",
         ],
         800.0,
+        None,
+    ),
+    # The block-rows layout experiment (VERDICT r4 #3 / weak #6): one
+    # kernel, larger grid blocks, banked under its own _br1024 key — runs
+    # LAST because it is exploratory, not evidence the round depends on.
+    (
+        "pallas_br",
+        [
+            sys.executable, "tpu_pallas_check.py", "--deadline", "600",
+            "--only", "pallas_scaling",
+        ],
+        800.0,
+        {"RIO_TPU_PALLAS_BLOCK_ROWS": "1024"},
     ),
 ]
 
@@ -183,8 +208,8 @@ def run_round(status: Status, wait: bool, max_wait_s: float) -> int:
         time.sleep(WAIT_INTERVAL_S)
         waited += WAIT_INTERVAL_S
 
-    for i, (name, cmd, budget) in enumerate(STAGES):
-        rc = run_stage(status, name, cmd, budget)
+    for i, (name, cmd, budget, env_extra) in enumerate(STAGES):
+        rc = run_stage(status, name, cmd, budget, env_extra)
         if rc == -1:
             status.set(phase="halted", halted_reason=f"stage {name} hit parent backstop")
             return 3
