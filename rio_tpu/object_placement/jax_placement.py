@@ -167,6 +167,24 @@ def _next_bucket(n: int, minimum: int = 256) -> int:
     return b
 
 
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=8)
+def _pad_feature_block(pad: int, dim: int) -> np.ndarray:
+    """Deterministic features for the hierarchical solve's pad rows.
+
+    Cached per (pad count, dim): pad row i's feature never changes, and at
+    large directories rebuilding up to bucket_n-n synthetic keys + crc32
+    hashes per rebalance on the solver thread would be pure waste. Callers
+    only read/concatenate the returned block (never mutate)."""
+    if pad == 0:
+        return np.zeros((0, dim), np.float32)
+    return np.asarray(
+        _hash_features([f"\x00pad:{i}" for i in range(pad)], dim), np.float32
+    )
+
+
 def _guard_sentinel_spill(repaired, real, m_axis: int, cap_alive):
     """Shared guard (see :func:`rio_tpu.ops.sinkhorn.route_sentinel_spill`);
     r4 trigger here: 10M objects, bucket 16,777,216 = exactly the fp32
@@ -651,15 +669,37 @@ class JaxObjectPlacement(ObjectPlacement):
         alive_np = np.zeros((m,), np.float32)
         cap_np[:m_real] = cap_full[:m_real]
         alive_np[:m_real] = alive_full[:m_real]
+        # PAD THE OBJECT AXIS to a power-of-two bucket: every static shape
+        # fed to the jitted solve must be drawn from a bounded set, or a
+        # steadily-allocating cluster compiles a FRESH executable per
+        # rebalance and the jit cache grows without bound (found by the r5
+        # endurance soak: ~25 MB of retained lowering/executable per new
+        # directory size; ~1 GB/hour under continuous allocation). Pad
+        # rows ride the solve as ordinary rows — they spread ~evenly under
+        # the capacity marginals, costing only rounding-noise balance (the
+        # real rows' per-node counts stay proportional) — and are sliced
+        # off before the result leaves this function. The feature hook is
+        # given ONLY real directory keys (its documented contract); pad
+        # features come from a cached internal block.
+        n = len(keys)
+        bucket_n = _next_bucket(n)
         # Bucket from the fullest group's capacity share (host-side, static
         # per solve): uniform N/G sizing under-provisions skewed clusters.
+        # Quantized to a power of two for the same bounded-compile reason
+        # as the object axis (a continuous float share would otherwise
+        # mint a fresh static `bucket` per capacity/liveness change).
         live_cap = (cap_np * alive_np).reshape(n_groups, group_size).sum(axis=1)
         share = live_cap.max() / max(live_cap.sum(), 1e-9)
-        n = len(keys)
-        bucket_sz = max(8, -(-int(1.3 * n * float(share)) // 8) * 8)
+        bucket_sz = _next_bucket(
+            max(8, int(1.3 * bucket_n * float(share))), minimum=8
+        )
 
         obj_feat = np.asarray(self._obj_features(keys), np.float32)
         d_feat = obj_feat.shape[1]
+        if bucket_n != n:
+            obj_feat = np.concatenate(
+                [obj_feat, _pad_feature_block(bucket_n - n, d_feat)]
+            )
         node_feat = np.zeros((d_feat, m), np.float32)
         if node_order:
             nf = np.asarray(self._node_features(node_order), np.float32)
@@ -669,7 +709,7 @@ class JaxObjectPlacement(ObjectPlacement):
             node_feat[:, : len(node_order)] = nf.T
         kw = dict(
             n_groups=n_groups,
-            bucket=min(bucket_sz, n),
+            bucket=min(bucket_sz, bucket_n),
             eps=self._eps,
             coarse_iters=self._n_iters,
             fine_iters=self._n_iters,
@@ -681,10 +721,10 @@ class JaxObjectPlacement(ObjectPlacement):
             from ..parallel.hierarchical import sharded_hierarchical_assign
 
             n_shards = int(self._mesh.devices.size)
-            n_pad = -(-n // n_shards) * n_shards
-            if n_pad != n:
+            n_pad = -(-bucket_n // n_shards) * n_shards
+            if n_pad != bucket_n:
                 obj_feat = jnp.concatenate(
-                    [obj_feat, jnp.zeros((n_pad - n, d_feat), jnp.float32)]
+                    [obj_feat, jnp.zeros((n_pad - bucket_n, d_feat), jnp.float32)]
                 )
             res = sharded_hierarchical_assign(
                 self._mesh, obj_feat, jnp.asarray(node_feat),
@@ -695,7 +735,7 @@ class JaxObjectPlacement(ObjectPlacement):
                 obj_feat, jnp.asarray(node_feat),
                 jnp.asarray(cap_np), jnp.asarray(alive_np), **kw,
             )
-        return res.assignment, None
+        return res.assignment[:n], None
 
     async def rebalance(self, *, mode: str | None = None) -> int:
         """Full re-solve of every tracked object; returns number of moves.

@@ -626,6 +626,36 @@ async def test_cordon_refuses_last_schedulable_node():
     assert p.cordoned == set()
 
 
+async def test_hierarchical_rebalance_compiles_are_bucket_bounded():
+    """r5 endurance regression: a steadily-allocating cluster must NOT
+    compile a fresh hierarchical executable per rebalance (the jit cache
+    retained ~25 MB per new directory size — ~1 GB/hour). The object axis
+    is padded to power-of-two buckets, so rebalances at many different
+    sizes within one bucket reuse ONE trace."""
+    from rio_tpu.parallel.hierarchical import hierarchical_assign
+
+    if not hasattr(hierarchical_assign, "_cache_size"):
+        import pytest
+
+        pytest.skip("jax jit cache probe (_cache_size) unavailable")
+    p = JaxObjectPlacement(mode="hierarchical")
+    p.sync_members([f"10.11.0.{i}:70" for i in range(3)])
+    hierarchical_assign.clear_cache()
+    n = 0
+    for step in range(6):
+        ids = [ObjectId("B", str(n + i)) for i in range(37)]  # 37: new n each step
+        n += 37
+        await p.assign_batch(ids)
+        await p.rebalance()
+    # 6 different directory sizes, all inside the 256-bucket: one trace.
+    assert hierarchical_assign._cache_size() == 1, hierarchical_assign._cache_size()
+    # Crossing the bucket boundary adds exactly one more.
+    ids = [ObjectId("B", str(n + i)) for i in range(120)]
+    await p.assign_batch(ids)
+    await p.rebalance()
+    assert hierarchical_assign._cache_size() == 2, hierarchical_assign._cache_size()
+
+
 async def test_solve_stats_history_records_prior_solves():
     placement = JaxObjectPlacement(mode="greedy")
     placement.sync_members([f"10.2.0.{i}:80" for i in range(4)])
