@@ -242,7 +242,8 @@ def _solve_rate(
     from rio_tpu.ops import (
         exact_quota_repair,
         plan_rounded_assign_from_scaling,
-        scaling_core,
+        scaling_core_auto,
+        scaling_impl_for,
     )
     from rio_tpu.ops.sinkhorn import normalize_marginals
 
@@ -257,13 +258,13 @@ def _solve_rate(
         return jnp.sum(jnp.abs(u * Kv - a))
 
     def solve_only(cost, mass, cap):
-        u, v, K, _ = scaling_core(
+        u, v, K, _ = scaling_core_auto(
             cost, mass, cap, eps=0.05, n_iters=n_iters, kernel_dtype=kernel_dtype
         )
         return jnp.sum(u) + jnp.sum(v) + _row_marginal_err(K, u, v, mass, cap)
 
     def step(cost, mass, cap):
-        u, v, K, _ = scaling_core(
+        u, v, K, _ = scaling_core_auto(
             cost, mass, cap, eps=0.05, n_iters=n_iters, kernel_dtype=kernel_dtype
         )
         marginal_err = _row_marginal_err(K, u, v, mass, cap)
@@ -318,7 +319,7 @@ def _solve_rate(
     @functools.partial(jax.jit, static_argnames=("k",))
     def chained_solve(cost, mass, cap, k):
         def body(_, mass_c):
-            u, v, K, _sh = scaling_core(
+            u, v, K, _sh = scaling_core_auto(
                 cost + 1e-30 * mass_c[0], mass_c, cap,
                 eps=0.05, n_iters=n_iters, kernel_dtype=kernel_dtype,
             )
@@ -361,6 +362,7 @@ def _solve_rate(
         "fair_load": n_obj // n_nodes,
         "mean_cost": round(mean_cost, 4),
         "marginal_err": float(out[2]),
+        "solver_impl": scaling_impl_for(n_obj, n_nodes),
     }
     if chained_res is not None:
         result.update(chained_res)

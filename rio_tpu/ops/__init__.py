@@ -22,8 +22,11 @@ from .assignment import (
 from .pallas_sinkhorn import fused_iteration, pallas_sinkhorn
 from .scaling import (
     fused_scaling_iteration,
+    pallas_scaling_core,
     pallas_scaling_sinkhorn,
     scaling_core,
+    scaling_core_auto,
+    scaling_impl_for,
     scaling_sinkhorn,
 )
 from .sinkhorn import (
@@ -39,9 +42,12 @@ __all__ = [
     "SinkhornResult",
     "fused_iteration",
     "fused_scaling_iteration",
+    "pallas_scaling_core",
     "pallas_scaling_sinkhorn",
     "pallas_sinkhorn",
     "scaling_core",
+    "scaling_core_auto",
+    "scaling_impl_for",
     "scaling_sinkhorn",
     "assign_from_potentials",
     "build_cost_matrix",
