@@ -273,6 +273,13 @@ def pallas_scaling_core(
 # applies where K spills far past VMEM; below this element count the XLA
 # loop is already cache/VMEM-resident and the pallas grid overhead loses.
 _FUSED_MIN_ELEMS = 1 << 24  # 32 MB of bf16 K
+# ...and only at WIDE column counts. The r5 TPU A/B: at m=1024 the fused
+# kernel wins (1.19x by iteration slope at 262144x1024; 275.7 -> 226.8 ms
+# single-call and 212.1 -> 204.6 ms chained at 1Mx1024), but at m=256 it
+# LOSES 2.1x (33.3 -> 71.0 ms chained at 1M) — narrow blocks starve the
+# sweep: per-grid-step work shrinks with m while the step count and the
+# (1, m) accumulator round trips don't. Dispatch only where measured.
+_FUSED_MIN_COLS = 1024
 
 
 def scaling_impl_for(n: int, m: int, *, block_rows: int = 1024) -> str:
@@ -280,6 +287,7 @@ def scaling_impl_for(n: int, m: int, *, block_rows: int = 1024) -> str:
     if (
         jax.default_backend() == "tpu"
         and n * m >= _FUSED_MIN_ELEMS
+        and m >= _FUSED_MIN_COLS
         and n % block_rows == 0
     ):
         return "pallas_fused"
