@@ -830,7 +830,18 @@ def _hier_rate(
     import jax
     import jax.numpy as jnp
 
-    from rio_tpu.parallel.hierarchical import hierarchical_assign
+    from rio_tpu.parallel.hierarchical import (
+        chunked_hierarchical_assign,
+        hierarchical_assign,
+    )
+
+    # Above the 655k chunk shape, the TPU backend's compile is superlinear
+    # (v5e: 50 s at 655k, 599 s flat at 2.6M) — run the sharded design
+    # temporally instead: lax.map over fixed-shape chunks pins compile cost
+    # to the chunk while execution scales linearly (CPU check: 8.5 s to
+    # compile 16x655k vs 599 s the flat 2.6M cost on device).
+    hier_chunk = 655_360
+    n_chunks = n_obj // hier_chunk if n_obj > hier_chunk and n_obj % hier_chunk == 0 else 1
 
     t_enter = time.perf_counter()
     key = jax.random.PRNGKey(1)
@@ -841,9 +852,15 @@ def _hier_rate(
     alive = jnp.ones((n_nodes,), jnp.float32)
 
     def run():
-        res = hierarchical_assign(
-            obj_feat, node_feat, cap, alive, n_groups=n_groups
-        )
+        if n_chunks > 1:
+            res = chunked_hierarchical_assign(
+                obj_feat, node_feat, cap, alive,
+                n_groups=n_groups, n_chunks=n_chunks,
+            )
+        else:
+            res = hierarchical_assign(
+                obj_feat, node_feat, cap, alive, n_groups=n_groups
+            )
         return res.assignment, res.overflow
 
     t0 = time.perf_counter()
@@ -861,9 +878,15 @@ def _hier_rate(
     @functools.partial(jax.jit, static_argnames=("k",))
     def chained(obj_feat, node_feat, cap, alive, k):
         def body(_, carry):
-            res = hierarchical_assign(
-                obj_feat + carry, node_feat, cap, alive, n_groups=n_groups
-            )
+            if n_chunks > 1:
+                res = chunked_hierarchical_assign(
+                    obj_feat + carry, node_feat, cap, alive,
+                    n_groups=n_groups, n_chunks=n_chunks,
+                )
+            else:
+                res = hierarchical_assign(
+                    obj_feat + carry, node_feat, cap, alive, n_groups=n_groups
+                )
             # 1e-30 * sum(assignment) is ~1e-22 against O(1) features:
             # bit-exact identity, structurally loop-carried.
             return 1e-30 * jnp.sum(res.assignment).astype(jnp.float32)
@@ -884,6 +907,7 @@ def _hier_rate(
         "n_nodes": n_nodes,
         "n_groups": n_groups,
         "overflow": overflow,
+        "n_chunks": n_chunks,
         "compile_s": round(compile_s, 2),
         **chain_extra,
     }

@@ -46,7 +46,12 @@ from ..ops.sinkhorn import (
     route_sentinel_spill,
 )
 
-__all__ = ["HierarchicalResult", "hierarchical_assign", "sharded_hierarchical_assign"]
+__all__ = [
+    "HierarchicalResult",
+    "chunked_hierarchical_assign",
+    "hierarchical_assign",
+    "sharded_hierarchical_assign",
+]
 
 
 class HierarchicalResult(NamedTuple):
@@ -204,6 +209,50 @@ def hierarchical_assign(
     missed = jnp.zeros((n,), bool).at[order].set(~in_bucket)
     assignment = jnp.where(missed, fallback[group], assignment)
     return HierarchicalResult(assignment=assignment, group=group, overflow=overflow)
+
+
+@functools.partial(jax.jit, static_argnames=("n_groups", "n_chunks", "bucket", "eps", "coarse_iters", "fine_iters"))
+def chunked_hierarchical_assign(
+    obj_feat: jax.Array,
+    node_feat: jax.Array,
+    node_capacity: jax.Array,
+    alive: jax.Array,
+    *,
+    n_groups: int,
+    n_chunks: int,
+    **kw,
+) -> HierarchicalResult:
+    """Single-chip scale-out: the sharded solve's design, run temporally.
+
+    The TPU backend's compile time for :func:`hierarchical_assign` is
+    superlinear in the object count (measured on v5e: 50 s at 655k,
+    599 s at 2.6M — while CPU XLA stays flat at ~7 s), so giant flat
+    shapes price a full re-solve out of any watchdog budget. This wrapper
+    reuses the exact per-shard independence `sharded_hierarchical_assign`
+    rides (each shard solves its slice against ``1/n_shards`` of every
+    node's capacity; marginal normalization spreads each slice across the
+    same capacity proportions): chunks run *sequentially* under
+    ``lax.map``, so XLA traces and compiles ONE body at the chunk shape —
+    compile cost is pinned to the chunk size while execution scales
+    linearly with N. Per-chunk exact quota repair makes total node loads
+    exact to chunk granularity, same as the mesh version.
+    """
+    n = obj_feat.shape[0]
+    assert n % n_chunks == 0, (n, n_chunks)
+    of = obj_feat.reshape(n_chunks, n // n_chunks, obj_feat.shape[1])
+
+    def one(of_c):
+        return hierarchical_assign(
+            of_c, node_feat, node_capacity / n_chunks, alive,
+            n_groups=n_groups, **kw,
+        )
+
+    res = jax.lax.map(one, of)
+    return HierarchicalResult(
+        assignment=res.assignment.reshape(-1),
+        group=res.group.reshape(-1),
+        overflow=jnp.sum(res.overflow),
+    )
 
 
 def sharded_hierarchical_assign(

@@ -237,3 +237,40 @@ def test_hierarchical_exact_node_quotas():
     assert int(res.overflow) == 0
     loads = np.bincount(np.asarray(res.assignment), minlength=m)
     assert loads.max() - loads.min() <= 2  # group quota +-1, node quota +-1
+
+
+def test_chunked_hierarchical_matches_flat_quality():
+    """chunked_hierarchical_assign = the sharded design run temporally.
+
+    Same contract the mesh version proves spatially: per-node loads exact
+    to chunk granularity, dead nodes empty, zero overflow, and affinity
+    quality on par with the flat solve (each chunk spreads over the same
+    capacity proportions). This is the path that pins TPU compile cost to
+    the chunk shape (v5e measured 599 s flat compile at 2.6M vs 50 s at
+    the 655k chunk shape)."""
+    from rio_tpu.parallel.hierarchical import chunked_hierarchical_assign
+
+    n, d, m, g, chunks = 4096, 16, 64, 8, 4
+    obj, node = _features(jax.random.PRNGKey(42), n, d, m)
+    cap = jnp.ones((m,), jnp.float32)
+    alive = jnp.ones((m,), jnp.float32).at[5].set(0.0).at[50].set(0.0)
+
+    flat = hierarchical_assign(obj, node, cap, alive, n_groups=g)
+    chunked = chunked_hierarchical_assign(
+        obj, node, cap, alive, n_groups=g, n_chunks=chunks
+    )
+    a = np.asarray(chunked.assignment)
+    assert a.min() >= 0 and a.max() < m
+    assert not np.any(np.isin(a, [5, 50]))
+    assert int(chunked.overflow) == 0
+    # Load exactness to chunk granularity: every live node within
+    # n_chunks of the flat solve's (exact-quota) load.
+    cf = np.bincount(np.asarray(flat.assignment), minlength=m)
+    cc = np.bincount(a, minlength=m)
+    assert np.abs(cc - cf).max() <= chunks
+    # Affinity quality: mean assigned score within 2% of the flat solve.
+    on = np.asarray(obj @ node)
+    q_flat = on[np.arange(n), np.asarray(flat.assignment)].mean()
+    q_chunk = on[np.arange(n), a].mean()
+    spread = on.std()
+    assert q_chunk >= q_flat - 0.02 * spread
