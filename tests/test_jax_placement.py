@@ -669,3 +669,37 @@ async def test_solve_stats_history_records_prior_solves():
     assert [h.epoch for h in hist] == [first_epoch]
     assert hist[0].history == []  # entries are flat, never nested
     assert placement.stats.epoch > first_epoch
+
+
+async def test_hierarchical_rebalance_chunks_above_threshold(monkeypatch):
+    """Above _HIER_CHUNK_ROWS the single-chip hierarchical solve must route
+    through chunked_hierarchical_assign (TPU compile is superlinear in the
+    flat row count; the chunked body compiles once at the chunk shape) and
+    still produce a valid, balanced directory."""
+    from rio_tpu.object_placement import jax_placement as jp_mod
+    from rio_tpu.parallel import hierarchical as hier_mod
+
+    monkeypatch.setattr(jp_mod, "_HIER_CHUNK_ROWS", 512)
+    calls = {"n_chunks": None}
+    real = hier_mod.chunked_hierarchical_assign
+
+    def spy(*args, **kw):
+        calls["n_chunks"] = kw.get("n_chunks")
+        return real(*args, **kw)
+
+    monkeypatch.setattr(hier_mod, "chunked_hierarchical_assign", spy)
+
+    p = JaxObjectPlacement(mode="hierarchical", n_iters=10)
+    members = [f"10.31.0.{i}:70" for i in range(6)]
+    p.sync_members(members)
+    ids = [ObjectId("Chunky", str(i)) for i in range(1200)]  # bucket 2048 -> 4 chunks
+    await p.assign_batch(ids)
+    await p.rebalance()
+    assert calls["n_chunks"] == 4
+    # Directory still complete, every seat on a live member, loads balanced.
+    addrs = [await p.lookup(i) for i in ids]
+    assert all(a in members for a in addrs)
+    from collections import Counter
+
+    loads = Counter(addrs)
+    assert max(loads.values()) <= 2.0 * (1200 / 6)
