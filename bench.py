@@ -951,13 +951,20 @@ def run_hier_tier(n_obj: int, deadline: float, platform: str = "tpu") -> None:
         # run started straight at quarter size (2.6M), blew the deadline
         # inside the first compile, and the watchdog exit left NO evidence
         # at all. Small rungs are cheap insurance.
-        sizes = sorted(
-            {
-                min(n_obj, max(65_536, n_obj // 16)),
-                min(n_obj, max(131_072, n_obj // 4)),
-                n_obj,
-            }
-        )
+        if n_obj > 655_360 and n_obj % 655_360 == 0:
+            # Chunked era: compile cost is pinned to the 655k chunk shape
+            # (see _hier_rate), so the middle rung no longer buys risk
+            # reduction — ladder straight from the chunk shape to the full
+            # size and spend the budget on the headline rung.
+            sizes = [655_360, n_obj]
+        else:
+            sizes = sorted(
+                {
+                    min(n_obj, max(65_536, n_obj // 16)),
+                    min(n_obj, max(131_072, n_obj // 4)),
+                    n_obj,
+                }
+            )
         result = {"ok": True, "kind": "hier", "rungs": {}}
         prev = prev_size = None
         for size in sizes:
@@ -1345,7 +1352,7 @@ def main() -> None:
         # BASELINE row 5 (scale ceiling): hierarchical 2-level OT toward
         # 10M x 1k, in its OWN child so an overrun can't cost the banked
         # headline result; the child sizes itself adaptively.
-        rc, hier = _run_child(10_485_760, "tpu", 420.0, hier=True)
+        rc, hier = _run_child(10_485_760, "tpu", 700.0, hier=True)
         if hier:
             detail["baseline_row5_hier"] = hier
             print(f"# row-5 hier tier: {hier}", file=sys.stderr)
