@@ -166,6 +166,15 @@ def _profiler_trace(name: str):
 # parallel/hierarchical.py chunked_hierarchical_assign.
 _HIER_CHUNK_ROWS = 524_288
 
+# Flat (collapsed) OT rebalances above this many padded rows route through
+# the hierarchical solve instead: the TPU backend's compile time for the
+# flat O(N) expansion pipeline is superlinear in the row count — neither
+# 10.5M nor 4.2M rows finished a 900 s compile budget (v5e, 2026-07-31)
+# while 1M compiles in ~80 s — and the chunked two-level solve compiles
+# in ~50 s and executes 10.5M in 2.6 s. The threshold is the largest
+# flat bucket actually proven on hardware.
+_FLAT_REBALANCE_MAX_ROWS = 1_048_576
+
 
 def _next_bucket(n: int, minimum: int = 256) -> int:
     """Pad batch sizes to power-of-two buckets so XLA compiles per bucket."""
@@ -801,7 +810,23 @@ class JaxObjectPlacement(ObjectPlacement):
             # Decide the actual code path up front so traces, profiler
             # labels, and SolveStats.mode all agree on what ran.
             collapse = mode in ("sinkhorn", "scaling") and self._mesh is None
-            solved_as = f"{mode}+collapsed" if collapse else mode
+            # Above _FLAT_REBALANCE_MAX_ROWS the flat collapsed pipeline is
+            # compile-infeasible on the TPU backend (superlinear compile:
+            # the 10.5M-row expansion never finished a 900 s budget on
+            # v5e, while 1M compiles in ~80 s) — route the re-solve
+            # through the two-level solve, whose chunked form pins compile
+            # to the 655k chunk shape (measured 48 s at 10.5M, 2.6 s
+            # chained execution). Hashed-identity features are the
+            # default, so this needs no user hooks; balance/liveness
+            # quality parity is pinned by tests/test_hierarchical.py.
+            route_hier = collapse and bucket > _FLAT_REBALANCE_MAX_ROWS
+            if route_hier:
+                collapse = False
+            solved_as = (
+                f"{mode}+hier_at_scale"
+                if route_hier
+                else f"{mode}+collapsed" if collapse else mode
+            )
             from ..tracing import span
 
             with span("placement_solve", mode=solved_as, n=n), _profiler_trace(
@@ -836,7 +861,7 @@ class JaxObjectPlacement(ObjectPlacement):
                         repaired, real, m_axis, cap_alive
                     )
 
-                if mode == "hierarchical":
+                if mode == "hierarchical" or route_hier:
                     # Never materializes the flat (bucket x node_axis) cost.
                     assignment, g = self._hierarchical_solve(keys, node_order, cap, alive)
                 elif collapse:

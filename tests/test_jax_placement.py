@@ -703,3 +703,31 @@ async def test_hierarchical_rebalance_chunks_above_threshold(monkeypatch):
 
     loads = Counter(addrs)
     assert max(loads.values()) <= 2.0 * (1200 / 6)
+
+
+async def test_flat_rebalance_routes_to_hierarchical_at_scale(monkeypatch):
+    """Flat OT modes above _FLAT_REBALANCE_MAX_ROWS must re-solve through
+    the two-level pipeline (the flat collapsed expansion is
+    compile-infeasible on the TPU backend at 10M-row shapes) and record
+    what actually ran in SolveStats.mode."""
+    from rio_tpu.object_placement import jax_placement as jp_mod
+
+    monkeypatch.setattr(jp_mod, "_FLAT_REBALANCE_MAX_ROWS", 256)
+    p = JaxObjectPlacement(mode="sinkhorn", n_iters=10)
+    members = [f"10.32.0.{i}:70" for i in range(5)]
+    p.sync_members(members)
+    ids = [ObjectId("Big", str(i)) for i in range(700)]  # bucket 1024 > 256
+    await p.assign_batch(ids)
+    moved = await p.rebalance()
+    assert p.stats.mode == "sinkhorn+hier_at_scale"
+    assert moved >= 0
+    addrs = [await p.lookup(i) for i in ids]
+    assert all(a in members for a in addrs)
+    from collections import Counter
+
+    loads = Counter(addrs)
+    assert max(loads.values()) <= 2.0 * (700 / 5)
+    # Below the threshold the collapsed fast path still runs.
+    monkeypatch.setattr(jp_mod, "_FLAT_REBALANCE_MAX_ROWS", 1 << 20)
+    await p.rebalance()
+    assert p.stats.mode == "sinkhorn+collapsed"
