@@ -661,7 +661,10 @@ class JaxObjectPlacement(ObjectPlacement):
             self._nodes[self._node_order[idx]].load += 1.0
         self._epoch += 1
 
-    def _hierarchical_solve(self, keys: list[str], node_order: list[str], cap, alive):
+    def _hierarchical_solve(
+        self, keys: list[str], node_order: list[str], cap, alive,
+        cur_idx=None, move_cost: float = 0.0,
+    ):
         """Two-level OT re-solve over hashed identity features.
 
         The flat-cost modes materialize (bucket x node_axis); this one stays
@@ -669,6 +672,16 @@ class JaxObjectPlacement(ObjectPlacement):
         (see :mod:`rio_tpu.parallel.hierarchical`). Reads ONLY the
         lock-snapshotted ``node_order``/``cap``/``alive`` — it runs in the
         solver thread, concurrent with directory mutations.
+
+        ``cur_idx``/``move_cost`` carry the flat modes' stay-put semantics
+        into feature space when a sinkhorn/scaling rebalance is routed here
+        at scale: each seated object's feature is pulled ``move_cost``
+        toward its current node's embedding (the same cache-warmth encoding
+        AffinityTracker learns from traffic), so only capacity pressure —
+        dead nodes, skew — moves anything, instead of every quota ripple
+        reshuffling millions of actors. Native ``mode="hierarchical"``
+        solves don't use it: there the tracker's learned features are the
+        stickiness mechanism and double-counting would over-stick.
         """
         from ..parallel.hierarchical import hierarchical_assign
 
@@ -726,6 +739,19 @@ class JaxObjectPlacement(ObjectPlacement):
 
         obj_feat = np.asarray(self._obj_features(keys), np.float32)
         d_feat = obj_feat.shape[1]
+        if move_cost > 0.0 and cur_idx is not None and node_order:
+            # Stay-put pull for routed flat-mode solves (see docstring).
+            # Node embeddings are unit vectors; cross-affinities of random
+            # unit vectors are ~1/sqrt(d) noise, so adding move_cost of the
+            # current seat's embedding raises the seat's affinity by
+            # ~move_cost relative to everywhere else — the feature-space
+            # analog of the flat path's stay-put diagonal discount.
+            node_emb = np.asarray(self._node_features(node_order), np.float32)
+            seat = np.asarray(cur_idx, np.int64)
+            seated = (seat >= 0) & (seat < len(node_order))
+            pull = np.zeros_like(obj_feat)
+            pull[seated] = node_emb[seat[seated]]
+            obj_feat = obj_feat + np.float32(move_cost) * pull
         if bucket_n != n:
             obj_feat = np.concatenate(
                 [obj_feat, _pad_feature_block(bucket_n - n, d_feat)]
@@ -819,7 +845,16 @@ class JaxObjectPlacement(ObjectPlacement):
             # chained execution). Hashed-identity features are the
             # default, so this needs no user hooks; balance/liveness
             # quality parity is pinned by tests/test_hierarchical.py.
-            route_hier = collapse and bucket > _FLAT_REBALANCE_MAX_ROWS
+            # Per-shard rows are what the backend actually compiles: a
+            # mesh divides the flat shape across devices, a single chip
+            # does not.
+            flat_rows = bucket if self._mesh is None else (
+                -(-bucket // int(self._mesh.devices.size))
+            )
+            route_hier = (
+                mode in ("sinkhorn", "scaling")
+                and flat_rows > _FLAT_REBALANCE_MAX_ROWS
+            )
             if route_hier:
                 collapse = False
             solved_as = (
@@ -863,7 +898,11 @@ class JaxObjectPlacement(ObjectPlacement):
 
                 if mode == "hierarchical" or route_hier:
                     # Never materializes the flat (bucket x node_axis) cost.
-                    assignment, g = self._hierarchical_solve(keys, node_order, cap, alive)
+                    assignment, g = self._hierarchical_solve(
+                        keys, node_order, cap, alive,
+                        cur_idx=cur_idx if route_hier else None,
+                        move_cost=self._move_cost if route_hier else 0.0,
+                    )
                 elif collapse:
                     # CLASS-COLLAPSED exact solve (ops/structured.py): the
                     # flat cost model is a per-node vector plus a stay-put

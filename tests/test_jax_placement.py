@@ -731,3 +731,36 @@ async def test_flat_rebalance_routes_to_hierarchical_at_scale(monkeypatch):
     monkeypatch.setattr(jp_mod, "_FLAT_REBALANCE_MAX_ROWS", 1 << 20)
     await p.rebalance()
     assert p.stats.mode == "sinkhorn+collapsed"
+
+
+async def test_routed_hier_rebalance_honors_move_cost(monkeypatch):
+    """Review regression: a flat-mode rebalance routed through the
+    hierarchical solve at scale must keep stay-put semantics. The pull of
+    move_cost toward the current seat's embedding is the feature-space
+    analog of the flat path's stay-put diagonal: re-solving an
+    already-seated directory must move almost nothing (measured 12 vs 631
+    unsticky), and a node death must move ~only the displaced share."""
+    from rio_tpu.object_placement import jax_placement as jp_mod
+
+    monkeypatch.setattr(jp_mod, "_FLAT_REBALANCE_MAX_ROWS", 256)
+    members = [f"10.40.0.{i}:70" for i in range(8)]
+    ids = [ObjectId("S", str(i)) for i in range(700)]
+
+    async def settle_and_kill(move_cost):
+        p = JaxObjectPlacement(mode="sinkhorn", n_iters=10, move_cost=move_cost)
+        p.sync_members(members)
+        await p.assign_batch(ids)
+        settle = await p.rebalance()
+        assert p.stats.mode == "sinkhorn+hier_at_scale"
+        p.sync_members(members[:-1])
+        after_kill = await p.rebalance()
+        addrs = [await p.lookup(i) for i in ids]
+        assert all(a in members[:-1] for a in addrs)  # dead node vacated
+        return settle, after_kill
+
+    settle_free, _ = await settle_and_kill(0.0)
+    settle_sticky, after_kill = await settle_and_kill(1.0)
+    displaced = 700 / 8
+    assert settle_sticky <= 60, settle_sticky            # measured 12
+    assert settle_free >= 5 * settle_sticky + 100        # measured 631
+    assert after_kill <= 2.0 * displaced, after_kill     # measured 93
