@@ -764,3 +764,32 @@ async def test_routed_hier_rebalance_honors_move_cost(monkeypatch):
     assert settle_sticky <= 60, settle_sticky            # measured 12
     assert settle_free >= 5 * settle_sticky + 100        # measured 631
     assert after_kill <= 2.0 * displaced, after_kill     # measured 93
+
+
+async def test_mesh_flat_rebalance_routes_by_per_shard_rows(monkeypatch):
+    """Review regression: the compile-feasibility guard keys on PER-SHARD
+    rows — a mesh-sharded flat solve whose shards exceed the proven bound
+    must route to the sharded hierarchical branch, and one whose shards
+    fit must keep the dense sharded path."""
+    from rio_tpu.object_placement import jax_placement as jp_mod
+    from rio_tpu.parallel import make_mesh
+
+    mesh = make_mesh()  # 8 virtual CPU devices (conftest)
+    n_dev = int(mesh.devices.size)
+    members = [f"10.41.0.{i}:70" for i in range(6)]
+    ids = [ObjectId("MeshBig", str(i)) for i in range(700)]  # bucket 1024
+
+    async def run(threshold):
+        p = JaxObjectPlacement(mode="sinkhorn", n_iters=10, mesh=mesh)
+        p.sync_members(members)
+        await p.assign_batch(ids)
+        await p.rebalance()
+        addrs = [await p.lookup(i) for i in ids]
+        assert all(a in members for a in addrs)
+        return p.stats.mode
+
+    # bucket/n_dev = 128 per shard: > 64 routes, > 1024 keeps dense.
+    monkeypatch.setattr(jp_mod, "_FLAT_REBALANCE_MAX_ROWS", 64)
+    assert await run(64) == "sinkhorn+hier_at_scale"
+    monkeypatch.setattr(jp_mod, "_FLAT_REBALANCE_MAX_ROWS", 1024)
+    assert await run(1024) == "sinkhorn"
