@@ -1,0 +1,98 @@
+"""AOT compile-time ladder for the placement pipelines.
+
+The r5 finding: the TPU backend's XLA compile time is superlinear in the
+flat object-row count (hierarchical_assign: 50 s at 655k, 599 s at 2.6M;
+collapsed expansion: ~80 s at 1M, >900 s at 4.2M) while CPU XLA stays
+flat (~7 s). This probe times `jit(...).lower().compile()` — no
+execution, so it is safe to run against a live relay window without
+holding the chip through a long run — across a size ladder for each
+pipeline, printing one JSON line per (pipeline, size).
+
+    env PYTHONPATH=. JAX_PLATFORMS=cpu python tools/compile_probe.py      # CPU control
+    python tools/compile_probe.py --sizes 655360,1310720 --budget 700     # on TPU
+
+Use `--budget` to cap each compile with a watchdog (os._exit, so run it
+as a child process when a wedge-sensitive relay is involved).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _watchdog(seconds: float):
+    t = threading.Timer(seconds, lambda: (print(
+        json.dumps({"event": "watchdog", "after_s": seconds}), flush=True),
+        os._exit(97)))
+    t.daemon = True
+    t.start()
+    return t
+
+
+def probe_hier(n: int, budget: float) -> dict:
+    import jax, jax.numpy as jnp
+    from rio_tpu.parallel.hierarchical import hierarchical_assign
+
+    d, m, g = 16, 1024, 32
+    of = jax.ShapeDtypeStruct((n, d), jnp.float32)
+    rest = [jax.ShapeDtypeStruct((d, m), jnp.float32),
+            jax.ShapeDtypeStruct((m,), jnp.float32),
+            jax.ShapeDtypeStruct((m,), jnp.float32)]
+    w = _watchdog(budget)
+    t0 = time.perf_counter()
+    low = jax.jit(hierarchical_assign, static_argnames=("n_groups",)).lower(of, *rest, n_groups=g)
+    t1 = time.perf_counter()
+    low.compile()
+    t2 = time.perf_counter()
+    w.cancel()
+    return {"pipeline": "hier_flat", "n": n, "lower_s": round(t1 - t0, 1),
+            "compile_s": round(t2 - t1, 1)}
+
+
+def probe_hier_chunked(n: int, budget: float, chunk: int = 655_360) -> dict:
+    import jax, jax.numpy as jnp
+    from rio_tpu.parallel.hierarchical import chunked_hierarchical_assign
+
+    if n % chunk:
+        return {"pipeline": "hier_chunked", "n": n, "skipped": "not chunk-divisible"}
+    d, m, g = 16, 1024, 32
+    of = jax.ShapeDtypeStruct((n, d), jnp.float32)
+    rest = [jax.ShapeDtypeStruct((d, m), jnp.float32),
+            jax.ShapeDtypeStruct((m,), jnp.float32),
+            jax.ShapeDtypeStruct((m,), jnp.float32)]
+    w = _watchdog(budget)
+    t0 = time.perf_counter()
+    jax.jit(
+        chunked_hierarchical_assign, static_argnames=("n_groups", "n_chunks")
+    ).lower(of, *rest, n_groups=g, n_chunks=n // chunk).compile()
+    dt = time.perf_counter() - t0
+    w.cancel()
+    return {"pipeline": "hier_chunked", "n": n, "n_chunks": n // chunk,
+            "lower_plus_compile_s": round(dt, 1)}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes", default="327680,655360,1310720,2621440")
+    ap.add_argument("--budget", type=float, default=900.0)
+    ap.add_argument("--pipelines", default="hier_flat,hier_chunked")
+    args = ap.parse_args()
+    import jax
+
+    print(json.dumps({"backend": jax.default_backend(),
+                      "device": str(jax.devices()[0])}), flush=True)
+    for n in (int(x) for x in args.sizes.split(",")):
+        for p in args.pipelines.split(","):
+            fn = {"hier_flat": probe_hier, "hier_chunked": probe_hier_chunked}[p]
+            print(json.dumps(fn(n, args.budget)), flush=True)
+
+
+if __name__ == "__main__":
+    main()
