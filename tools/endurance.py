@@ -67,9 +67,18 @@ async def main(args: argparse.Namespace) -> None:
         from rio_tpu.parallel import hierarchical as hier_mod  # noqa: F401
         jp_mod._HIER_CHUNK_ROWS = 1024
 
-    placement = JaxObjectPlacement(
-        mode=args.mode, n_iters=10, move_cost=args.move_cost
-    )
+    if args.persistent:
+        from rio_tpu.object_placement.persistent import PersistentJaxObjectPlacement
+        from rio_tpu.object_placement.sqlite import SqliteObjectPlacement
+
+        placement = PersistentJaxObjectPlacement(
+            SqliteObjectPlacement(args.persistent),
+            mode=args.mode, n_iters=10, move_cost=args.move_cost,
+        )
+    else:
+        placement = JaxObjectPlacement(
+            mode=args.mode, n_iters=10, move_cost=args.move_cost
+        )
     stats = {
         "requests": 0, "errors": 0, "churn_cycles": 0, "rebalances": 0,
         "samples": [],
@@ -126,6 +135,8 @@ async def main(args: argparse.Namespace) -> None:
                     "directory": len(placement._placements),
                     "solve_mode": placement.stats.mode,
                 }
+                if hasattr(placement, "_dirty"):
+                    sample["dirty"] = len(placement._dirty)
                 last_req = stats["requests"]
                 stats["samples"].append(sample)
                 print(json.dumps(sample), flush=True)
@@ -176,5 +187,7 @@ if __name__ == "__main__":
     ap.add_argument("--churn-every", type=float, default=45.0)
     ap.add_argument("--sample-every", type=float, default=60.0)
     ap.add_argument("--route-small", action="store_true")
+    ap.add_argument("--persistent", metavar="SQLITE_PATH", default=None,
+                    help="wrap the provider in write-behind persistence on this db")
     ap.add_argument("--cordon", action="store_true")
     asyncio.run(main(ap.parse_args()))
