@@ -161,6 +161,25 @@ async def main(args: argparse.Namespace) -> None:
         placement=placement,
         gossip=True,
     )
+    convergence = None
+    if args.persistent:
+        # The write-behind store must converge to exactly the mirror.
+        from rio_tpu.object_placement.sqlite import SqliteObjectPlacement
+
+        backing = SqliteObjectPlacement(args.persistent)
+        await backing.prepare()
+        stored = {
+            str(it.object_id): it.server_address for it in await backing.items()
+        }
+        mirror = {
+            k: placement._node_order[idx]
+            for k, idx in placement._placements.items()
+        }
+        convergence = "exact" if stored == mirror else (
+            f"DIVERGED: {len(stored)} stored vs {len(mirror)} mirrored, "
+            f"{sum(1 for k in mirror if stored.get(k) != mirror[k])} mismatched"
+        )
+
     first_rss = stats["samples"][1]["rss_mb"] if len(stats["samples"]) > 1 else None
     last_rss = stats["samples"][-1]["rss_mb"] if stats["samples"] else None
     print(json.dumps({
@@ -173,6 +192,7 @@ async def main(args: argparse.Namespace) -> None:
         "rss_final_mb": last_rss,
         "route_small": bool(args.route_small),
         "mode_final": placement.stats.mode,
+        "backing_convergence": convergence,
     }), flush=True)
 
 
