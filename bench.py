@@ -46,6 +46,22 @@ EXIT_TIER_TIMEOUT = 96  # deadline hit after a healthy probe — smaller tier ma
 
 PROBE_DEADLINE_S = 120.0
 
+# Relay-health ceiling for the hier ladder (the bench's only tier whose
+# compile can outgrow its watchdog budget when the relay degrades). Healthy
+# windows pull 4 MB in ~170-350 ms; wedge-preceding degradation measured
+# 747 ms (r4) and 1119 ms (r5 session 2, where the 655k rung's ~45 s
+# compile inflated past the 700 s child budget and the mid-compile watchdog
+# exit re-wedged the relay). Above this, skip the ladder: its evidence is
+# already banked (BENCH_DETAIL.tpu.json baseline_row5_hier) and a skipped
+# rung is recoverable where a wedged relay is not.
+HIER_PULL_MAX_MS = 700.0
+
+# The only keys _write_detail carries forward from a prior tpu capture:
+# scarce hardware evidence. Host-stage numbers (rpc, routing, live-cluster
+# rows) deliberately never carry — they are only meaningful next to the
+# SAME session's sqlite baseline (absolute throughput drifts ±30-40%).
+_CARRYABLE_TIERS = ("collapsed_tier", "solve_tier", "baseline_row5_hier")
+
 
 def sqlite_baseline_rate(n_samples: int = 5000) -> float:
     """Placements/sec for the reference's row-by-row SQL directory."""
@@ -946,6 +962,77 @@ def run_hier_tier(n_obj: int, deadline: float, platform: str = "tpu") -> None:
     probe_timer.cancel()
     if platform == "tpu" and devices[0].platform != "tpu":
         sys.exit(EXIT_INIT_FAIL)
+    fake_pull = os.environ.get("RIO_TPU_HIER_PREFLIGHT_MS")
+    if fake_pull is not None and platform == "tpu":
+        # Rehearsal-only hook: a stale export in the shell must not
+        # silently disable the relay-health gate on a real TPU run.
+        print(
+            "# hier: ignoring RIO_TPU_HIER_PREFLIGHT_MS on tpu "
+            "(rehearsal-only hook)",
+            file=sys.stderr,
+        )
+        fake_pull = None
+    if platform == "tpu" or fake_pull is not None:
+        # Pull-latency pre-flight: the wedge vector is a watchdog os._exit
+        # DURING a long compile, and rising pull latency is the proven
+        # leading indicator (212 ms healthy -> 1119 ms in the run whose
+        # ladder blew its budget). A 4 MB pull completes in bounded time,
+        # so bailing here is a clean exit — never mid-compile.
+        # RIO_TPU_HIER_PREFLIGHT_MS injects a fake measurement so the CPU
+        # rehearsal can execute the skip/force branches end-to-end (this
+        # gate must not be the one piece of ladder logic that first runs
+        # inside a scarce live window — the r4 failure mode).
+        if fake_pull is not None:
+            try:
+                pull_ms = float(fake_pull)
+            except ValueError:
+                print(
+                    f"# hier: bad RIO_TPU_HIER_PREFLIGHT_MS={fake_pull!r}; "
+                    "treating as healthy",
+                    file=sys.stderr,
+                )
+                pull_ms = 0.0
+        else:
+            import numpy as _np
+
+            # Warm one-way pulls, matching the ceiling's calibration data
+            # (the collapsed tier's pull_ms and tpu_probe's pull4mb are
+            # D2H-only; timing the cold H2D upload too would read ~2x
+            # high). Min of 3 because a single tunnel sample is noisy
+            # (healthy windows have pulled 170-970 ms); sustained >700 ms
+            # across all three is the degradation signal. Each sample
+            # needs a FRESH device array: jax.Array caches the host value
+            # after the first device_get, so re-pulling the same array
+            # measures a dict lookup, not the relay. A hung pull must not
+            # burn the whole 700 s budget before its os._exit (a stall
+            # here is still an execution-time exit — the documented-
+            # harmless class — but exiting in seconds beats exiting after
+            # the parent gave up): bound the pre-flight with its own
+            # short watchdog.
+            preflight_timer = _arm_watchdog(90.0, EXIT_TIER_TIMEOUT)
+            pull_ms = float("inf")
+            for _ in range(3):
+                x = jax.device_put(_np.zeros(1 << 20, _np.float32))
+                x.block_until_ready()
+                t0 = time.monotonic()
+                jax.device_get(x)
+                pull_ms = min(pull_ms, (time.monotonic() - t0) * 1e3)
+                del x
+            preflight_timer.cancel()
+        if pull_ms > HIER_PULL_MAX_MS:
+            if os.environ.get("RIO_TPU_BENCH_HIER") == "1":
+                print(
+                    f"# hier: relay degraded (pull4mb {pull_ms:.0f} ms) but "
+                    "RIO_TPU_BENCH_HIER=1 forces the ladder",
+                    file=sys.stderr,
+                )
+            else:
+                print(
+                    f"# hier: relay degraded (pull4mb {pull_ms:.0f} ms > "
+                    f"{HIER_PULL_MAX_MS:.0f} ms ceiling); skipping ladder",
+                    file=sys.stderr,
+                )
+                sys.exit(EXIT_TIER_TIMEOUT)
     try:
         # Ladder of sizes, each banked before the next is attempted: the r4
         # run started straight at quarter size (2.6M), blew the deadline
@@ -1270,12 +1357,66 @@ def _write_detail(detail: dict, here: str | None = None) -> None:
     plat = _detail_platform(detail)
     targets = [os.path.join(here, f"BENCH_DETAIL.{plat}.json")]
     legacy = os.path.join(here, "BENCH_DETAIL.json")
+    out = detail
     if plat == "tpu":
+        # A tier this run SKIPPED (e.g. the hier ladder behind its
+        # relay-health gate) must not erase the banked capture from a
+        # healthier window: carry forward any top-level tpu-run key the
+        # new detail lacks, marked with its provenance. Merge on a COPY —
+        # the caller's dict keeps only this run's numbers, so the later
+        # end-of-run write re-derives what is still missing (a host stage
+        # that has since produced a fresh value sheds the stale marker).
+        # The tpu sidecar is the primary carry source; the legacy file is
+        # the fallback (a crash mid-sidecar-write must not cost the last
+        # banked copy — this run overwrites BOTH targets below).
+        prior = None
+        for cand in (targets[0], legacy):
+            try:
+                with open(cand) as fh:
+                    parsed = json.load(fh)
+            except (OSError, ValueError):
+                continue
+            if isinstance(parsed, dict) and _detail_platform(parsed) == "tpu":
+                prior = parsed
+                break
+        if prior is not None:
+            out = dict(detail)
+            for key, val in prior.items():
+                if key not in _CARRYABLE_TIERS or val is None:
+                    # Only device tiers carry: host-stage numbers (rpc,
+                    # routing, live clusters) are only comparable against
+                    # the same session's sqlite baseline, so pairing a
+                    # prior session's host numbers with this run's
+                    # baseline would fabricate a ratio no session measured.
+                    continue
+                cur = out.get(key)
+                # None counts as missing: a tier that ran but failed (e.g.
+                # solve_tier = None when every dense child exits) must not
+                # clobber the banked capture either.
+                if cur is None:
+                    out[key] = val
+                    out[f"{key}_carried"] = "prior tpu capture"
+                elif (
+                    isinstance(val, dict)
+                    and val.get("platform") == "tpu"
+                    and isinstance(cur, dict)
+                    and cur.get("platform") not in (None, "tpu")
+                ):
+                    # A cpu-fallback tier in an otherwise-tpu run (dense
+                    # children failed, 131k cpu tier filled in) must not
+                    # displace banked hardware numbers in the tpu file;
+                    # keep the fresh fallback under its own key.
+                    out[f"{key}_cpu_fallback"] = cur
+                    out[key] = val
+                    out[f"{key}_carried"] = "prior tpu capture"
         targets.append(legacy)
     else:
         try:
             with open(legacy) as fh:
-                existing_is_tpu = _detail_platform(json.load(fh)) == "tpu"
+                existing = json.load(fh)
+            existing_is_tpu = (
+                isinstance(existing, dict) and _detail_platform(existing) == "tpu"
+            )
         except (OSError, ValueError):
             existing_is_tpu = False
         if not existing_is_tpu:
@@ -1289,7 +1430,7 @@ def _write_detail(detail: dict, here: str | None = None) -> None:
     for path in targets:
         try:
             with open(path, "w") as fh:
-                json.dump(detail, fh, indent=1)
+                json.dump(out, fh, indent=1)
         except OSError as e:  # never let the sidecar kill the headline line
             print(f"# {os.path.basename(path)} write failed: {e}", file=sys.stderr)
 
@@ -1351,11 +1492,30 @@ def main() -> None:
     if result is not None and result.get("platform") == "tpu":
         # BASELINE row 5 (scale ceiling): hierarchical 2-level OT toward
         # 10M x 1k, in its OWN child so an overrun can't cost the banked
-        # headline result; the child sizes itself adaptively.
-        rc, hier = _run_child(10_485_760, "tpu", 700.0, hier=True)
-        if hier:
-            detail["baseline_row5_hier"] = hier
-            print(f"# row-5 hier tier: {hier}", file=sys.stderr)
+        # headline result; the child sizes itself adaptively. Relay-health
+        # gating lives in the CHILD's min-of-3 pull pre-flight (a clean
+        # exit BEFORE its first big compile): a 700 s budget the ladder
+        # fit comfortably in a healthy window (total ~350 s) blows up
+        # INSIDE a compile when the relay degrades, and that mid-compile
+        # watchdog exit is what wedges the relay (r5 session 2). Main
+        # deliberately has no pull_ms gate of its own — a single sample
+        # overlaps the healthy range (170-970 ms) and would spuriously
+        # skip; child init against a degraded relay is safe (init-time
+        # watchdog exits never wedged, 38 observed). RIO_TPU_BENCH_HIER=1
+        # forces past the pre-flight, =0 skips the child entirely.
+        if os.environ.get("RIO_TPU_BENCH_HIER") == "0":
+            print("# hier tier skipped (RIO_TPU_BENCH_HIER=0)", file=sys.stderr)
+        else:
+            rc, hier = _run_child(10_485_760, "tpu", 700.0, hier=True)
+            if hier:
+                detail["baseline_row5_hier"] = hier
+                print(f"# row-5 hier tier: {hier}", file=sys.stderr)
+            elif rc == EXIT_TIER_TIMEOUT:
+                print(
+                    "# hier tier skipped by child pre-flight (relay "
+                    "degraded); banked evidence stands",
+                    file=sys.stderr,
+                )
     # Device tiers are done — bank them NOW, before the host-side stages
     # (a crash in a live-cluster stage must not cost banked TPU evidence).
     detail["solve_tier"] = result

@@ -54,3 +54,156 @@ def test_corrupt_legacy_file_is_replaced_not_fatal(tmp_path):
     (tmp_path / "BENCH_DETAIL.json").write_text("{not json")
     _write_detail({"solve_tier": {"platform": "cpu"}}, here=str(tmp_path))
     assert _detail_platform(_read(tmp_path, "BENCH_DETAIL.json")) == "cpu"
+
+
+def test_tpu_run_carries_forward_missing_tiers_with_provenance(tmp_path):
+    """A skipped tier (e.g. hier ladder behind its relay-health gate) must
+    not erase the banked capture from a healthier window."""
+    _write_detail(
+        {
+            "solve_tier": {"platform": "tpu", "run": 1},
+            "baseline_row5_hier": {"ok": True, "run": 1},
+        },
+        here=str(tmp_path),
+    )
+    # Next tpu run skipped the hier tier entirely.
+    fresh = {"solve_tier": {"platform": "tpu", "run": 2}}
+    _write_detail(fresh, here=str(tmp_path))
+    for name in ("BENCH_DETAIL.tpu.json", "BENCH_DETAIL.json"):
+        banked = _read(tmp_path, name)
+        assert banked["solve_tier"]["run"] == 2
+        assert banked["baseline_row5_hier"]["run"] == 1
+        assert banked["baseline_row5_hier_carried"] == "prior tpu capture"
+    # The caller's dict is untouched (later writes re-derive the merge).
+    assert "baseline_row5_hier" not in fresh
+    # A third run that DID capture the tier sheds both value and marker.
+    _write_detail(
+        {
+            "solve_tier": {"platform": "tpu", "run": 3},
+            "baseline_row5_hier": {"ok": True, "run": 3},
+        },
+        here=str(tmp_path),
+    )
+    banked = _read(tmp_path, "BENCH_DETAIL.tpu.json")
+    assert banked["baseline_row5_hier"]["run"] == 3
+    assert "baseline_row5_hier_carried" not in banked
+
+
+def test_cpu_sidecar_never_receives_carried_tpu_keys(tmp_path):
+    _write_detail(
+        {
+            "solve_tier": {"platform": "tpu", "run": 1},
+            "baseline_row5_hier": {"ok": True},
+        },
+        here=str(tmp_path),
+    )
+    _write_detail({"solve_tier": {"platform": "cpu", "run": 2}}, here=str(tmp_path))
+    cpu = _read(tmp_path, "BENCH_DETAIL.cpu.json")
+    assert "baseline_row5_hier" not in cpu and "baseline_row5_hier_carried" not in cpu
+
+
+def test_none_valued_tier_does_not_clobber_banked_capture(tmp_path):
+    """solve_tier = None (every dense child failed) counts as missing."""
+    _write_detail(
+        {
+            "collapsed_tier": {"platform": "tpu", "run": 1},
+            "solve_tier": {"platform": "tpu", "run": 1},
+        },
+        here=str(tmp_path),
+    )
+    _write_detail(
+        {"collapsed_tier": {"platform": "tpu", "run": 2}, "solve_tier": None},
+        here=str(tmp_path),
+    )
+    banked = _read(tmp_path, "BENCH_DETAIL.tpu.json")
+    assert banked["collapsed_tier"]["run"] == 2
+    assert banked["solve_tier"]["run"] == 1
+    assert banked["solve_tier_carried"] == "prior tpu capture"
+
+
+def test_cpu_fallback_tier_cannot_displace_banked_tpu_tier(tmp_path):
+    """Dense TPU children failed; the 131k cpu fallback filled solve_tier —
+    the tpu file keeps the hardware capture, fallback under its own key."""
+    _write_detail(
+        {
+            "collapsed_tier": {"platform": "tpu", "run": 1},
+            "solve_tier": {"platform": "tpu", "run": 1},
+        },
+        here=str(tmp_path),
+    )
+    _write_detail(
+        {
+            "collapsed_tier": {"platform": "tpu", "run": 2},
+            "solve_tier": {"platform": "cpu", "run": 2},
+        },
+        here=str(tmp_path),
+    )
+    banked = _read(tmp_path, "BENCH_DETAIL.tpu.json")
+    assert banked["solve_tier"] == {"platform": "tpu", "run": 1}
+    assert banked["solve_tier_carried"] == "prior tpu capture"
+    assert banked["solve_tier_cpu_fallback"] == {"platform": "cpu", "run": 2}
+
+
+def test_prior_none_value_is_not_carried_as_capture(tmp_path):
+    _write_detail(
+        {"collapsed_tier": {"platform": "tpu", "run": 1}, "solve_tier": None},
+        here=str(tmp_path),
+    )
+    _write_detail(
+        {"collapsed_tier": {"platform": "tpu", "run": 2}, "solve_tier": None},
+        here=str(tmp_path),
+    )
+    banked = _read(tmp_path, "BENCH_DETAIL.tpu.json")
+    assert banked["solve_tier"] is None
+    assert "solve_tier_carried" not in banked
+
+
+def test_non_dict_prior_files_are_tolerated(tmp_path):
+    (tmp_path / "BENCH_DETAIL.tpu.json").write_text("[1, 2]")
+    (tmp_path / "BENCH_DETAIL.json").write_text("\"x\"")
+    _write_detail({"solve_tier": {"platform": "tpu", "run": 1}}, here=str(tmp_path))
+    assert _read(tmp_path, "BENCH_DETAIL.tpu.json")["solve_tier"]["run"] == 1
+    (tmp_path / "BENCH_DETAIL.json").write_text("[]")
+    _write_detail({"solve_tier": {"platform": "cpu", "run": 2}}, here=str(tmp_path))
+    assert _read(tmp_path, "BENCH_DETAIL.json")["solve_tier"]["run"] == 2
+
+
+def test_host_stage_keys_never_carry_forward(tmp_path):
+    """Prior rpc numbers must not pair with a fresh session's baseline."""
+    _write_detail(
+        {
+            "sqlite_baseline_rate": 100000,
+            "collapsed_tier": {"platform": "tpu", "run": 1},
+            "rpc_msgs_per_sec": {"asyncio": 20000},
+        },
+        here=str(tmp_path),
+    )
+    _write_detail(
+        {
+            "sqlite_baseline_rate": 40000,
+            "collapsed_tier": {"platform": "tpu", "run": 2},
+        },
+        here=str(tmp_path),
+    )
+    banked = _read(tmp_path, "BENCH_DETAIL.tpu.json")
+    assert banked["sqlite_baseline_rate"] == 40000
+    assert "rpc_msgs_per_sec" not in banked
+    assert banked["collapsed_tier"]["run"] == 2
+
+
+def test_carry_falls_back_to_legacy_when_tpu_sidecar_corrupt(tmp_path):
+    _write_detail(
+        {
+            "collapsed_tier": {"platform": "tpu", "run": 1},
+            "baseline_row5_hier": {"ok": True, "run": 1},
+        },
+        here=str(tmp_path),
+    )
+    (tmp_path / "BENCH_DETAIL.tpu.json").write_text("{trunc")
+    _write_detail(
+        {"collapsed_tier": {"platform": "tpu", "run": 2}}, here=str(tmp_path)
+    )
+    for name in ("BENCH_DETAIL.tpu.json", "BENCH_DETAIL.json"):
+        banked = _read(tmp_path, name)
+        assert banked["collapsed_tier"]["run"] == 2
+        assert banked["baseline_row5_hier"]["run"] == 1
