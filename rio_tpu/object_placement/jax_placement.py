@@ -202,6 +202,55 @@ def _pad_feature_block(pad: int, dim: int) -> np.ndarray:
     )
 
 
+def _least_loaded_spread(load, alive, cap, n_real: int, count: int) -> np.ndarray:
+    """Deterministic seats when the solver can't provide them: REAL
+    nodes only, schedulable (alive AND capacity > 0) nodes before the
+    rest, least-loaded first — and round-robin over ONLY the
+    schedulable prefix when one exists (seating overflow on a dead,
+    cordoned, or capacity-zero node while schedulable capacity exists
+    would break cordon's no-new-seats contract and the operator's
+    capacity=0 don't-place-here signal). When NO node is schedulable
+    (the all-dead blip) every real node cycles — any real seat beats a
+    pad index, and an alive-but-zero-capacity node must not absorb the
+    whole cluster's overflow alone. (Load alone can't order this:
+    ``clean_server`` zeroes a dead node's load, ranking fresh corpses
+    first.)"""
+    if n_real <= 0:
+        raise ValueError("placement solve with no registered nodes")
+    a = np.asarray(alive)[:n_real]
+    c = np.asarray(cap)[:n_real]
+    sched = (a > 0) & (c > 0)
+    order = np.lexsort((np.asarray(load)[:n_real], ~sched))
+    n_sched = int(sched.sum())
+    cycle = order[:n_sched] if n_sched > 0 else order
+    return cycle[np.arange(count) % len(cycle)].astype(np.int32)
+
+
+def _route_unseatable(
+    assignment: np.ndarray, n_real: int, load: np.ndarray, alive, cap
+) -> np.ndarray:
+    """Defensive clamp: solver output must index the REAL node axis.
+
+    Solvers run over the padded power-of-two node axis; pad slots carry
+    zero capacity and are normally unreachable, and the zero-schedulable-
+    capacity snapshot that CAN reach them (every node dead at once) is
+    short-circuited before any solve (see ``_solve_chunk`` /
+    ``rebalance``). This guard is the belt-and-braces behind that: if any
+    other degenerate numerical case ever clips a row onto a pad slot, a
+    pad index entering the directory would blow up every later
+    ``_node_order[idx]`` resolution (lookup, persistence marks, load
+    recount) — route such rows through the shared spread instead.
+    """
+    bad = assignment >= n_real
+    if not bad.any():
+        return assignment  # load/alive stay un-pulled (device arrays on TPU)
+    out = assignment.copy()
+    out[bad] = _least_loaded_spread(
+        load, alive, cap, n_real, int(bad.sum())
+    ).astype(assignment.dtype)
+    return out
+
+
 def _guard_sentinel_spill(repaired, real, m_axis: int, cap_alive):
     """Shared guard (see :func:`rio_tpu.ops.sinkhorn.route_sentinel_spill`);
     r4 trigger here: 10M objects, bucket 16,777,216 = exactly the fp32
@@ -514,6 +563,17 @@ class JaxObjectPlacement(ObjectPlacement):
             alive[s.index] = 1.0 if (s.alive and not s.cordoned) else 0.0
         return jnp.asarray(load), jnp.asarray(cap), jnp.asarray(alive)
 
+    def _no_schedulable_capacity_host(self) -> bool:
+        """Loop-side zero-capacity predicate over HOST node state, taken at
+        the same moment as the ``_node_vectors`` snapshot. Never reads the
+        device arrays: an eager device->host pull per placement chunk costs
+        ~300 ms through the TPU tunnel, and this predicate runs on every
+        chunk and every rebalance."""
+        return not any(
+            s.alive and not s.cordoned and s.capacity > 0
+            for s in self._nodes.values()
+        )
+
     def _recount_loads(self) -> None:
         for s in self._nodes.values():
             s.load = float(len(self._by_node.get(s.index, ())))
@@ -631,15 +691,30 @@ class JaxObjectPlacement(ObjectPlacement):
         # (and, between lock holds, any interleaved mutator) changed load.
         load, cap, alive = self._node_vectors()
         g = self._g
+        n_real = len(self._node_order)  # snapshot: the thread reads no live state
+        no_capacity = self._no_schedulable_capacity_host()
         assignment = await asyncio.to_thread(
-            self._solve_chunk, chunk, load, cap, alive, g
+            self._solve_chunk, chunk, load, cap, alive, g, n_real, no_capacity
         )
         self._apply_chunk(chunk, assignment)
 
-    def _solve_chunk(self, keys, load, cap, alive, g) -> np.ndarray:
+    def _solve_chunk(
+        self, keys, load, cap, alive, g, n_real, no_capacity=False
+    ) -> np.ndarray:
         """Device solve for one placement chunk over loop-side snapshots;
         reads NO live provider state, mutates nothing (thread-safe)."""
         n = len(keys)
+        if no_capacity:
+            # Every node dead (or cordoned) at once, e.g. a clean_server
+            # storm or a gossip blip marking the whole cluster inactive
+            # between ticks (found by the 80-wave soak at wave 46). The
+            # waterfill degenerates here (all-zero widths clip every row
+            # onto one worst-scored slot, real or pad), so don't solve:
+            # seat deterministically via the shared spread. Reference
+            # semantics: placement rows outlive their owner
+            # (rio-rs/src/service.rs:213-238 re-seats on the next
+            # request); the next liveness change re-solves.
+            return _least_loaded_spread(load, alive, cap, n_real, n)
         cost = build_cost_matrix(load, cap, alive)  # (1, n_nodes)
         if g is not None:
             # Warm path: bias the score by the cached node potentials from the
@@ -651,9 +726,13 @@ class JaxObjectPlacement(ObjectPlacement):
         mass = jnp.concatenate(
             [jnp.ones((n,), jnp.float32), jnp.zeros((bucket - n,), jnp.float32)]
         )
-        return np.asarray(
-            greedy_balanced_assign(rows, mass, cap * alive, load)
-        )[:n]
+        return _route_unseatable(
+            np.asarray(greedy_balanced_assign(rows, mass, cap * alive, load))[:n],
+            n_real,
+            load,
+            alive,
+            cap,
+        )
 
     def _apply_chunk(self, keys: list[str], assignment: np.ndarray) -> None:
         for k, idx in zip(keys, assignment.tolist()):
@@ -822,6 +901,7 @@ class JaxObjectPlacement(ObjectPlacement):
             self._recount_loads()
             load, cap, alive = self._node_vectors()
             node_order = list(self._node_order)  # snapshot for off-lock use
+            no_capacity = self._no_schedulable_capacity_host()
         if not keys:
             return 0
 
@@ -833,6 +913,20 @@ class JaxObjectPlacement(ObjectPlacement):
             live — and makes the epoch-discard check below load-bearing.
             Only the snapshots taken under the lock are read here."""
             t0 = time.perf_counter()
+            from ..tracing import span
+
+            if no_capacity:
+                # Zero schedulable capacity (all nodes dead/cordoned at
+                # once): reshuffling seats among dead nodes is pure churn
+                # and the degenerate waterfill/OT outputs are meaningless —
+                # stay put until liveness returns, recorded as its own
+                # mode (span included, so trace tooling sees the outage
+                # mode next to its SolveStats entry).
+                solved_as = f"{mode}+no_capacity"
+                with span("placement_solve", mode=solved_as, n=n):
+                    return cur_idx.copy(), None, (
+                        time.perf_counter() - t0
+                    ) * 1e3, solved_as
             # Decide the actual code path up front so traces, profiler
             # labels, and SolveStats.mode all agree on what ran.
             collapse = mode in ("sinkhorn", "scaling") and self._mesh is None
@@ -862,8 +956,6 @@ class JaxObjectPlacement(ObjectPlacement):
                 if route_hier
                 else f"{mode}+collapsed" if collapse else mode
             )
-            from ..tracing import span
-
             with span("placement_solve", mode=solved_as, n=n), _profiler_trace(
                 f"rio_tpu.solve.{solved_as}"
             ):
@@ -1040,7 +1132,9 @@ class JaxObjectPlacement(ObjectPlacement):
                         )
                         assignment = jnp.where(keep, cur, refill)
                         g = None
-            out = np.asarray(assignment)[:n]
+            out = _route_unseatable(
+                np.asarray(assignment)[:n], len(node_order), load, alive, cap
+            )
             return out, g, (time.perf_counter() - t0) * 1e3, solved_as
 
         assignment, g, solve_ms, solved_as = await asyncio.to_thread(_solve)

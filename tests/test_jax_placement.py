@@ -793,3 +793,102 @@ async def test_mesh_flat_rebalance_routes_by_per_shard_rows(monkeypatch):
     assert await run(64) == "sinkhorn+hier_at_scale"
     monkeypatch.setattr(jp_mod, "_FLAT_REBALANCE_MAX_ROWS", 1024)
     assert await run(1024) == "sinkhorn"
+
+
+async def test_assign_with_every_node_dead_still_seats_on_real_nodes():
+    """A clean_server storm can mark EVERY node dead between gossip ticks
+    (80-wave soak, wave 46): the waterfill's width vector collapses and,
+    unguarded, searchsorted clipped rows onto padded-axis slots — a pad
+    index in the directory then blew up every _node_order[idx] resolution
+    (IndexError in the persistence mark was the observed symptom). The
+    directory must still seat such objects on REAL nodes (reference
+    semantics: placement rows outlive their owner, service.rs:213-238);
+    the next liveness change re-seats them."""
+    p = JaxObjectPlacement(mode="greedy", move_cost=0.5)
+    members = [f"10.9.0.{i}:70" for i in range(6)]
+    p.sync_members(members)
+    ids = [ObjectId("Dead", str(i)) for i in range(40)]
+    await p.assign_batch(ids[:10])
+    for a in members:
+        await p.clean_server(a)  # every node now dead, loads zeroed
+    addrs = await p.assign_batch(ids[10:])
+    assert all(a in members for a in addrs)
+    # Spread, not a single-node pileup: least-loaded round-robin.
+    assert len(set(addrs)) == len(members)
+    # Rebalance with the all-dead snapshot must not corrupt the directory
+    # either (same funnel guard, every solver mode).
+    await p.rebalance()
+    for i in ids[10:]:
+        assert await p.lookup(i) in members
+    # Recovery: liveness returns -> the next rebalance re-seats cleanly.
+    p.sync_members(members)
+    await p.rebalance()
+    for i in ids[10:]:
+        assert await p.lookup(i) in members
+    _ = p.count()
+
+
+async def test_gossip_blip_marking_all_nodes_dead_spreads_and_stays_put():
+    """The sync_members variant of the all-dead case (loads retained, no
+    clean_server): the unguarded waterfill piled the whole batch onto ONE
+    worst-scored node here — the condition-level guard must spread the
+    batch least-loaded round-robin, and a rebalance under zero capacity
+    must STAY PUT (reshuffling among dead nodes is pure churn) and say so
+    in its stats mode."""
+    p = JaxObjectPlacement(mode="sinkhorn", n_iters=8, move_cost=0.5)
+    members = [f"10.9.1.{i}:70" for i in range(6)]
+    p.sync_members(members)
+    ids = [ObjectId("Blip", str(i)) for i in range(36)]
+    await p.assign_batch(ids[:12])
+    before = {str(i): await p.lookup(i) for i in ids[:12]}
+
+    class _Dead:
+        def __init__(self, a):
+            self._a = a
+            self.active = False
+        def address(self):
+            return self._a
+
+    p.sync_members([_Dead(a) for a in members])  # every node inactive
+    addrs = await p.assign_batch(ids[12:])
+    assert all(a in members for a in addrs)
+    assert len(set(addrs)) == len(members)  # spread, not a pileup
+    moved = await p.rebalance()
+    assert moved == 0
+    assert p.stats.mode.endswith("+no_capacity")
+    for i in ids[:12]:  # pre-blip seats untouched
+        assert await p.lookup(i) == before[str(i)]
+    # Liveness returns: the next rebalance runs the real solver again.
+    p.sync_members(members)
+    await p.rebalance()
+    assert not p.stats.mode.endswith("+no_capacity")
+    for i in ids:
+        assert await p.lookup(i) in members
+
+
+def test_least_loaded_spread_prefers_schedulable_prefix():
+    """Overflow seats cycle ONLY schedulable (alive AND capacity>0) nodes
+    while any exist (cordon's no-new-seats contract, and the operator's
+    capacity=0 don't-place-here signal); dead nodes' zeroed loads must not
+    rank them first."""
+    from rio_tpu.object_placement.jax_placement import _least_loaded_spread
+
+    load = np.array([5, 0, 3, 1], np.float32)  # node 1 dead, load zeroed
+    alive = np.array([1, 0, 1, 1], np.float32)
+    cap = np.ones(4, np.float32)
+    out = _least_loaded_spread(load, alive, cap, 4, 7)
+    assert 1 not in out.tolist()
+    assert out[0] == 3  # least-loaded schedulable node first
+    # A lone alive node with capacity=0 must NOT absorb the whole batch
+    # while other schedulable nodes exist.
+    cap0 = np.array([1, 1, 1, 0], np.float32)
+    out = _least_loaded_spread(load, alive, cap0, 4, 7)
+    assert 3 not in out.tolist() and 1 not in out.tolist()
+    # All-dead: every real node cycles (any seat beats a pad index).
+    out = _least_loaded_spread(load, np.zeros(4, np.float32), cap, 4, 8)
+    assert sorted(set(out.tolist())) == [0, 1, 2, 3]
+    # All-dead-or-capacity-zero: still spreads over every real node
+    # rather than piling onto the lone alive capacity-zero node.
+    alive_only3 = np.array([0, 0, 0, 1], np.float32)
+    out = _least_loaded_spread(load, alive_only3, cap0, 4, 8)
+    assert sorted(set(out.tolist())) == [0, 1, 2, 3]
