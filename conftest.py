@@ -24,6 +24,11 @@ force_cpu(n_devices=8)
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: run async test on a fresh event loop")
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running test (1M-actor stress, soak, multihost); "
+        "tier-1 verify runs -m 'not slow'",
+    )
 
 
 def pytest_pyfunc_call(pyfuncitem):

@@ -24,8 +24,15 @@ from .message_router import MessageRouter
 from .object_placement import LocalObjectPlacement, ObjectPlacement, ObjectPlacementItem
 from .registry import ObjectId, Registry, handler, message, type_id, type_name, wire_error
 from .registry.declarative import RegistryDeclaration, make_registry
+from .reminders import LocalReminderStorage, Reminder, ReminderStorage
+from .reminders.daemon import ReminderDaemonConfig
 from .server import Server
-from .service_object import LifecycleKind, LifecycleMessage, ServiceObject
+from .service_object import (
+    LifecycleKind,
+    LifecycleMessage,
+    ReminderFired,
+    ServiceObject,
+)
 
 __version__ = "0.7.2"  # tracks the surveyed reference version (pyproject.toml)
 
@@ -51,6 +58,11 @@ __all__ = [
     "ObjectPlacementItem",
     "Registry",
     "RegistryDeclaration",
+    "Reminder",
+    "ReminderDaemonConfig",
+    "ReminderFired",
+    "ReminderStorage",
+    "LocalReminderStorage",
     "RioError",
     "Server",
     "ServerInfo",

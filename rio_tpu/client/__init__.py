@@ -468,6 +468,18 @@ class ClientBuilder:
         self._timeout = seconds
         return self
 
+    def backoff(self, policy: ExponentialBackoff) -> "ClientBuilder":
+        """Retry/backoff policy for request sends (see
+        :class:`~rio_tpu.utils.backoff.ExponentialBackoff`)."""
+        self._backoff_policy = policy
+        return self
+
+    def membership_view_ttl(self, seconds: float) -> "ClientBuilder":
+        """How long the cached active-servers view is trusted before a
+        storage refetch."""
+        self._view_ttl_value = seconds
+        return self
+
     def transport(self, transport: str) -> "ClientBuilder":
         """Socket/framing backend: "asyncio" (default), "native", or "auto"."""
         if transport not in ("asyncio", "native", "auto"):
@@ -490,6 +502,8 @@ class ClientBuilder:
             placement_cache_size=self._lru,
             pool_per_server=self._pool,
             connect_timeout=self._timeout,
+            backoff=getattr(self, "_backoff_policy", None),
             transport=getattr(self, "_transport", "asyncio"),
             placement_resolver=getattr(self, "_resolver", None),
+            membership_view_ttl=getattr(self, "_view_ttl_value", 1.0),
         )

@@ -15,6 +15,15 @@ Opt in per node::
 
 The daemon is a no-op for placement providers without the solver surface
 (``sync_members``/``rebalance``), so it is safe to enable unconditionally.
+
+Reminder-shard seats (``rio.ReminderShard`` rows written by
+:class:`~rio_tpu.reminders.daemon.ReminderDaemon`) are ordinary directory
+rows, so a rebalance here re-seats them like any object — deliberately:
+tick load reported through the provider's ``AffinityTracker`` makes hot
+shards expensive, and the solver moves them to capacity. The reminder
+daemons follow the directory (release the lease when seated elsewhere) and
+lease-steal seats the solver lands on nodes that run no reminder daemon,
+so a re-seat never strands a shard.
 """
 
 from __future__ import annotations

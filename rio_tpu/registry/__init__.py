@@ -106,9 +106,13 @@ class Registry:
         tname = type_id(cls)
         self._constructors[tname] = constructor or cls
         for spec in resolve_handlers(cls):
-            # Lifecycle dispatch (activation Load) is framework plumbing and
-            # must exist regardless of the declared message surface.
-            if auto_handlers or spec.message_type_name == "rio.LifecycleMessage":
+            # Lifecycle dispatch (activation Load) and reminder wakeups are
+            # framework plumbing and must exist regardless of the declared
+            # message surface.
+            if auto_handlers or spec.message_type_name in (
+                "rio.LifecycleMessage",
+                "rio.ReminderFired",
+            ):
                 self._handlers[(tname, spec.message_type_name)] = spec
         return self
 

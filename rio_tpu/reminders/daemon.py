@@ -1,0 +1,327 @@
+"""ReminderDaemon: the per-node scheduler that ticks owned shards.
+
+One daemon runs inside every ``Server(..., reminder_daemon=True)`` as a
+``run()`` child task (beside the placement daemon). Each poll it walks the
+shard space and enforces a three-layer ownership protocol:
+
+1. **Directory seat** (``ObjectPlacement``): each shard is a directory row
+   ``ObjectId("rio.ReminderShard", str(shard))`` — the same trait every
+   service object is seated through, so ``JaxObjectPlacement`` folds shards
+   into its device solve and the placement daemon reseats them on churn
+   like any other population. An *unowned* shard is claimed by its
+   rendezvous-preferred node (``sorted(active)[shard % n]``) so a cold
+   cluster spreads shards without coordination; a shard whose seated owner
+   left the active set is freed exactly the way the service layer frees
+   dead owners (``clean_server``). When the directory seats a shard on a
+   *different* live node (e.g. a solver rebalance moved it), this daemon
+   releases its lease and stops ticking — the directory is the scheduling
+   authority.
+2. **Lease** (``ReminderStorage``): the directory is eventually consistent
+   under races, so the storage-side lease (TTL + monotone epoch) is what
+   guarantees at most one node ticks a shard at a time. A node only scans
+   a shard while holding its unexpired lease.
+3. **Delivery**: each due reminder becomes a ``rio.ReminderFired`` message
+   sent through an internal cluster :class:`~rio_tpu.client.Client`
+   (placement → redirect → retry with ``utils/backoff``) to the target
+   object, activating it wherever placement wants it — an ordinary request
+   on the existing wire protocol, no new frame kind. The reminder is
+   rescheduled only *after* the send resolves: a transport-level failure
+   leaves ``next_due`` in the past and the next poll retries —
+   **at-least-once** delivery.
+
+Missed-tick catch-up (node died mid-window, shard re-owned after the lease
+expired): the first post-recovery fire carries ``missed`` (how many whole
+periods were lost). ``catchup="skip"`` (default) jumps ``next_due`` past
+the gap but stays phase-aligned with the original schedule;
+``catchup="all"`` advances one period per fire, replaying every missed tick
+on successive scans.
+
+Tick-rate feeds placement cost: after each scan the daemon reports the
+shard's delivered-tick volume into the provider's ``AffinityTracker`` (when
+one is wired), so hot shards weigh more in the hierarchical OT solve —
+reminder-shard ownership *is* a granular allocation problem.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import time
+from dataclasses import dataclass, field
+
+from ..client import Client
+from ..cluster.storage import MembershipStorage
+from ..object_placement import ObjectPlacement, ObjectPlacementItem
+from ..registry import ObjectId
+from ..service_object import ReminderFired
+from ..utils import ExponentialBackoff
+from . import Reminder, ReminderStorage
+
+log = logging.getLogger("rio_tpu.reminders")
+
+#: Directory type name under which shard seats live. A reserved framework
+#: kind — registries never construct it; only the daemons read/write it.
+SHARD_TYPE = "rio.ReminderShard"
+
+
+@dataclass
+class ReminderDaemonConfig:
+    """Tunables; defaults sized for human-scale periods (seconds+).
+
+    Tests shrink everything to tens of milliseconds — every interval is a
+    plain float, nothing is quantized.
+    """
+
+    poll_interval: float = 1.0
+    # Lease TTL. Failover bound: after an owner dies unannounced, a
+    # survivor ticks its shards within ttl + one poll.
+    lease_ttl: float = 5.0
+    # Max due rows delivered per shard per poll (backpressure bound).
+    batch: int = 256
+    catchup: str = "skip"  # "skip" (phase-aligned jump) | "all" (replay)
+    # Delivery client's retry policy (at-least-once inner loop). Bounded
+    # small: the poll loop is the outer retry and must not starve sibling
+    # reminders behind one dead target.
+    delivery_backoff: ExponentialBackoff = field(
+        default_factory=lambda: ExponentialBackoff(initial=0.01, cap=0.25, max_retries=4)
+    )
+
+
+@dataclass
+class ReminderDaemonStats:
+    polls: int = 0
+    owned_shards: int = 0  # gauge: shards leased as of the last poll
+    claims: int = 0  # directory seats this node took
+    releases: int = 0  # leases handed back (reseat elsewhere / drain)
+    ticks: int = 0  # reminders delivered
+    missed_ticks: int = 0  # periods skipped by catch-up accounting
+    delivery_failures: int = 0  # transport-level; reminder stays due
+    errors: int = 0
+
+
+class ReminderDaemon:
+    """Poll loop: claim/renew shard ownership, scan due reminders, deliver."""
+
+    def __init__(
+        self,
+        *,
+        address: str,
+        members_storage: MembershipStorage,
+        placement: ObjectPlacement,
+        storage: ReminderStorage,
+        config: ReminderDaemonConfig | None = None,
+        client: Client | None = None,
+    ) -> None:
+        self.address = address
+        self.members_storage = members_storage
+        self.placement = placement
+        self.storage = storage
+        self.config = config or ReminderDaemonConfig()
+        self.stats = ReminderDaemonStats()
+        self._client = client
+        self._held: dict[int, int] = {}  # shard -> lease epoch we hold
+        self._draining = False
+
+    def _get_client(self) -> Client:
+        if self._client is None:
+            self._client = Client(
+                self.members_storage, backoff=self.config.delivery_backoff
+            )
+        return self._client
+
+    # ------------------------------------------------------------------
+    # Ownership
+    # ------------------------------------------------------------------
+
+    def _preferred(self, shard: int, active: list[str]) -> str | None:
+        """Rendezvous tie-break for UNOWNED shards: all nodes sort the same
+        active set, so they agree on who claims without coordination."""
+        if not active:
+            return None
+        return sorted(active)[shard % len(active)]
+
+    async def _resolve_owner(self, shard: int, active: set[str], now: float) -> str | None:
+        oid = ObjectId(SHARD_TYPE, str(shard))
+        owner = await self.placement.lookup(oid)
+        if owner is not None and owner != self.address and owner not in active:
+            # Dead owner: free everything it held (mirrors the service
+            # layer's dead-owner path, service.rs:227-238).
+            await self.placement.clean_server(owner)
+            owner = None
+        if owner is not None and owner != self.address and owner in active:
+            # Live seated owner that is provably not ticking: its lease has
+            # lapsed a full TTL past expiry (or was never taken). Happens
+            # when a solver rebalance seats the shard on a node without a
+            # reminder daemon, or a claimant died between seat and lease.
+            # Steal through the lease (storage serializes to one winner)
+            # and move the seat to the actual ticker.
+            if not self._draining and await self._seat_is_stale(shard, owner, now):
+                lease = await self.storage.acquire_lease(
+                    shard, self.address, self.config.lease_ttl, now
+                )
+                if lease is not None:
+                    self._held[shard] = lease.epoch
+                    await self.placement.update(
+                        ObjectPlacementItem(object_id=oid, server_address=self.address)
+                    )
+                    self.stats.claims += 1
+                    return self.address
+        if owner is None and not self._draining:
+            if self._preferred(shard, sorted(active)) == self.address:
+                await self.placement.update(
+                    ObjectPlacementItem(object_id=oid, server_address=self.address)
+                )
+                self.stats.claims += 1
+                owner = self.address
+        return owner
+
+    async def _seat_is_stale(self, shard: int, owner: str, now: float) -> bool:
+        lease = await self.storage.get_lease(shard)
+        if lease is None:
+            return True  # seated but never ticked
+        if lease.owner != owner:
+            return False  # directory lag behind a lease someone else holds
+        return lease.expires_at + self.config.lease_ttl <= now
+
+    async def _release_held(self, shard: int) -> None:
+        epoch = self._held.pop(shard, None)
+        if epoch is not None:
+            self.stats.releases += 1
+            with contextlib.suppress(Exception):
+                await self.storage.release_lease(shard, self.address, epoch)
+
+    async def poll_once(self, now: float | None = None) -> None:
+        """One full pass over the shard space."""
+        now = time.time() if now is None else now
+        cfg = self.config
+        active = {m.address for m in await self.members_storage.active_members()}
+        owned = 0
+        for shard in range(self.storage.num_shards):
+            if self._draining:
+                break
+            owner = await self._resolve_owner(shard, active, now)
+            if owner != self.address:
+                # Seated elsewhere (or unclaimed and not ours to claim):
+                # make sure we are not still ticking it.
+                await self._release_held(shard)
+                continue
+            lease = await self.storage.acquire_lease(
+                shard, self.address, cfg.lease_ttl, now
+            )
+            if lease is None:
+                # Directory says us, lease says someone else: the previous
+                # owner's lease has not expired yet. Back off until it does.
+                self._held.pop(shard, None)
+                continue
+            self._held[shard] = lease.epoch
+            owned += 1
+            await self._tick_shard(shard, now)
+        self.stats.owned_shards = owned
+
+    # ------------------------------------------------------------------
+    # Ticking
+    # ------------------------------------------------------------------
+
+    async def _tick_shard(self, shard: int, now: float) -> None:
+        cfg = self.config
+        due = await self.storage.due(shard, now, cfg.batch)
+        delivered = 0
+        for rem in due:
+            missed = max(0, int((now - rem.next_due) // rem.period))
+            fired = ReminderFired(name=rem.reminder_name, due=rem.next_due, missed=missed)
+            if not await self._deliver(rem, fired):
+                # Transport-level failure: next_due stays in the past and
+                # the next poll retries — the at-least-once outer loop.
+                self.stats.delivery_failures += 1
+                continue
+            delivered += 1
+            self.stats.ticks += 1
+            if cfg.catchup == "all":
+                next_due = rem.next_due + rem.period  # replay the backlog
+            else:  # "skip": jump the gap, keep the original phase
+                self.stats.missed_ticks += missed
+                next_due = rem.next_due + (missed + 1) * rem.period
+            await self.storage.reschedule(
+                rem.object_kind, rem.object_id, rem.reminder_name, next_due
+            )
+        if delivered:
+            self._observe_load(shard, delivered)
+
+    def _observe_load(self, shard: int, ticks: int) -> None:
+        """Feed the shard's tick volume into the placement provider's
+        affinity tracker (when wired): tick-rate becomes cost in the
+        hierarchical OT solve, so the solver seats hot shards where
+        capacity is."""
+        tracker = getattr(self.placement, "affinity_tracker", None)
+        if tracker is None:
+            return
+        with contextlib.suppress(Exception):
+            tracker.observe(f"{SHARD_TYPE}.{shard}", self.address, weight=float(ticks))
+
+    async def _deliver(self, rem: Reminder, fired: ReminderFired) -> bool:
+        """Send one tick; True when the tick is considered fired.
+
+        An exception *raised by the target's handler* (typed application
+        error, unsupported type, panic) still counts as fired — the actor
+        ran (or terminally cannot); retrying each poll would hot-loop.
+        Only transport-level failures (owner unreachable, retries
+        exhausted) leave the reminder due.
+        """
+        from ..errors import Disconnect, RetryExhausted, ServerNotAvailable
+
+        try:
+            await self._get_client().send(rem.object_kind, rem.object_id, fired)
+            return True
+        except (RetryExhausted, ServerNotAvailable, Disconnect, OSError) as e:
+            log.warning(
+                "reminder %s/%s/%s undelivered (%r); will retry next poll",
+                rem.object_kind, rem.object_id, rem.reminder_name, e,
+            )
+            return False
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — handler-side outcome
+            log.warning(
+                "reminder %s/%s/%s fired into a failing handler: %r",
+                rem.object_kind, rem.object_id, rem.reminder_name, e,
+            )
+            return True
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+
+    async def handoff(self) -> None:
+        """Graceful drain: stop claiming, release every held lease, and
+        free our directory seats so survivors claim on their next poll
+        (well inside one lease interval). Called by
+        ``Server._drain_and_exit`` before the placement cordon."""
+        self._draining = True
+        for shard in list(self._held):
+            await self._release_held(shard)
+            oid = ObjectId(SHARD_TYPE, str(shard))
+            with contextlib.suppress(Exception):
+                if await self.placement.lookup(oid) == self.address:
+                    await self.placement.remove(oid)
+
+    async def run(self) -> None:
+        """Serve until cancelled (a ``Server.run`` child task)."""
+        await self.storage.prepare()
+        try:
+            while not self._draining:
+                try:
+                    await self.poll_once()
+                    self.stats.polls += 1
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    # Like the placement daemon: a transient storage or
+                    # membership error must never kill the scheduler.
+                    self.stats.errors += 1
+                    log.exception("reminder daemon poll failed")
+                await asyncio.sleep(self.config.poll_interval)
+            await asyncio.Event().wait()  # drained: park until cancelled
+        finally:
+            if self._client is not None:
+                self._client.close()

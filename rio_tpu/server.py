@@ -89,6 +89,8 @@ class Server:
         advertise_address: str | None = None,
         placement_daemon: bool = False,
         placement_daemon_config=None,
+        reminder_daemon: bool = False,
+        reminder_daemon_config=None,
     ) -> None:
         if transport not in ("asyncio", "native", "auto"):
             raise ValueError(f"unknown transport {transport!r}")
@@ -109,6 +111,11 @@ class Server:
         self.placement_daemon_enabled = placement_daemon
         self.placement_daemon_config = placement_daemon_config
         self.placement_daemon = None  # set by run() when enabled
+        # Opt-in durable-reminder scheduler; requires a ReminderStorage in
+        # app_data (checked at run(), where failure is loud).
+        self.reminder_daemon_enabled = reminder_daemon
+        self.reminder_daemon_config = reminder_daemon_config
+        self.reminder_daemon = None  # set by run() when enabled
 
         self._listener: asyncio.Server | None = None
         self._native_transport = None
@@ -160,6 +167,10 @@ class Server:
         """Run storage migrations (reference ``server.rs:120-125``)."""
         await self.members_storage.prepare()
         await self.object_placement.prepare()
+        from .reminders import ReminderStorage
+
+        if ReminderStorage in self.app_data:
+            await self.app_data.get(ReminderStorage).prepare()
 
     def _resolve_transport(self) -> str:
         if self.transport == "auto":
@@ -303,6 +314,13 @@ class Server:
         placement = self.object_placement
         try:
             self._draining.active = True
+            if self.reminder_daemon is not None:
+                # Hand shard ownership to the survivors BEFORE the object
+                # population moves: released leases + freed directory seats
+                # are claimable on the next peer poll, so reminder ticks
+                # resume within one lease interval of a graceful exit.
+                with contextlib.suppress(Exception):
+                    await self.reminder_daemon.handoff()
             if hasattr(placement, "cordon"):
                 try:
                     placement.cordon(self._local_addr)
@@ -396,6 +414,24 @@ class Server:
             )
             self.placement_daemon = daemon
             tasks.append(asyncio.ensure_future(daemon.run()))
+        if self.reminder_daemon_enabled:
+            from .reminders import ReminderStorage
+            from .reminders.daemon import ReminderDaemon
+
+            if ReminderStorage not in self.app_data:
+                raise ServerError(
+                    "reminder_daemon=True requires a ReminderStorage in app_data "
+                    "(app_data.set(storage, as_type=ReminderStorage))"
+                )
+            rdaemon = ReminderDaemon(
+                address=self.local_address,
+                members_storage=self.members_storage,
+                placement=self.object_placement,
+                storage=self.app_data.get(ReminderStorage),
+                config=self.reminder_daemon_config,
+            )
+            self.reminder_daemon = rdaemon
+            tasks.append(asyncio.ensure_future(rdaemon.run()))
         if self.http_members_address:
             from .cluster.storage.http import serve_members_http
 

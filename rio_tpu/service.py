@@ -210,7 +210,13 @@ class Service:
             )
         except Exception as e:  # noqa: BLE001 — "panic" isolation
             # Reference service.rs:92-107: catch_unwind → deallocate → Unknown.
-            self.registry.remove(req.handler_type, req.handler_id)
+            panicked = self.registry.remove(req.handler_type, req.handler_id)
+            if panicked is not None:
+                # Orphaned volatile timers would keep re-activating the
+                # deallocated object through the dispatch queue.
+                from .service_object import cancel_timers
+
+                cancel_timers(panicked)
             await self.object_placement.remove(object_id)
             log.exception("handler panic for %s", object_id)
             return ResponseEnvelope.err(ResponseError.unknown(f"Panic: {e!r}"))

@@ -12,6 +12,8 @@ lifecycle (``before_load`` → state load → ``after_load``; ``before_shutdown`
 
 from __future__ import annotations
 
+import asyncio
+import logging
 from enum import Enum
 from typing import Any, TypeVar
 
@@ -23,6 +25,8 @@ from .protocol import ErrorKind, ResponseEnvelope
 from .registry import decode_error, handler, message, type_id
 
 T = TypeVar("T")
+
+log = logging.getLogger("rio_tpu.service_object")
 
 
 class LifecycleKind(Enum):
@@ -39,6 +43,38 @@ class LifecycleMessage:
     """
 
     kind: LifecycleKind = LifecycleKind.LOAD
+
+
+@message(name="rio.ReminderFired")
+class ReminderFired:
+    """One durable-reminder tick, delivered as an ordinary request.
+
+    Riding the existing request path (rather than a new frame kind) keeps
+    the wire format untouched: the native codec and both transports see a
+    plain message. ``due`` is the tick's scheduled time; ``missed`` counts
+    whole periods lost before this fire (0 on a healthy schedule — the
+    catch-up signal after an ownership gap).
+    """
+
+    name: str = ""
+    due: float = 0.0
+    missed: int = 0
+
+
+def cancel_timers(obj: Any) -> None:
+    """Cancel every volatile timer of ``obj`` (idempotent).
+
+    Module-level because both deactivation paths need it and one of them
+    no longer has a handler context: the SHUTDOWN lifecycle (graceful) and
+    the service layer's panic deallocation (the object is already out of
+    the registry when its timers must die).
+    """
+    timers = getattr(obj, "_rio_timers", None)
+    if not timers:
+        return
+    for task in timers.values():
+        task.cancel()
+    timers.clear()
 
 
 class ServiceObject:
@@ -90,7 +126,94 @@ class ServiceObject:
             except Exception as e:
                 raise ServiceObjectLifeCycleError(str(e)) from e
         elif msg.kind == LifecycleKind.SHUTDOWN:
+            # Timers die first: a tick enqueued mid-shutdown would
+            # re-activate the object on this (possibly draining) node.
+            cancel_timers(self)
             await self.before_shutdown(ctx)
+
+    @handler
+    async def _handle_reminder(self, msg: ReminderFired, ctx: AppData) -> None:
+        """Blanket reminder handler: every service object can be woken by
+        the reminder daemon; subclasses override :meth:`receive_reminder`."""
+        await self.receive_reminder(msg, ctx)
+
+    async def receive_reminder(self, fired: ReminderFired, ctx: AppData) -> None:  # noqa: ARG002
+        """Called on each durable-reminder tick (override me).
+
+        The activation itself is often the point — a reminder to an
+        unloaded object runs the full LOAD lifecycle first, so state is
+        warm by the time this runs.
+        """
+        log.debug("%s/%s: unhandled reminder %r", type_id(type(self)), self.id, fired.name)
+
+    # -- volatile timers ----------------------------------------------------
+
+    def register_timer(self, ctx: AppData, name: str, period: float, msg: Any) -> None:
+        """Fire ``msg`` at ``self`` every ``period`` seconds while activated.
+
+        The tick goes through the server's normal dispatch queue
+        (:meth:`send`), so it honors the per-object lock like any request
+        and runs the handler registered for ``type(msg)``. Volatile:
+        cancelled at SHUTDOWN/panic deactivation, never persisted — use
+        :meth:`register_reminder` to survive deactivation.
+        Re-registering ``name`` replaces the previous timer.
+        """
+        # Lazy dict on the INSTANCE: subclasses routinely skip
+        # super().__init__(), and a class-level default would be shared.
+        timers: dict[str, asyncio.Task] = self.__dict__.setdefault("_rio_timers", {})
+        old = timers.pop(name, None)
+        if old is not None:
+            old.cancel()
+        tname, oid = type_id(type(self)), self.id
+
+        async def _tick_loop() -> None:
+            while True:
+                await asyncio.sleep(period)
+                try:
+                    await ServiceObject.send(ctx, tname, oid, msg)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — keep ticking
+                    log.warning("timer %s/%s/%s tick failed: %r", tname, oid, name, e)
+
+        timers[name] = asyncio.ensure_future(_tick_loop())
+
+    def cancel_timer(self, name: str) -> bool:
+        """Cancel one timer; True when it existed."""
+        timers = self.__dict__.get("_rio_timers")
+        if not timers or name not in timers:
+            return False
+        timers.pop(name).cancel()
+        return True
+
+    # -- durable reminders --------------------------------------------------
+
+    async def register_reminder(
+        self, ctx: AppData, name: str, period: float, *, first_due: float | None = None
+    ) -> None:
+        """Persist a durable reminder: ``receive_reminder`` fires every
+        ``period`` seconds from ``first_due`` (default: one period from
+        now) — surviving crash, drain, and re-placement; delivered by
+        whichever node owns this object's reminder shard. Re-registering
+        overwrites (Orleans semantics)."""
+        import time
+
+        from .reminders import Reminder, ReminderStorage
+
+        due = time.time() + period if first_due is None else first_due
+        await ctx.get(ReminderStorage).upsert(
+            Reminder(type_id(type(self)), self.id, name, period, due)
+        )
+
+    async def unregister_reminder(self, ctx: AppData, name: str) -> None:
+        from .reminders import ReminderStorage
+
+        await ctx.get(ReminderStorage).remove(type_id(type(self)), self.id, name)
+
+    async def list_reminders(self, ctx: AppData) -> list[Any]:
+        from .reminders import ReminderStorage
+
+        return await ctx.get(ReminderStorage).list_object(type_id(type(self)), self.id)
 
     # -- in-server messaging (reference service_object.rs:52-83) ------------
 

@@ -7,8 +7,9 @@ speaking the actual wire protocol, backed by an in-memory keyspace. The
 Redis backends under test use their production code path end to end
 (``rio_tpu/utils/resp.py`` over a socket).
 
-Supported commands: PING SELECT SET GET DEL EXISTS HSET HGET HGETALL HDEL
-RPUSH LTRIM LRANGE SADD SREM SMEMBERS FLUSHDB KEYS.
+Supported commands: PING SELECT SET (incl. NX) GET DEL EXISTS INCR HSET
+HGET HGETALL HDEL RPUSH LTRIM LRANGE SADD SREM SMEMBERS ZADD ZREM ZCARD
+ZRANGEBYSCORE (incl. LIMIT) FLUSHDB KEYS.
 """
 
 from __future__ import annotations
@@ -93,8 +94,15 @@ class FakeRedisServer:
                 d.clear()
             return _enc("OK")
         if name == "SET":
+            opts = [a.decode().upper() for a in args[2:]]
+            if "NX" in opts and args[0] in d:
+                return _enc_bulk(None)
             d[args[0]] = args[1]
             return _enc("OK")
+        if name == "INCR":
+            v = int(d.get(args[0], b"0")) + 1
+            d[args[0]] = str(v).encode()
+            return _enc(v)
         if name == "GET":
             v = d.get(args[0])
             if v is not None and not isinstance(v, bytes):
@@ -159,4 +167,35 @@ class FakeRedisServer:
             return _enc(n)
         if name == "SMEMBERS":
             return _enc(sorted(d.get(args[0], set())))
+        if name == "ZADD":
+            z = d.setdefault(args[0], {})
+            added = 0
+            for i in range(1, len(args), 2):
+                added += args[i + 1] not in z
+                z[args[i + 1]] = float(args[i])
+            return _enc(added)
+        if name == "ZREM":
+            z = d.get(args[0], {})
+            n = sum(1 for m in args[1:] if z.pop(m, None) is not None)
+            if not z:
+                d.pop(args[0], None)
+            return _enc(n)
+        if name == "ZCARD":
+            return _enc(len(d.get(args[0], {})))
+        if name == "ZRANGEBYSCORE":
+            z = d.get(args[0], {})
+
+            def _score(raw: bytes) -> float:
+                s = raw.decode()
+                return float("-inf") if s == "-inf" else float("inf") if s in ("+inf", "inf") else float(s)
+
+            lo, hi = _score(args[1]), _score(args[2])
+            members = sorted(
+                (m for m, sc in z.items() if lo <= sc <= hi),
+                key=lambda m: (z[m], m),
+            )
+            if len(args) >= 6 and args[3].decode().upper() == "LIMIT":
+                off, cnt = int(args[4]), int(args[5])
+                members = members[off:] if cnt < 0 else members[off : off + cnt]
+            return _enc(members)
         raise ValueError(f"unknown command '{name}'")

@@ -14,6 +14,7 @@ from dataclasses import dataclass, field
 from typing import Awaitable, Callable
 
 from rio_tpu import (
+    AppData,
     Client,
     LocalObjectPlacement,
     LocalStorage,
@@ -88,6 +89,7 @@ async def run_integration_test(
     provider_builder: Callable[[LocalStorage], ClusterProvider] | None = None,
     transport: str = "asyncio",
     server_kwargs: dict | None = None,
+    app_data_builder: Callable[[], "AppData"] | None = None,
 ) -> None:
     members = members if members is not None else LocalStorage()
     placement = placement if placement is not None else LocalObjectPlacement()
@@ -100,13 +102,20 @@ async def run_integration_test(
             provider = PeerToPeerClusterProvider(members, fast_gossip_config())
         else:
             provider = LocalClusterProvider(members)
+        extra = dict(server_kwargs or {})
+        if app_data_builder is not None:
+            # One AppData PER SERVER (Server.__init__ injects per-node
+            # handles like AdminSender into it — sharing one instance
+            # across servers would clobber them); the builder puts shared
+            # fakes (e.g. an aliased ReminderStorage) into each.
+            extra["app_data"] = app_data_builder()
         server = Server(
             address="127.0.0.1:0",
             registry=registry_builder(),
             cluster_provider=provider,
             object_placement_provider=placement,
             transport=transport,
-            **(server_kwargs or {}),
+            **extra,
         )
         await server.prepare()
         await server.bind()

@@ -153,3 +153,32 @@ def test_no_active_servers_retry_exhausts():
             await client.send(Oracle, "x", Ask(), returns=Answer)
 
     asyncio.run(run_integration_test(body, registry_builder=build_registry, num_servers=1))
+
+
+def test_client_builder_promotes_tunables():
+    """Every hard-coded client tunable is reachable through the builder:
+    placement LRU size, retry/backoff policy, membership view TTL."""
+    from rio_tpu import ClientBuilder, LocalStorage
+    from rio_tpu.utils import ExponentialBackoff
+
+    policy = ExponentialBackoff(initial=0.5, cap=2.0, max_retries=7)
+    client = (
+        ClientBuilder()
+        .members_storage(LocalStorage())
+        .placement_cache_size(17)
+        .backoff(policy)
+        .membership_view_ttl(4.5)
+        .build()
+    )
+    assert client._placement.capacity == 17
+    assert client._backoff is policy
+    assert client._view_ttl == 4.5
+    client.close()
+
+    # Defaults still hold when nothing is overridden.
+    default = ClientBuilder().members_storage(LocalStorage()).build()
+    from rio_tpu.client import DEFAULT_PLACEMENT_LRU
+
+    assert default._placement.capacity == DEFAULT_PLACEMENT_LRU
+    assert default._view_ttl == 1.0
+    default.close()

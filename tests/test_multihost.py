@@ -63,6 +63,7 @@ def test_process_rows_covers_everything_single_process():
     assert (rows.start, rows.stop) == (0, n)
 
 
+@pytest.mark.slow
 def test_two_process_multicontroller_solve_parity(tmp_path):
     """REAL multi-controller run: two OS processes, 2 CPU devices each,
     joined by jax.distributed over loopback (gloo — the DCN analog), one

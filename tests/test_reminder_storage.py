@@ -1,0 +1,151 @@
+"""ReminderStorage backend matrix: the generic trait-level checks of
+``tests/test_backends.py`` extended to the reminder store — every backend
+(local / sqlite / postgres-over-fake-pg / redis-over-fake-redis) runs the
+SAME assertions over its production code path."""
+
+import os
+
+import pytest
+
+from rio_tpu.reminders import (
+    LocalReminderStorage,
+    Reminder,
+    ReminderStorage,
+    shard_of,
+)
+from rio_tpu.reminders.sqlite import SqliteReminderStorage
+from rio_tpu.utils.resp import RedisClient
+
+from .fake_redis import FakeRedisServer
+
+
+def test_shard_of_is_stable_and_bounded():
+    # The cluster-wide agreement hinge: same inputs → same shard, always.
+    assert shard_of("Kind", "id-1", 32) == shard_of("Kind", "id-1", 32)
+    seen = {shard_of("K", str(i), 8) for i in range(200)}
+    assert seen <= set(range(8))
+    assert len(seen) > 1  # actually spreads
+
+
+async def check_reminders(s: ReminderStorage):
+    await s.prepare()
+    kind, oid = "Player", "p1"
+    shard = s.shard_for(kind, oid)
+
+    # upsert stamps the shard; list enumerates per object, name-ordered
+    await s.upsert(Reminder(kind, oid, "b-save", period=5.0, next_due=100.0))
+    await s.upsert(Reminder(kind, oid, "a-expire", period=2.0, next_due=50.0))
+    await s.upsert(Reminder(kind, "p2", "other", period=9.0, next_due=60.0))
+    rows = await s.list_object(kind, oid)
+    assert [r.reminder_name for r in rows] == ["a-expire", "b-save"]
+    assert all(r.shard == shard for r in rows)
+
+    # re-registering overwrites (Orleans semantics)
+    await s.upsert(Reminder(kind, oid, "b-save", period=7.0, next_due=140.0))
+    rows = await s.list_object(kind, oid)
+    assert [(r.period, r.next_due) for r in rows] == [(2.0, 50.0), (7.0, 140.0)]
+
+    # due scan: one shard, next_due <= now, soonest first, limit honored
+    due = await s.due(shard, now=141.0)
+    mine = [r for r in due if (r.object_kind, r.object_id) == (kind, oid)]
+    assert [r.reminder_name for r in mine] == ["a-expire", "b-save"]
+    assert [r.reminder_name for r in await s.due(shard, now=99.0)
+            if (r.object_kind, r.object_id) == (kind, oid)] == ["a-expire"]
+    limited = await s.due(shard, now=141.0, limit=1)
+    assert len(limited) == 1
+    assert not [r for r in await s.due(shard, now=10.0)
+                if (r.object_kind, r.object_id) == (kind, oid)]
+
+    # reschedule advances next_due (the post-delivery step)
+    await s.reschedule(kind, oid, "a-expire", 500.0)
+    assert not [r for r in await s.due(shard, now=499.0)
+                if r.reminder_name == "a-expire"]
+    assert (await s.list_object(kind, oid))[0].next_due == 500.0
+
+    # shard_counts reflects live rows
+    counts = await s.shard_counts()
+    assert counts[shard] >= 2
+    assert sum(counts.values()) == 3
+
+    # remove one / remove the whole object
+    await s.remove(kind, oid, "a-expire")
+    assert [r.reminder_name for r in await s.list_object(kind, oid)] == ["b-save"]
+    await s.remove_object(kind, oid)
+    assert await s.list_object(kind, oid) == []
+    assert [r.reminder_name for r in await s.list_object(kind, "p2")] == ["other"]
+    await s.remove_object(kind, "p2")
+    assert await s.shard_counts() == {}
+
+
+async def check_leases(s: ReminderStorage):
+    await s.prepare()
+    shard = 3
+    # fresh acquisition
+    l1 = await s.acquire_lease(shard, "n1:1", ttl=10.0, now=1000.0)
+    assert l1 is not None and l1.owner == "n1:1" and l1.expires_at == 1010.0
+    # blocked while another owner's lease is unexpired
+    assert await s.acquire_lease(shard, "n2:2", 10.0, now=1005.0) is None
+    # renewal keeps the epoch, extends the TTL
+    l2 = await s.acquire_lease(shard, "n1:1", 10.0, now=1005.0)
+    assert l2 is not None and l2.epoch == l1.epoch and l2.expires_at == 1015.0
+    # expired takeover bumps the epoch (the fencing token)
+    l3 = await s.acquire_lease(shard, "n2:2", 10.0, now=1020.0)
+    assert l3 is not None and l3.owner == "n2:2" and l3.epoch > l1.epoch
+    # a stale release (old owner + old epoch) must not disturb the new lease
+    await s.release_lease(shard, "n1:1", l1.epoch)
+    g = await s.get_lease(shard)
+    assert g is not None and g.owner == "n2:2" and g.expires_at > 1020.0
+    # the owner's own release frees the shard immediately
+    await s.release_lease(shard, "n2:2", l3.epoch)
+    l4 = await s.acquire_lease(shard, "n3:3", 10.0, now=1021.0)
+    assert l4 is not None and l4.owner == "n3:3" and l4.epoch > l3.epoch
+    # independent shards don't interfere
+    other = await s.acquire_lease(shard + 1, "n1:1", 10.0, now=1021.0)
+    assert other is not None and other.epoch == 1
+
+
+@pytest.mark.asyncio
+async def test_local_reminder_storage():
+    await check_reminders(LocalReminderStorage())
+    await check_leases(LocalReminderStorage())
+
+
+@pytest.mark.asyncio
+async def test_sqlite_reminder_storage(tmp_path):
+    await check_reminders(SqliteReminderStorage(str(tmp_path / "rem.db")))
+    await check_leases(SqliteReminderStorage(str(tmp_path / "lease.db")))
+
+
+@pytest.mark.asyncio
+async def test_postgres_reminder_storage():
+    """Real server when RIO_TPU_PG_DSN is set, else the DBAPI fake — the
+    portable SQL, paramstyle translation, and thread bridge run either way."""
+    from rio_tpu.reminders.postgres import PostgresReminderStorage
+    from rio_tpu.utils.pg import driver_available
+
+    dsn = os.environ.get("RIO_TPU_PG_DSN", "")
+    if not driver_available() or not dsn:
+        from tests import fake_pg
+
+        fake_pg.install()
+        fake_pg.reset()
+        dsn = "postgresql://fake-pg/reminders"
+    await check_reminders(PostgresReminderStorage(dsn))
+    await check_leases(PostgresReminderStorage(dsn))
+
+
+@pytest.mark.asyncio
+async def test_redis_reminder_storage():
+    from rio_tpu.reminders.redis import RedisReminderStorage
+
+    server = await FakeRedisServer().start()
+    try:
+        client = RedisClient("127.0.0.1", server.port)
+        await check_reminders(RedisReminderStorage(client, key_prefix="t_rem"))
+        await check_leases(RedisReminderStorage(client, key_prefix="t_lease"))
+        # key-prefix isolation
+        other = RedisReminderStorage(client, key_prefix="t_isolated")
+        assert await other.shard_counts() == {}
+        client.close()
+    finally:
+        await server.stop()

@@ -1,0 +1,551 @@
+"""Timers & reminders integration tests.
+
+The tentpole subsystem end to end: volatile timers through the dispatch
+queue (cancelled at shutdown AND at panic deallocation), durable reminders
+delivered by the shard-owning node's ReminderDaemon, failover of shard
+ownership on both an abrupt server kill (lease expiry bounds the gap) and a
+graceful drain (handoff releases leases immediately), and the missed-tick
+catch-up policies — plus deterministic daemon-level unit tests with a stub
+delivery client.
+"""
+
+import asyncio
+import time
+from collections import defaultdict
+
+import pytest
+
+from rio_tpu import (
+    AdminCommand,
+    AppData,
+    LocalObjectPlacement,
+    LocalReminderStorage,
+    Registry,
+    ReminderDaemonConfig,
+    ReminderFired,
+    ReminderStorage,
+    ServerInfo,
+    ServiceObject,
+    handler,
+    message,
+)
+from rio_tpu.cluster.storage import LocalStorage, Member
+from rio_tpu.object_placement import ObjectPlacementItem
+from rio_tpu.registry import ObjectId
+from rio_tpu.reminders import Reminder
+from rio_tpu.reminders.daemon import SHARD_TYPE, ReminderDaemon
+from rio_tpu.utils import ExponentialBackoff
+
+from .server_utils import Cluster, run_integration_test
+
+# Global tick record: survives re-activation and server moves (everything
+# runs in one process), so failover tests can see who delivered what when.
+RECORD: dict[str, list[tuple[str, int, float]]] = defaultdict(list)
+
+
+@message
+class StartTimer:
+    name: str = "t"
+    period: float = 0.05
+
+
+@message
+class StopTimer:
+    name: str = "t"
+
+
+@message
+class TimerTick:
+    name: str = "t"
+
+
+@message
+class StartReminder:
+    name: str = "r"
+    period: float = 0.2
+    first_in: float = 0.2
+
+
+@message
+class Poke:
+    mode: str = "ok"  # ok | panic | shutdown
+
+
+@message
+class Ticks:
+    timer_ticks: int = 0
+    server: str = ""
+    stopped: bool = False
+
+
+class Waker(ServiceObject):
+    def __init__(self):
+        self.timer_ticks = 0
+
+    @handler
+    async def start_timer(self, msg: StartTimer, ctx: AppData) -> Ticks:
+        self.register_timer(ctx, msg.name, msg.period, TimerTick(name=msg.name))
+        return Ticks(server=ctx.get(ServerInfo).address)
+
+    @handler
+    async def stop_timer(self, msg: StopTimer, ctx: AppData) -> Ticks:
+        return Ticks(timer_ticks=self.timer_ticks, stopped=self.cancel_timer(msg.name))
+
+    @handler
+    async def tick(self, msg: TimerTick, ctx: AppData) -> Ticks:
+        self.timer_ticks += 1
+        return Ticks(timer_ticks=self.timer_ticks)
+
+    @handler
+    async def start_reminder(self, msg: StartReminder, ctx: AppData) -> Ticks:
+        await self.register_reminder(
+            ctx, msg.name, msg.period, first_due=time.time() + msg.first_in
+        )
+        return Ticks(server=ctx.get(ServerInfo).address)
+
+    @handler
+    async def poke(self, msg: Poke, ctx: AppData) -> Ticks:
+        if msg.mode == "panic":
+            raise ValueError("handler panic")
+        if msg.mode == "shutdown":
+            await self.shutdown(ctx)
+        return Ticks(timer_ticks=self.timer_ticks, server=ctx.get(ServerInfo).address)
+
+    async def receive_reminder(self, fired: ReminderFired, ctx: AppData) -> None:
+        RECORD[fired.name].append(
+            (ctx.get(ServerInfo).address, fired.missed, time.time())
+        )
+
+
+def build_registry() -> Registry:
+    return Registry().add_type(Waker)
+
+
+def fast_client(cluster: Cluster):
+    c = cluster.client()
+    c._backoff = ExponentialBackoff(initial=1e-4, cap=1e-2, max_retries=8)
+    return c
+
+
+def reminder_cluster_kwargs(storage: LocalReminderStorage, **cfg) -> dict:
+    config = ReminderDaemonConfig(
+        poll_interval=cfg.pop("poll_interval", 0.05),
+        lease_ttl=cfg.pop("lease_ttl", 2.0),
+        delivery_backoff=ExponentialBackoff(initial=1e-3, cap=0.05, max_retries=4),
+        **cfg,
+    )
+    return dict(
+        server_kwargs={"reminder_daemon": True, "reminder_daemon_config": config},
+        app_data_builder=lambda: AppData().set(storage, as_type=ReminderStorage),
+    )
+
+
+async def wait_until(pred, timeout: float, interval: float = 0.02):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        v = pred()
+        if v:
+            return v
+        await asyncio.sleep(interval)
+    raise AssertionError(f"condition never became true within {timeout}s")
+
+
+# ---------------------------------------------------------------------------
+# volatile timers
+# ---------------------------------------------------------------------------
+
+
+def test_volatile_timer_fires_and_cancels():
+    async def body(cluster: Cluster):
+        client = fast_client(cluster)
+        await client.send(Waker, "w1", StartTimer(name="t", period=0.03), returns=Ticks)
+        # Ticks arrive through the normal dispatch queue.
+        out = await wait_until_ticks(client, "w1", 3)
+        # Cancel stops it; the count freezes.
+        stop = await client.send(Waker, "w1", StopTimer(name="t"), returns=Ticks)
+        assert stop.stopped
+        frozen = stop.timer_ticks
+        await asyncio.sleep(0.15)
+        after = await client.send(Waker, "w1", StopTimer(name="absent"), returns=Ticks)
+        assert after.timer_ticks == frozen >= out.timer_ticks >= 3
+        assert not after.stopped  # cancelling a non-timer reports False
+        client.close()
+
+    async def wait_until_ticks(client, oid, n):
+        for _ in range(200):
+            out = await client.send(Waker, oid, StopTimer(name="absent"), returns=Ticks)
+            if out.timer_ticks >= n:
+                return out
+            await asyncio.sleep(0.02)
+        raise AssertionError(f"never saw {n} timer ticks")
+
+    asyncio.run(run_integration_test(body, registry_builder=build_registry, num_servers=1))
+
+
+def test_timer_cancelled_on_shutdown_and_panic():
+    """Deactivation must kill timers on BOTH exits: the graceful SHUTDOWN
+    lifecycle and the panic deallocation — an orphaned timer would keep
+    re-activating the object through the dispatch queue forever."""
+
+    async def body(cluster: Cluster):
+        client = fast_client(cluster)
+        # Graceful: shutdown from inside a handler (admin path).
+        await client.send(Waker, "g1", StartTimer(period=0.03), returns=Ticks)
+        await client.send(Waker, "g1", Poke(mode="shutdown"), returns=Ticks)
+        await wait_until(
+            lambda: not any(s.registry.has("Waker", "g1") for s in cluster.servers), 2.0
+        )
+        await asyncio.sleep(0.2)  # > several periods
+        assert not any(s.registry.has("Waker", "g1") for s in cluster.servers), (
+            "an orphaned timer re-activated the shut-down object"
+        )
+
+        # Panic: the deallocated instance's timers must die with it.
+        await client.send(Waker, "p1", StartTimer(period=0.03), returns=Ticks)
+        from rio_tpu.errors import ClientError
+
+        with pytest.raises(ClientError):
+            await client.send(Waker, "p1", Poke(mode="panic"), returns=Ticks)
+        await asyncio.sleep(0.2)
+        assert not any(s.registry.has("Waker", "p1") for s in cluster.servers), (
+            "an orphaned timer re-activated the panicked object"
+        )
+        client.close()
+
+    asyncio.run(run_integration_test(body, registry_builder=build_registry, num_servers=1))
+
+
+# ---------------------------------------------------------------------------
+# durable reminders through the cluster
+# ---------------------------------------------------------------------------
+
+
+def test_reminder_fires_through_cluster():
+    storage = LocalReminderStorage()
+    RECORD.pop("cluster-r", None)
+
+    async def body(cluster: Cluster):
+        client = fast_client(cluster)
+        await client.send(
+            Waker, "c1", StartReminder(name="cluster-r", period=0.1, first_in=0.1),
+            returns=Ticks,
+        )
+        # Periodic delivery: several ticks, each on a live node, missed == 0
+        # on a healthy schedule.
+        await wait_until(lambda: len(RECORD["cluster-r"]) >= 3, 10.0)
+        addrs = {a for a, _, _ in RECORD["cluster-r"]}
+        assert addrs <= set(cluster.addresses)
+        assert all(m == 0 for _, m, _ in RECORD["cluster-r"][:3])
+        # The shard is seated in the directory through ObjectPlacement.
+        shard = storage.shard_for("Waker", "c1")
+        owner = await cluster.placement.lookup(ObjectId(SHARD_TYPE, str(shard)))
+        assert owner in cluster.addresses
+        # Unregister stops the schedule.
+        r = await client.send(Waker, "c1", Poke(), returns=Ticks)
+        assert r.server in cluster.addresses
+        obj = next(s.registry.get("Waker", "c1") for s in cluster.servers
+                   if s.registry.has("Waker", "c1"))
+        sa = next(s for s in cluster.servers if s.registry.has("Waker", "c1"))
+        await obj.unregister_reminder(sa.app_data, "cluster-r")
+        await asyncio.sleep(0.1)
+        n = len(RECORD["cluster-r"])
+        await asyncio.sleep(0.4)
+        assert len(RECORD["cluster-r"]) <= n + 1  # at most one in-flight tick
+        client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body, registry_builder=build_registry, num_servers=2, timeout=30.0,
+            **reminder_cluster_kwargs(storage),
+        )
+    )
+
+
+def _find_server(cluster: Cluster, address: str):
+    return next(s for s in cluster.servers if s.local_address == address)
+
+
+def test_reminder_failover_on_server_kill():
+    """A reminder registered via node A keeps firing on the survivor within
+    one lease interval after the shard owner dies (acceptance criterion).
+    The dead owner never releases its lease, so the gap is bounded by
+    lease_ttl; the first post-takeover tick carries the missed count."""
+    storage = LocalReminderStorage()
+    RECORD.pop("kill-r", None)
+    lease_ttl = 2.0
+
+    async def body(cluster: Cluster):
+        client = fast_client(cluster)
+        await client.send(
+            Waker, "k1", StartReminder(name="kill-r", period=0.1, first_in=0.1),
+            returns=Ticks,
+        )
+        await wait_until(lambda: len(RECORD["kill-r"]) >= 2, 10.0)
+
+        shard = storage.shard_for("Waker", "k1")
+        owner = await cluster.placement.lookup(ObjectId(SHARD_TYPE, str(shard)))
+        assert owner in cluster.addresses
+        # Kill the shard-owning server (unannounced as far as the reminder
+        # subsystem goes — no drain, its lease stays in storage).
+        _find_server(cluster, owner).admin_sender().send(AdminCommand.server_exit())
+        t_kill = time.time()
+
+        def survivor_tick():
+            return next(
+                (
+                    (a, m, ts)
+                    for a, m, ts in RECORD["kill-r"]
+                    if a != owner and ts > t_kill
+                ),
+                None,
+            )
+
+        tick = await wait_until(survivor_tick, 15.0)
+        addr, missed, ts = tick
+        assert addr in cluster.addresses and addr != owner
+        # Within one lease interval (plus poll/delivery slack).
+        assert ts - t_kill <= lease_ttl + 2.0, (
+            f"failover took {ts - t_kill:.2f}s (lease_ttl={lease_ttl})"
+        )
+        # Catch-up: the outage spanned multiple periods; the first
+        # post-takeover tick reports them.
+        assert missed >= 1
+        # The schedule keeps running on the survivor afterwards.
+        n = len(RECORD["kill-r"])
+        await wait_until(lambda: len(RECORD["kill-r"]) >= n + 2, 10.0)
+        client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body, registry_builder=build_registry, num_servers=2, timeout=40.0,
+            **reminder_cluster_kwargs(storage, lease_ttl=lease_ttl),
+        )
+    )
+
+
+def test_reminder_failover_on_graceful_drain():
+    """DRAIN_SERVER hands shards off: the daemon releases its leases and
+    directory seats before exit, so the survivor resumes ticking without
+    waiting out the lease TTL (acceptance criterion, graceful half)."""
+    storage = LocalReminderStorage()
+    RECORD.pop("drain-r", None)
+
+    async def body(cluster: Cluster):
+        client = fast_client(cluster)
+        await client.send(
+            Waker, "d1", StartReminder(name="drain-r", period=0.1, first_in=0.1),
+            returns=Ticks,
+        )
+        await wait_until(lambda: len(RECORD["drain-r"]) >= 2, 10.0)
+
+        shard = storage.shard_for("Waker", "d1")
+        owner = await cluster.placement.lookup(ObjectId(SHARD_TYPE, str(shard)))
+        _find_server(cluster, owner).admin_sender().send(AdminCommand.drain())
+        t_drain = time.time()
+
+        tick = await wait_until(
+            lambda: next(
+                (
+                    (a, m, ts)
+                    for a, m, ts in RECORD["drain-r"]
+                    if a != owner and ts > t_drain
+                ),
+                None,
+            ),
+            15.0,
+        )
+        _, _, ts = tick
+        # Released leases make the handoff prompt — well under the TTL-expiry
+        # bound the kill test tolerates.
+        assert ts - t_drain <= 4.0
+        # The released lease was re-acquired by the survivor, epoch advanced.
+        lease = await storage.get_lease(shard)
+        assert lease is not None and lease.owner != owner
+        client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body, registry_builder=build_registry, num_servers=2, timeout=40.0,
+            **reminder_cluster_kwargs(storage, lease_ttl=5.0),
+        )
+    )
+
+
+# ---------------------------------------------------------------------------
+# daemon-level determinism: catch-up policies + at-least-once
+# ---------------------------------------------------------------------------
+
+
+class StubClient:
+    """Records deliveries; optionally fails the first N with a transport
+    error (the daemon must treat those as undelivered)."""
+
+    def __init__(self, fail_first: int = 0):
+        self.sent: list[tuple[str, str, ReminderFired]] = []
+        self.fail_first = fail_first
+
+    async def send(self, kind, oid, msg, returns=None):
+        if self.fail_first > 0:
+            self.fail_first -= 1
+            from rio_tpu.errors import Disconnect
+
+            raise Disconnect("stub transport down")
+        self.sent.append((kind, oid, msg))
+
+    def close(self):
+        pass
+
+
+async def _one_node_daemon(storage, client, **cfg):
+    members = LocalStorage()
+    await members.push(Member(ip="10.0.0.1", port=9000, active=True))
+    daemon = ReminderDaemon(
+        address="10.0.0.1:9000",
+        members_storage=members,
+        placement=LocalObjectPlacement(),
+        storage=storage,
+        config=ReminderDaemonConfig(**cfg),
+        client=client,
+    )
+    return daemon
+
+
+@pytest.mark.asyncio
+async def test_catchup_skip_jumps_phase_aligned():
+    storage = LocalReminderStorage(num_shards=4)
+    await storage.upsert(Reminder("Svc", "a", "r", period=10.0, next_due=100.0))
+    shard = storage.shard_for("Svc", "a")
+    client = StubClient()
+    daemon = await _one_node_daemon(storage, client, catchup="skip", lease_ttl=60.0)
+
+    await daemon.poll_once(now=135.0)  # 3 whole periods missed
+    assert len(client.sent) == 1
+    fired = client.sent[0][2]
+    assert (fired.name, fired.due, fired.missed) == ("r", 100.0, 3)
+    # Phase-aligned jump: 100 + (3+1)*10, NOT "now + period".
+    assert (await storage.list_object("Svc", "a"))[0].next_due == 140.0
+    assert daemon.stats.ticks == 1 and daemon.stats.missed_ticks == 3
+    # Not due again until 140.
+    await daemon.poll_once(now=139.0)
+    assert len(client.sent) == 1
+    # The daemon seated the shard through the placement directory.
+    assert await daemon.placement.lookup(
+        ObjectId(SHARD_TYPE, str(shard))
+    ) == "10.0.0.1:9000"
+
+
+@pytest.mark.asyncio
+async def test_catchup_all_replays_every_missed_tick():
+    storage = LocalReminderStorage(num_shards=4)
+    await storage.upsert(Reminder("Svc", "a", "r", period=10.0, next_due=100.0))
+    client = StubClient()
+    daemon = await _one_node_daemon(storage, client, catchup="all", lease_ttl=60.0)
+
+    for _ in range(6):  # more polls than backlog; extras must not over-fire
+        await daemon.poll_once(now=135.0)
+    # Every schedule point in (100..135] fired exactly once: 100,110,120,130.
+    assert [(m.due, m.missed) for _, _, m in client.sent] == [
+        (100.0, 3), (110.0, 2), (120.0, 1), (130.0, 0)
+    ]
+    assert (await storage.list_object("Svc", "a"))[0].next_due == 140.0
+
+
+@pytest.mark.asyncio
+async def test_at_least_once_on_transport_failure():
+    storage = LocalReminderStorage(num_shards=4)
+    await storage.upsert(Reminder("Svc", "a", "r", period=10.0, next_due=100.0))
+    client = StubClient(fail_first=2)
+    daemon = await _one_node_daemon(storage, client, lease_ttl=60.0)
+
+    # Two failed polls: undelivered, next_due untouched, failure counted.
+    await daemon.poll_once(now=105.0)
+    await daemon.poll_once(now=106.0)
+    assert client.sent == [] and daemon.stats.delivery_failures == 2
+    assert (await storage.list_object("Svc", "a"))[0].next_due == 100.0
+    # Transport back: the SAME tick is delivered, then rescheduled.
+    await daemon.poll_once(now=107.0)
+    assert len(client.sent) == 1 and client.sent[0][2].due == 100.0
+    assert (await storage.list_object("Svc", "a"))[0].next_due == 110.0
+
+
+@pytest.mark.asyncio
+async def test_handler_error_counts_as_delivered():
+    """An application-level failure must NOT hot-loop the tick each poll."""
+
+    class AngryClient(StubClient):
+        async def send(self, kind, oid, msg, returns=None):
+            self.sent.append((kind, oid, msg))
+            raise RuntimeError("handler blew up")
+
+    storage = LocalReminderStorage(num_shards=4)
+    await storage.upsert(Reminder("Svc", "a", "r", period=10.0, next_due=100.0))
+    client = AngryClient()
+    daemon = await _one_node_daemon(storage, client, lease_ttl=60.0)
+    await daemon.poll_once(now=105.0)
+    assert len(client.sent) == 1 and daemon.stats.delivery_failures == 0
+    assert (await storage.list_object("Svc", "a"))[0].next_due == 110.0
+
+
+@pytest.mark.asyncio
+async def test_daemon_steals_stale_seat_on_live_non_ticking_node():
+    """A solver rebalance can seat a shard on a live node that runs no
+    reminder daemon. Once the lease lapses a full TTL past expiry (or was
+    never taken), any daemon may steal through the lease and move the seat
+    to itself — otherwise the shard would never tick again."""
+    storage = LocalReminderStorage(num_shards=4)
+    await storage.upsert(Reminder("Svc", "a", "r", period=10.0, next_due=100.0))
+    shard = storage.shard_for("Svc", "a")
+    members = LocalStorage()
+    await members.push(Member(ip="10.0.0.1", port=9000, active=True))
+    await members.push(Member(ip="10.0.0.2", port=9000, active=True))
+    client = StubClient()
+    daemon = ReminderDaemon(
+        address="10.0.0.1:9000",
+        members_storage=members,
+        placement=LocalObjectPlacement(),
+        storage=storage,
+        config=ReminderDaemonConfig(lease_ttl=10.0),
+        client=client,
+    )
+    oid = ObjectId(SHARD_TYPE, str(shard))
+    # Seat the shard on the live daemon-less node, lease held there too.
+    await daemon.placement.update(ObjectPlacementItem(object_id=oid, server_address="10.0.0.2:9000"))
+    lease = await storage.acquire_lease(shard, "10.0.0.2:9000", ttl=10.0, now=100.0)
+    assert lease is not None
+    # Lease valid: the seat is respected, nothing fires from us.
+    await daemon.poll_once(now=105.0)
+    assert client.sent == [] and await daemon.placement.lookup(oid) == "10.0.0.2:9000"
+    # Expired but within one TTL of grace: still not stealable (renewal lag).
+    await daemon.poll_once(now=115.0)
+    assert client.sent == [] and await daemon.placement.lookup(oid) == "10.0.0.2:9000"
+    # Lapsed a full TTL past expiry: provably not ticking — steal and tick.
+    await daemon.poll_once(now=121.0)
+    assert await daemon.placement.lookup(oid) == "10.0.0.1:9000"
+    assert len(client.sent) == 1 and shard in daemon._held
+    stolen = await storage.get_lease(shard)
+    assert stolen.owner == "10.0.0.1:9000" and stolen.epoch > lease.epoch
+
+
+@pytest.mark.asyncio
+async def test_daemon_respects_foreign_lease_and_handoff():
+    storage = LocalReminderStorage(num_shards=4)
+    await storage.upsert(Reminder("Svc", "a", "r", period=10.0, next_due=100.0))
+    shard = storage.shard_for("Svc", "a")
+    # Another node holds the shard's lease (unexpired).
+    foreign = await storage.acquire_lease(shard, "10.0.0.2:9000", ttl=1000.0, now=100.0)
+    assert foreign is not None
+    client = StubClient()
+    daemon = await _one_node_daemon(storage, client, lease_ttl=60.0)
+    await daemon.poll_once(now=105.0)
+    # Directory seated us (nothing else claimed it) but the lease blocks
+    # ticking — exactly-one-node-ticks is the lease's job, not the seat's.
+    assert client.sent == [] and shard not in daemon._held
+    # Foreign owner releases (drain); our next poll acquires and ticks.
+    await storage.release_lease(shard, "10.0.0.2:9000", foreign.epoch)
+    await daemon.poll_once(now=106.0)
+    assert len(client.sent) == 1 and shard in daemon._held
+    # Our own handoff frees lease + seat for the next owner.
+    await daemon.handoff()
+    lease = await storage.get_lease(shard)
+    assert lease is not None and lease.expires_at == 0.0
+    assert await daemon.placement.lookup(ObjectId(SHARD_TYPE, str(shard))) is None

@@ -18,6 +18,8 @@ import asyncio
 import os
 import random
 
+import pytest
+
 from rio_tpu import ObjectId, ObjectPlacementItem
 from rio_tpu.object_placement.jax_placement import JaxObjectPlacement
 
@@ -129,6 +131,7 @@ async def _soak(seed: int) -> None:
             assert slot.alive and not slot.cordoned, (key, slot)
 
 
+@pytest.mark.slow
 def test_soak_random_ops():
     for seed in (3, 17):
         asyncio.run(asyncio.wait_for(_soak(seed), _seed_budget()))
@@ -198,6 +201,7 @@ async def _soak_persistent(seed: int) -> None:
     await p.aclose()
 
 
+@pytest.mark.slow
 def test_soak_persistent_backing_convergence():
     for seed in (5, 23):
         asyncio.run(asyncio.wait_for(_soak_persistent(seed), _seed_budget()))

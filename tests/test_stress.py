@@ -47,6 +47,7 @@ class StressProxy(ServiceObject):
         return SDone(hops=1)
 
 
+@pytest.mark.slow
 def test_million_actor_proxy_dispatch_no_deadlock():
     reg = Registry()
     reg.add_type(StressProxy)
@@ -216,6 +217,7 @@ async def test_churn_resolve_moves_only_affected_objects():
     "(~2 GB RSS, minutes; last banked run in the docstring below)",
 )
 @pytest.mark.asyncio
+@pytest.mark.slow
 async def test_row5_scale_directory_host_side():
     """BASELINE row-5's HOST half: the directory at 10M objects x 1k nodes.
 
