@@ -21,6 +21,7 @@ from .cluster.storage import LocalStorage, Member, MembershipStorage
 from .commands import AdminCommand, AdminSender, InternalClientSender, ServerInfo
 from .errors import RioError
 from .message_router import MessageRouter
+from .migration import MigrationManager, MigrationStats
 from .object_placement import LocalObjectPlacement, ObjectPlacement, ObjectPlacementItem
 from .registry import ObjectId, Registry, handler, message, type_id, type_name, wire_error
 from .registry.declarative import RegistryDeclaration, make_registry
@@ -53,6 +54,8 @@ __all__ = [
     "Member",
     "MembershipStorage",
     "MessageRouter",
+    "MigrationManager",
+    "MigrationStats",
     "ObjectId",
     "ObjectPlacement",
     "ObjectPlacementItem",

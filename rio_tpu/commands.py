@@ -18,6 +18,7 @@ class AdminCommandKind(Enum):
     SERVER_EXIT = "server_exit"
     SHUTDOWN_OBJECT = "shutdown_object"
     DRAIN_SERVER = "drain_server"
+    MIGRATE_OBJECT = "migrate_object"
 
 
 @dataclasses.dataclass
@@ -25,6 +26,7 @@ class AdminCommand:
     kind: AdminCommandKind
     type_name: str = ""
     object_id: str = ""
+    target: str = ""  # MIGRATE_OBJECT: destination node address
 
     @classmethod
     def server_exit(cls) -> "AdminCommand":
@@ -44,6 +46,13 @@ class AdminCommand:
     @classmethod
     def shutdown(cls, type_name: str, object_id: str) -> "AdminCommand":
         return cls(AdminCommandKind.SHUTDOWN_OBJECT, type_name, object_id)
+
+    @classmethod
+    def migrate(cls, type_name: str, object_id: str, target: str) -> "AdminCommand":
+        """Hand one locally-seated object to ``target`` through the full
+        migration protocol (pin → deactivate → snapshot → flip → fence) —
+        the ops/debug entry to the same path the rebalancer actuates."""
+        return cls(AdminCommandKind.MIGRATE_OBJECT, type_name, object_id, target)
 
 
 class AdminSender:

@@ -74,3 +74,19 @@ class MessageRouter:
             body=codec.serialize(msg), message_type=type_id(type(msg))
         )
         return self._channel(type_name, object_id).publish(resp)
+
+    def close_subscriptions(self, type_name: str, object_id: str, error) -> int:
+        """Terminate every live subscription on one object with ``error``.
+
+        Used by migration handoff: subscribers get a final error item
+        (``Redirect`` to the new owner) through the ordinary stream — the
+        client's subscribe loop treats it as "resubscribe at detail" — and
+        the channel is dropped so no publisher writes into dead queues.
+        Returns the number of subscribers notified.
+        """
+        ch = self._channels.pop((type_name, object_id), None)
+        if ch is None:
+            return 0
+        notified = ch.publish(SubscriptionResponse(error=error))
+        ch.queues.clear()
+        return notified

@@ -1,0 +1,464 @@
+"""Live object migration: coordinated state handoff behind the OT rebalancer.
+
+A solver re-seat used to be a raw directory write: the old node's in-memory
+activation was stranded, volatile state was lost, and a request racing the
+move could double-activate the object. This package turns every move into a
+coordinated handoff:
+
+1. **Pin** — the source marks the object migrating; the service layer
+   refuses new requests with a retryable ``DeallocateServiceObject``
+   (mirroring ``Service._refuse_if_draining``), and a synchronous
+   activation barrier in ``start_service_object`` closes the
+   passed-checks-before-the-pin race.
+2. **Deactivate + snapshot** — :meth:`~rio_tpu.registry.Registry.deactivate`
+   runs the SHUTDOWN lifecycle under the object's dispatch lock, persists
+   every ``managed_state`` field through the state backend, and serializes
+   opt-in volatile state (``__migrate_state__``) through the codec. The
+   lock plus ``send_raw``'s entry-identity recheck guarantee no handler
+   runs between snapshot and removal.
+3. **Transfer** — the volatile snapshot travels inline as an admin-style
+   actor message (:class:`InstallState`) to the target's node-scoped
+   :class:`MigrationInbox`, so clusters with no shared state backend still
+   migrate volatile state. The target stashes it and hands it to the fresh
+   activation's ``__restore_state__`` during the LOAD lifecycle.
+4. **Flip + fence** — the directory row is rewritten through the
+   ``ObjectPlacement`` trait (all four backends unchanged) only if it still
+   points at the source, and the source keeps a *fence*: any straggler
+   request is answered with a ``Redirect`` to the new owner, so a stale
+   source can never serve after the flip.
+
+Actuation comes from three places, all converging on
+:meth:`MigrationManager.migrate_out`: the placement daemon's rebalance
+(via the ``move_sink`` hook on ``JaxObjectPlacement.rebalance``), the admin
+command ``AdminCommand.migrate(...)``, and ``Server._drain_and_exit`` (a
+drain is just "migrate everything out, then stop"). Moves whose source is
+dead — or whose type has no live activation anywhere, like
+``rio.ReminderShard`` seat rows — degrade to a bare directory flip, which
+for those rows *is* the migration.
+
+Cross-node control traffic rides two **node-scoped** actors
+(``__node_scoped__ = True``: the object id is a node address; the service
+layer routes them without the directory, so the solver never re-seats
+them). :class:`MigrationControl` runs the long handoff; :class:`MigrationInbox`
+only stashes inbound snapshots. They are separate types on purpose: a
+symmetric A→B / B→A migration pair would distributed-deadlock if the
+snapshot install needed the same per-object lock the handoff holds.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass
+from typing import Any
+
+from .. import codec
+from ..app_data import AppData
+from ..cluster.storage import MembershipStorage
+from ..message_router import MessageRouter
+from ..object_placement import ObjectPlacement, ObjectPlacementItem
+from ..protocol import ResponseError
+from ..registry import ObjectId, Registry, handler, message, type_id, type_name
+from ..reminders.daemon import SHARD_TYPE
+from ..service_object import ServiceObject
+
+log = logging.getLogger("rio_tpu.migration")
+
+__all__ = [
+    "CONTROL_TYPE",
+    "INBOX_TYPE",
+    "InstallState",
+    "MigrateObject",
+    "MigrationAck",
+    "MigrationControl",
+    "MigrationInbox",
+    "MigrationManager",
+    "MigrationStats",
+]
+
+#: Wire type-names of the node-scoped control actors.
+CONTROL_TYPE = "rio.Migration"
+INBOX_TYPE = "rio.MigrationInbox"
+
+#: Inbound volatile snapshots are dropped after this long un-consumed (a
+#: handoff that aborted after its install must not leak stash entries).
+STASH_TTL = 120.0
+#: Fences outlive the flip long enough for every straggler to re-resolve;
+#: after this the directory alone is authoritative again.
+FENCE_TTL = 300.0
+
+
+@dataclass
+class MigrationStats:
+    """Counters exported through :func:`rio_tpu.otel.stats_gauges`."""
+
+    started: int = 0
+    completed: int = 0
+    aborted: int = 0
+    state_bytes: int = 0  # serialized volatile state transferred out
+    seat_flips: int = 0  # moves with no live activation: directory-only
+    refusals: int = 0  # requests bounced off a pin or fence
+    installs: int = 0  # inbound volatile snapshots stashed
+
+
+@message(name="rio.MigrateObject")
+class MigrateObject:
+    """Ask a source node to hand one of its objects to ``target``."""
+
+    type_name: str = ""
+    object_id: str = ""
+    target: str = ""
+
+
+@message(name="rio.InstallState")
+class InstallState:
+    """Inline volatile-state transfer, sent to the target before the flip."""
+
+    type_name: str = ""
+    object_id: str = ""
+    payload: bytes = b""
+
+
+@message(name="rio.MigrationAck")
+class MigrationAck:
+    ok: bool = False
+    detail: str = ""
+
+
+class MigrationManager:
+    """Per-node migration coordinator; injected into AppData by the Server.
+
+    One instance per server: the *source* role (pin → deactivate → snapshot
+    → transfer → flip → fence) lives in :meth:`migrate_out`; the *target*
+    role (stash → restore) in :meth:`install`/:meth:`restore_volatile`; the
+    *coordinator* role (actuating a whole rebalance plan) in
+    :meth:`apply_moves`.
+    """
+
+    def __init__(
+        self,
+        *,
+        address: str,
+        registry: Registry,
+        placement: ObjectPlacement,
+        members_storage: MembershipStorage,
+        app_data: AppData,
+        router: MessageRouter | None = None,
+        client: Any | None = None,
+    ) -> None:
+        self.address = address
+        self.registry = registry
+        self.placement = placement
+        self.members_storage = members_storage
+        self.app_data = app_data
+        self.router = router
+        self.stats = MigrationStats()
+        self._pinned: dict[tuple[str, str], str] = {}  # key -> target
+        self._fenced: dict[tuple[str, str], tuple[str, float]] = {}
+        self._stash: dict[tuple[str, str], tuple[bytes, float]] = {}
+        self._client = client
+
+    # ------------------------------------------------------------------
+    # Request-path refusals (single-activation fencing)
+    # ------------------------------------------------------------------
+
+    async def refusal_for(self, object_id: ObjectId) -> ResponseError | None:
+        """Directory-aware refusal at the top of the request path.
+
+        Pinned (handoff in flight) → ``DeallocateServiceObject``: the client
+        drops its cache, backs off, and re-resolves — a pre-flip redirect to
+        the target would just ping-pong back here. Fenced (flip done) →
+        ``Redirect`` to the directory's answer (falling back to the
+        remembered target); the fence clears itself when the directory
+        seats the object back on this node.
+        """
+        key = (object_id.type_name, object_id.id)
+        if key in self._pinned:
+            self.stats.refusals += 1
+            return ResponseError.deallocate()
+        fence = self._fenced.get(key)
+        if fence is None:
+            return None
+        addr = await self.placement.lookup(object_id)
+        if addr == self.address:
+            self._fenced.pop(key, None)  # solver seated it back here
+            return None
+        self.stats.refusals += 1
+        return ResponseError.redirect(addr if addr is not None else fence[0])
+
+    def activation_refusal(self, object_id: ObjectId) -> ResponseError | None:
+        """SYNChronous single-activation barrier.
+
+        Called by ``Service.start_service_object`` in the same event-loop
+        tick as the registry insert: a request that passed the async checks
+        *before* the pin went up, and resumed after the flip, must still be
+        refused here or the source would re-activate a migrated object.
+        """
+        key = (object_id.type_name, object_id.id)
+        if key in self._pinned:
+            self.stats.refusals += 1
+            return ResponseError.deallocate()
+        fence = self._fenced.get(key)
+        if fence is not None:
+            target, ts = fence
+            if time.monotonic() - ts > FENCE_TTL:
+                self._fenced.pop(key, None)
+                return None
+            self.stats.refusals += 1
+            return ResponseError.redirect(target)
+        return None
+
+    # ------------------------------------------------------------------
+    # Source role
+    # ------------------------------------------------------------------
+
+    async def migrate_out(self, object_id: ObjectId, target: str) -> bool:
+        """Hand ``object_id`` (seated here) to ``target``; True on success.
+
+        Safe orderings, in sequence: the pin goes up before anything else
+        (and the has-check shares its event-loop tick, so an activation
+        either precedes the pin — and is deactivated below — or hits the
+        barrier); managed state is persisted and volatile state serialized
+        under the object's dispatch lock; the volatile snapshot is installed
+        on the target *before* the flip (so the target's first activation
+        finds it); the fence is armed before the pin drops. Any failure
+        before the flip aborts with the directory untouched — the object
+        re-activates here (or wherever the lazy path seats it) from its
+        last persisted state.
+        """
+        key = (object_id.type_name, object_id.id)
+        if not target or target == self.address or key in self._pinned:
+            return False
+        if not await self.members_storage.is_active(target):
+            log.warning("migration of %s refused: target %s not active", object_id, target)
+            return False
+        self.stats.started += 1
+        self._pinned[key] = target
+        fenced = False
+        try:
+            volatile: list[bytes] = []
+            live = self.registry.has(object_id.type_name, object_id.id)
+            if live:
+
+                async def _snapshot(obj: Any) -> None:
+                    from ..state import managed_fields, save_state
+
+                    if managed_fields(type(obj)):
+                        await save_state(obj, self.app_data)
+                    snap = getattr(obj, "__migrate_state__", None)
+                    if snap is not None:
+                        value = snap()
+                        if asyncio.iscoroutine(value):
+                            value = await value
+                        volatile.append(codec.serialize(value))
+
+                live = await self.registry.deactivate(
+                    object_id.type_name,
+                    object_id.id,
+                    self.app_data,
+                    before_remove=_snapshot,
+                )
+            if volatile:
+                self.stats.state_bytes += len(volatile[0])
+                await self._install_on(target, object_id, volatile[0])
+            if await self.placement.lookup(object_id) == self.address:
+                await self.placement.update(
+                    ObjectPlacementItem(object_id=object_id, server_address=target)
+                )
+            elif live:
+                # Someone re-seated the row mid-handoff; their row wins and
+                # our deactivation degrades to an ordinary cold stop.
+                log.info("migration of %s lost the directory race", object_id)
+            self._fenced[key] = (target, time.monotonic())
+            fenced = True
+            if not live:
+                self.stats.seat_flips += 1
+            self.stats.completed += 1
+            if live and self.router is not None:
+                # Subscribers follow the object: terminate their streams
+                # with a Redirect so the client resubscribes at the target.
+                self.router.close_subscriptions(
+                    object_id.type_name,
+                    object_id.id,
+                    ResponseError.redirect(target),
+                )
+            return True
+        except Exception as e:
+            self.stats.aborted += 1
+            log.warning("migration of %s -> %s aborted: %r", object_id, target, e)
+            return False
+        finally:
+            self._pinned.pop(key, None)
+            if fenced:
+                self._prune_fences()
+
+    async def _install_on(
+        self, target: str, object_id: ObjectId, payload: bytes
+    ) -> None:
+        ack = await self._get_client().send(
+            INBOX_TYPE,
+            target,
+            InstallState(
+                type_name=object_id.type_name,
+                object_id=object_id.id,
+                payload=payload,
+            ),
+            returns=MigrationAck,
+        )
+        if not ack.ok:
+            raise RuntimeError(f"target {target} refused state install: {ack.detail}")
+
+    def _prune_fences(self) -> None:
+        now = time.monotonic()
+        for key, (_, ts) in list(self._fenced.items()):
+            if now - ts > FENCE_TTL:
+                self._fenced.pop(key, None)
+
+    # ------------------------------------------------------------------
+    # Target role
+    # ------------------------------------------------------------------
+
+    def install(self, tname: str, object_id: str, payload: bytes) -> None:
+        """Stash an inbound volatile snapshot until the activation claims it."""
+        now = time.monotonic()
+        for key, (_, ts) in list(self._stash.items()):
+            if now - ts > STASH_TTL:
+                self._stash.pop(key, None)
+        self._stash[(tname, object_id)] = (payload, now)
+        self.stats.installs += 1
+
+    def restore_volatile(self, obj: Any) -> None:
+        """LOAD-lifecycle hook: hand a stashed snapshot to the fresh
+        activation's ``__restore_state__`` (runs after ``load_state``, so
+        managed fields are already warm)."""
+        key = (type_id(type(obj)), obj.id)
+        stashed = self._stash.pop(key, None)
+        if stashed is None:
+            return
+        payload, ts = stashed
+        restore = getattr(obj, "__restore_state__", None)
+        if restore is None or time.monotonic() - ts > STASH_TTL:
+            return
+        restore(codec.deserialize(payload, Any))
+
+    # ------------------------------------------------------------------
+    # Coordinator role (the rebalancer's move sink)
+    # ------------------------------------------------------------------
+
+    async def apply_moves(self, moves: list[tuple[str, str, str]]) -> int:
+        """Actuate one rebalance plan: ``(directory_key, from, to)`` each.
+
+        Local sources run the handoff directly; live remote sources are
+        asked through their :class:`MigrationControl` actor; dead sources
+        and activation-less framework rows (reminder-shard seats) get the
+        bare directory flip, which for them *is* the migration. A failed
+        move leaves its row standing — the lazy request-path re-seat and
+        the next churn solve both cover it.
+        """
+        done = 0
+        for key, src, dst in moves:
+            oid = self._split_key(key)
+            if oid is None or src == dst:
+                if oid is None:
+                    log.warning("unroutable directory key %r; row left in place", key)
+                continue
+            try:
+                if src == self.address:
+                    done += int(await self.migrate_out(oid, dst))
+                    continue
+                if self.registry.has_type(oid.type_name) and (
+                    await self.members_storage.is_active(src)
+                ):
+                    ack = await self._get_client().send(
+                        CONTROL_TYPE,
+                        src,
+                        MigrateObject(
+                            type_name=oid.type_name, object_id=oid.id, target=dst
+                        ),
+                        returns=MigrationAck,
+                    )
+                    done += int(ack.ok)
+                    continue
+                # Dead source, or a row kind with no live activation to
+                # hand off (rio.ReminderShard seats): flip if unmoved.
+                if await self.placement.lookup(oid) == src:
+                    await self.placement.update(
+                        ObjectPlacementItem(object_id=oid, server_address=dst)
+                    )
+                    self.stats.seat_flips += 1
+                    done += 1
+            except Exception as e:
+                self.stats.aborted += 1
+                log.warning("move %s %s->%s failed: %r", key, src, dst, e)
+        return done
+
+    def _split_key(self, key: str) -> ObjectId | None:
+        """Invert ``ObjectId.__str__`` (``f"{type_name}.{id}"``).
+
+        Both halves may contain dots, so a blind split is ambiguous; the
+        registered type names (plus framework row kinds) disambiguate by
+        longest matching prefix, with a first-dot split as the fallback
+        for foreign rows.
+        """
+        best: str | None = None
+        for tname in [*self.registry.registered_types(), SHARD_TYPE]:
+            if key.startswith(tname + ".") and (best is None or len(tname) > len(best)):
+                best = tname
+        if best is not None:
+            return ObjectId(best, key[len(best) + 1 :])
+        head, sep, tail = key.partition(".")
+        return ObjectId(head, tail) if sep else None
+
+    # ------------------------------------------------------------------
+
+    def _get_client(self):
+        if self._client is None:
+            from ..client import Client
+
+            self._client = Client(
+                self.members_storage, placement_resolver=self._resolve
+            )
+        return self._client
+
+    async def _resolve(self, handler_type: str, handler_id: str) -> str | None:
+        if handler_type in (CONTROL_TYPE, INBOX_TYPE):
+            return handler_id  # node-scoped: the id IS the address
+        return await self.placement.lookup(ObjectId(handler_type, handler_id))
+
+    def close(self) -> None:
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+
+@type_name(CONTROL_TYPE)
+class MigrationControl(ServiceObject):
+    """Node-scoped handoff orchestrator (one per server; id = address)."""
+
+    __node_scoped__ = True
+
+    @handler
+    async def migrate_object(self, msg: MigrateObject, ctx: AppData) -> MigrationAck:
+        mgr = ctx.try_get(MigrationManager)
+        if mgr is None:
+            return MigrationAck(ok=False, detail="migration disabled on this node")
+        ok = await mgr.migrate_out(ObjectId(msg.type_name, msg.object_id), msg.target)
+        return MigrationAck(ok=ok)
+
+
+@type_name(INBOX_TYPE)
+class MigrationInbox(ServiceObject):
+    """Node-scoped snapshot receiver, deliberately separate from
+    :class:`MigrationControl`: installs must never queue behind a handoff
+    this node is running (symmetric migrations would deadlock)."""
+
+    __node_scoped__ = True
+
+    @handler
+    async def install_state(self, msg: InstallState, ctx: AppData) -> MigrationAck:
+        mgr = ctx.try_get(MigrationManager)
+        if mgr is None:
+            return MigrationAck(ok=False, detail="migration disabled on this node")
+        mgr.install(msg.type_name, msg.object_id, msg.payload)
+        return MigrationAck(ok=True)

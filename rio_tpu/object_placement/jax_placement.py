@@ -880,13 +880,21 @@ class JaxObjectPlacement(ObjectPlacement):
             )
         return res.assignment[:n], None
 
-    async def rebalance(self, *, mode: str | None = None) -> int:
+    async def rebalance(self, *, mode: str | None = None, move_sink=None) -> int:
         """Full re-solve of every tracked object; returns number of moves.
 
         Snapshots the epoch before the (async-yielding) device solve and
         discards the result if the directory changed underneath — the
         single-writer/versioned-epoch consistency design from ``SURVEY.md``
         §7 "hard parts".
+
+        ``move_sink`` (``async (list[(key, from_addr, to_addr)]) -> int``)
+        turns the apply phase from raw directory writes into *planned*
+        moves: the solve commits (epoch bump, so sibling solves discard)
+        but rows are left standing, and the sink — the migration
+        coordinator — actuates each move as a coordinated handoff whose
+        own ``update()`` flips the row. The sink runs OUTSIDE the
+        provider lock: handoffs call back into ``update``/``lookup``.
         """
         # An explicit mode="auto" resolves exactly like the constructor
         # default (it would otherwise fall through every dispatch check
@@ -1166,9 +1174,23 @@ class JaxObjectPlacement(ObjectPlacement):
             t_apply = time.perf_counter()
             mover_pos = np.nonzero(assignment != cur_idx)[0]
             moved = 0
+            planned: list[tuple[str, str, str]] = []
             for p in mover_pos.tolist():
-                if self._set_placement(keys[p], int(assignment[p])):
+                if move_sink is not None:
+                    # Plan, don't apply: the row flips when the sink's
+                    # handoff commits (or never, if it aborts — the lazy
+                    # request path and the next churn solve cover it).
+                    planned.append(
+                        (
+                            keys[p],
+                            node_order[int(cur_idx[p])],
+                            node_order[int(assignment[p])],
+                        )
+                    )
+                elif self._set_placement(keys[p], int(assignment[p])):
                     moved += 1
+            if move_sink is not None:
+                moved = len(planned)
             if g is not None:
                 self._g = g
             self._recount_loads()
@@ -1184,4 +1206,8 @@ class JaxObjectPlacement(ObjectPlacement):
                 discarded=False,
                 history=hist,
             )
-            return moved
+        if planned:
+            # Outside the lock on purpose: each handoff calls back into
+            # update()/lookup(), which take it.
+            await move_sink(planned)
+        return moved

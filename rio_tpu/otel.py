@@ -1,4 +1,4 @@
-"""Optional OpenTelemetry bridge for :mod:`rio_tpu.tracing`.
+"""Optional OpenTelemetry bridge for :mod:`rio_tpu.tracing` + metrics gauges.
 
 Reference: the observability example exports `tracing` spans via OTLP to
 Jaeger (``examples/observability/src/bin/observability_server.rs:37-63`` +
@@ -6,16 +6,141 @@ Jaeger (``examples/observability/src/bin/observability_server.rs:37-63`` +
 forwards every finished :class:`~rio_tpu.tracing.Span` — with its
 trace/span/parent correlation ids — through the ``opentelemetry`` SDK.
 
-The dependency is optional (``pip install rio-tpu[otel]`` style); importing
-this module without it raises a clear error, and nothing else in the
-framework touches it.
+Metrics ride the same split: :func:`stats_gauges`/:func:`server_gauges`
+flatten the framework's stats dataclasses (placement daemon, migration,
+reminders, client) into a ``name -> value`` gauge snapshot with **no SDK
+dependency** — scrape loops, tests, and debug dumps read it directly —
+while :func:`otlp_metrics_exporter` is the optional SDK-backed periodic
+push for deployments that have the packages.
+
+The OTel dependency is optional (``pip install rio-tpu[otel]`` style);
+the SDK-requiring entry points raise a clear error without it, and nothing
+else in the framework touches it.
 """
 
 from __future__ import annotations
 
-from typing import Callable
+import dataclasses
+from typing import Any, Callable
 
 from .tracing import Span
+
+
+def stats_gauges(**sources: Any) -> dict[str, float]:
+    """Flatten stats dataclasses into ``{"rio.<source>.<field>": value}``.
+
+    Each keyword names one stats object (``placement_daemon=daemon.stats,
+    migration=mgr.stats, ...``); every numeric dataclass field becomes one
+    gauge. ``None`` sources are skipped so callers can pass optional
+    subsystems unconditionally. Non-dataclass objects contribute their
+    numeric public attributes — duck-typed stats from tests/fakes work too.
+    """
+    gauges: dict[str, float] = {}
+    for source_name, stats in sources.items():
+        if stats is None:
+            continue
+        if dataclasses.is_dataclass(stats):
+            pairs = [
+                (f.name, getattr(stats, f.name))
+                for f in dataclasses.fields(stats)
+            ]
+        else:
+            pairs = [
+                (k, v) for k, v in vars(stats).items() if not k.startswith("_")
+            ]
+        for field_name, value in pairs:
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                continue
+            gauges[f"rio.{source_name}.{field_name}"] = float(value)
+    return gauges
+
+
+def server_gauges(server: Any) -> dict[str, float]:
+    """One node's full gauge snapshot: every wired subsystem's counters.
+
+    Works on a partially-wired :class:`~rio_tpu.server.Server` (daemons or
+    the migration manager absent → their gauges simply missing), so a
+    scrape loop can poll any node uniformly::
+
+        while True:
+            push(server_gauges(server))
+            await asyncio.sleep(15)
+    """
+    daemon = getattr(server, "placement_daemon", None)
+    rdaemon = getattr(server, "reminder_daemon", None)
+    migrator = getattr(server, "migration_manager", None)
+    placement = getattr(server, "object_placement", None)
+    gauges = stats_gauges(
+        placement_daemon=getattr(daemon, "stats", None),
+        reminder_daemon=getattr(rdaemon, "stats", None),
+        migration=getattr(migrator, "stats", None),
+        placement_solve=getattr(placement, "stats", None),
+    )
+    registry = getattr(server, "registry", None)
+    if registry is not None:
+        gauges["rio.registry.objects"] = float(registry.count_objects())
+    return gauges
+
+
+def otlp_metrics_exporter(
+    read_gauges: Callable[[], dict[str, float]],
+    endpoint: str = "http://127.0.0.1:4317",
+    service_name: str = "rio-tpu",
+    interval: float = 15.0,
+):
+    """Periodically export a gauge snapshot over OTLP/gRPC.
+
+    ``read_gauges`` is any zero-arg callable returning the
+    :func:`stats_gauges` shape (pass ``lambda: server_gauges(server)``).
+    Returns the SDK ``MeterProvider`` (call ``.shutdown()`` to stop).
+    Raises ``ImportError`` with install guidance when the optional
+    OpenTelemetry packages are absent — the SDK-free :func:`stats_gauges`
+    path needs nothing.
+    """
+    try:
+        from opentelemetry.exporter.otlp.proto.grpc.metric_exporter import (
+            OTLPMetricExporter,
+        )
+        from opentelemetry.sdk.metrics import MeterProvider
+        from opentelemetry.sdk.metrics.export import PeriodicExportingMetricReader
+        from opentelemetry.sdk.resources import Resource
+    except ImportError as e:  # pragma: no cover - env without otel
+        raise ImportError(
+            "otlp_metrics_exporter requires the optional OpenTelemetry "
+            "packages: pip install opentelemetry-sdk opentelemetry-exporter-otlp"
+        ) from e
+
+    reader = PeriodicExportingMetricReader(
+        OTLPMetricExporter(endpoint=endpoint),
+        export_interval_millis=interval * 1000.0,
+    )
+    provider = MeterProvider(
+        resource=Resource.create({"service.name": service_name}),
+        metric_readers=[reader],
+    )
+    meter = provider.get_meter("rio_tpu")
+    registered: set[str] = set()
+
+    def _register_all() -> None:
+        # Observable gauges bind one callback per instrument name; new
+        # gauge names appear as subsystems come online (first rebalance,
+        # first migration), so re-scan on every export via the callbacks.
+        for name in read_gauges():
+            if name in registered:
+                continue
+            registered.add(name)
+
+            def _cb(options, _name=name):  # noqa: ARG001 - SDK signature
+                from opentelemetry.metrics import Observation
+
+                value = read_gauges().get(_name)
+                return [] if value is None else [Observation(value)]
+
+            meter.create_observable_gauge(name, callbacks=[_cb])
+
+    _register_all()
+    provider._rio_register_new_gauges = _register_all  # scrape-loop hook
+    return provider
 
 
 def otlp_sink(

@@ -86,15 +86,33 @@ class PlacementDaemon:
         members_storage: MembershipStorage,
         placement: ObjectPlacement,
         config: PlacementDaemonConfig | None = None,
+        *,
+        migrator=None,
     ) -> None:
         self.members_storage = members_storage
         self.placement = placement
         self.config = config or PlacementDaemonConfig()
         self.stats = PlacementDaemonStats()
+        self.migrator = migrator  # MigrationManager: moves become handoffs
         self._last_liveness: frozenset[tuple[str, bool]] | None = None
         self._retry_solve = False  # last solve was epoch-discarded
         self._consecutive_discards = 0
         self._retry_not_before = float("-inf")  # backoff gate (loop time)
+
+    async def _rebalance(self, mode: str | None):
+        """Dispatch the re-solve, routing moves through the migration
+        coordinator when both sides support it (the provider's
+        ``move_sink`` hook and a wired :class:`MigrationManager`). Raw
+        directory writes remain the fallback for bare providers and
+        migration-less deployments."""
+        if self.migrator is not None:
+            import inspect
+
+            if "move_sink" in inspect.signature(self.placement.rebalance).parameters:
+                return await self.placement.rebalance(
+                    mode=mode, move_sink=self.migrator.apply_moves
+                )
+        return await self.placement.rebalance(mode=mode)
 
     @property
     def supported(self) -> bool:
@@ -181,7 +199,7 @@ class PlacementDaemon:
                         await asyncio.sleep(cfg.poll_interval)
                         continue
                     stats_before = getattr(self.placement, "stats", None)
-                    moved = await self.placement.rebalance(mode=cfg.mode)
+                    moved = await self._rebalance(cfg.mode)
                     last_rebalance = loop.time()
                     stats_now = getattr(self.placement, "stats", None)
                     # Attribute a discard to OUR attempt only when the

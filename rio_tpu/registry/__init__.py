@@ -87,6 +87,7 @@ class Registry:
         self._constructors: dict[str, Callable[[], Any]] = {}
         self._handlers: dict[tuple[str, str], HandlerSpec] = {}
         self._objects: dict[tuple[str, str], _Entry] = {}
+        self._node_scoped: set[str] = set()
 
     # -- type / handler registration (reference registry/mod.rs:82-182) ----
 
@@ -105,6 +106,13 @@ class Registry:
         """
         tname = type_id(cls)
         self._constructors[tname] = constructor or cls
+        if getattr(cls, "__node_scoped__", False):
+            # Node-scoped actors (one per server; the object id IS a node
+            # address) are routed without the placement directory — the
+            # service layer serves ``id == self.address`` locally and
+            # redirects everything else. Framework control planes (e.g.
+            # migration) use this so the solver never re-seats them.
+            self._node_scoped.add(tname)
         for spec in resolve_handlers(cls):
             # Lifecycle dispatch (activation Load) and reminder wakeups are
             # framework plumbing and must exist regardless of the declared
@@ -137,6 +145,9 @@ class Registry:
     def has_type(self, type_name: str) -> bool:
         return type_name in self._constructors
 
+    def is_node_scoped(self, type_name: str) -> bool:
+        return type_name in self._node_scoped
+
     def has_handler(self, type_name: str, message_type: str) -> bool:
         return (type_name, message_type) in self._handlers
 
@@ -166,6 +177,47 @@ class Registry:
     def remove(self, type_name: str, object_id: str) -> Any | None:
         entry = self._objects.pop((type_name, object_id), None)
         return entry.obj if entry else None
+
+    async def deactivate(
+        self,
+        type_name: str,
+        object_id: str,
+        app_data: Any,
+        *,
+        before_remove: Callable[[Any], Any] | None = None,
+    ) -> bool:
+        """Gracefully deactivate one live object under its dispatch lock.
+
+        Runs the SHUTDOWN lifecycle handler *directly* (dispatching a
+        LifecycleMessage through :meth:`send` would deadlock on the lock we
+        must hold), then the optional ``before_remove(obj)`` awaitable —
+        the migration snapshot seam — and finally drops the entry. Because
+        the lock is held end-to-end and :meth:`send_raw` rechecks entry
+        identity after acquiring it, no handler can observe the object
+        between snapshot and removal. Returns False when the object is not
+        live (or another deactivation won the race); lifecycle/snapshot
+        exceptions propagate with the object still seated — callers treat
+        that as an aborted deactivation.
+        """
+        from ..service_object import LifecycleKind, LifecycleMessage
+
+        key = (type_name, object_id)
+        entry = self._objects.get(key)
+        if entry is None:
+            return False
+        async with entry.lock:
+            if self._objects.get(key) is not entry:
+                return False
+            spec = self._handlers.get((type_name, "rio.LifecycleMessage"))
+            if spec is not None:
+                await spec.fn(
+                    entry.obj, LifecycleMessage(kind=LifecycleKind.SHUTDOWN), app_data
+                )
+            if before_remove is not None:
+                await before_remove(entry.obj)
+            if self._objects.get(key) is entry:
+                del self._objects[key]
+        return True
 
     def count_objects(self) -> int:
         return len(self._objects)
@@ -199,6 +251,13 @@ class Registry:
         msg = codec.deserialize(payload, spec.message_type)
         # Serialized &mut self execution: one handler at a time per object.
         async with entry.lock:
+            if self._objects.get((type_name, object_id)) is not entry:
+                # The object was deactivated (migration handoff, shutdown)
+                # while this request waited on the lock: running the handler
+                # would mutate a removed instance and silently lose the
+                # update. Surface a routing error instead — the client's
+                # Allocate retry re-resolves against the directory.
+                raise ObjectNotFound(f"{type_name}/{object_id}")
             try:
                 result = await spec.fn(entry.obj, msg, app_data)
             except Exception as e:  # noqa: BLE001 - triaged below

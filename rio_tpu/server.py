@@ -120,6 +120,7 @@ class Server:
         self._listener: asyncio.Server | None = None
         self._native_transport = None
         self._local_addr: str | None = None
+        self.migration_manager = None  # created at bind() (needs the address)
         self._admin = AdminSender()
         self._internal = InternalClientSender()
         self._draining = ServerDraining()
@@ -219,6 +220,23 @@ class Server:
             bound_host, bound_port = sock.getsockname()[:2]
         self._local_addr = self._advertised(bound_host, bound_port)
         self.app_data.set(ServerInfo(self._local_addr))
+        if self.migration_manager is None:
+            # Wire the migration control plane: the coordinator in AppData
+            # (service layer refusals + lifecycle restore find it there) and
+            # the two node-scoped actors every node must answer for.
+            from .migration import MigrationControl, MigrationInbox, MigrationManager
+
+            self.migration_manager = MigrationManager(
+                address=self._local_addr,
+                registry=self.registry,
+                placement=self.object_placement,
+                members_storage=self.members_storage,
+                app_data=self.app_data,
+                router=self.app_data.get(MessageRouter),
+            )
+            self.app_data.set(self.migration_manager)
+            self.registry.add_type(MigrationControl)
+            self.registry.add_type(MigrationInbox)
         return self._local_addr
 
     def _advertised(self, bound_host: str, bound_port: int) -> str:
@@ -287,6 +305,11 @@ class Server:
                 return
             if cmd.kind == AdminCommandKind.SHUTDOWN_OBJECT:
                 await self.shutdown_object(cmd.type_name, cmd.object_id)
+            if cmd.kind == AdminCommandKind.MIGRATE_OBJECT:
+                if self.migration_manager is not None:
+                    await self.migration_manager.migrate_out(
+                        ObjectId(cmd.type_name, cmd.object_id), cmd.target
+                    )
 
     async def _drain_and_exit(self) -> None:
         """The graceful exit flow behind ``AdminCommand.drain()``.
@@ -333,7 +356,7 @@ class Server:
                 else:
                     if hasattr(placement, "rebalance"):
                         with contextlib.suppress(Exception):
-                            await placement.rebalance()
+                            await self._drain_rebalance(placement)
             for _pass in range(10):
                 remaining = self.registry.object_ids()
                 if not remaining:
@@ -353,6 +376,22 @@ class Server:
             log.exception("%s: drain failed; exiting anyway", self._local_addr)
         finally:
             self._stopped.set()
+
+    async def _drain_rebalance(self, placement) -> None:
+        """The drain's cordon re-solve, as coordinated handoffs when the
+        provider supports planned moves: survivors receive our population's
+        volatile state instead of finding bare re-seated rows. Bare
+        ``rebalance()`` remains the fallback — the lifecycle pass below
+        still persists managed state either way."""
+        import inspect
+
+        if (
+            self.migration_manager is not None
+            and "move_sink" in inspect.signature(placement.rebalance).parameters
+        ):
+            await placement.rebalance(move_sink=self.migration_manager.apply_moves)
+        else:
+            await placement.rebalance()
 
     async def shutdown_object(self, type_name: str, object_id: str) -> None:
         """Run ``before_shutdown``, drop the instance, delete its placement.
@@ -411,6 +450,7 @@ class Server:
             daemon = PlacementDaemon(
                 self.members_storage, self.object_placement,
                 self.placement_daemon_config,
+                migrator=self.migration_manager,
             )
             self.placement_daemon = daemon
             tasks.append(asyncio.ensure_future(daemon.run()))
@@ -456,6 +496,8 @@ class Server:
             await asyncio.gather(*self._conn_tasks, return_exceptions=True)
             if self._listener is not None:
                 await self._listener.wait_closed()
+            if self.migration_manager is not None:
+                self.migration_manager.close()
             # Leaving the cluster: mark self inactive so peers stop routing here.
             with contextlib.suppress(Exception):
                 host, _, port = self.local_address.rpartition(":")

@@ -65,6 +65,9 @@ class Service:
         # observation hook (None for deployments without a tracker).
         observer = app_data.try_get(DispatchObserver)
         self._observe = observer.fn if observer is not None else None
+        from .migration import MigrationManager
+
+        self._migrator = app_data.try_get(MigrationManager)
 
     # ------------------------------------------------------------------
     # Placement (reference service.rs:193-298)
@@ -89,6 +92,26 @@ class Service:
         if addr == self.address:
             await self.object_placement.remove(object_id)
         return ResponseError.deallocate()
+
+    async def _route_node_scoped(self, object_id: ObjectId) -> ResponseError | None:
+        """Directory-less routing for node-scoped actors (id == an address).
+
+        These actors (migration control plane) exist once per server with
+        the node's own address as object id: serve locally when the id is
+        this node, redirect when it names a live peer, deallocate when it
+        names a dead one. The placement directory is never consulted or
+        written — the solver can't re-seat what has no row.
+        """
+        if object_id.id == self.address:
+            return None
+        if await self.members_storage.is_active(object_id.id):
+            return ResponseError.redirect(object_id.id)
+        return ResponseError.deallocate()
+
+    async def _refuse_if_migrating(self, object_id: ObjectId) -> ResponseError | None:
+        if self._migrator is None:
+            return None
+        return await self._migrator.refusal_for(object_id)
 
     async def get_or_create_placement(self, object_id: ObjectId) -> str:
         """Resolve the owning server for ``object_id``, self-assigning if free."""
@@ -130,6 +153,17 @@ class Service:
     async def start_service_object(self, object_id: ObjectId) -> ResponseError | None:
         if self.registry.has(object_id.type_name, object_id.id):
             return None
+        if self._migrator is not None and not self.registry.is_node_scoped(
+            object_id.type_name
+        ):
+            # Synchronous single-activation barrier: a request that passed
+            # the async refusal checks BEFORE the migration pin went up must
+            # not re-activate the object here after the handoff. This check
+            # and the insert below share one event-loop tick, so the pin
+            # cannot appear between them.
+            barred = self._migrator.activation_refusal(object_id)
+            if barred is not None:
+                return barred
         with span("object_activate", object=object_id):
             try:
                 obj = self.registry.new_from_type(object_id.type_name, object_id.id)
@@ -161,13 +195,23 @@ class Service:
         if not self.registry.has_type(req.handler_type):
             return ResponseEnvelope.err(ResponseError.not_supported(req.handler_type))
 
-        refusal = await self._refuse_if_draining(object_id)
-        if refusal is not None:
-            return ResponseEnvelope.err(refusal)
-        addr = await self.get_or_create_placement(object_id)
-        mismatch = await self.check_address_mismatch(addr)
-        if mismatch is not None:
-            return ResponseEnvelope.err(mismatch)
+        if self.registry.is_node_scoped(req.handler_type):
+            # Control-plane actors bypass drain/migration refusals too: a
+            # draining node must still answer MigrateObject — drain IS a
+            # migration storm.
+            routing = await self._route_node_scoped(object_id)
+            if routing is not None:
+                return ResponseEnvelope.err(routing)
+        else:
+            refusal = await self._refuse_if_draining(object_id)
+            if refusal is None:
+                refusal = await self._refuse_if_migrating(object_id)
+            if refusal is not None:
+                return ResponseEnvelope.err(refusal)
+            addr = await self.get_or_create_placement(object_id)
+            mismatch = await self.check_address_mismatch(addr)
+            if mismatch is not None:
+                return ResponseEnvelope.err(mismatch)
 
         start_err = await self.start_service_object(object_id)
         if start_err is not None:
@@ -229,13 +273,20 @@ class Service:
         object_id = ObjectId(req.handler_type, req.handler_id)
         if not self.registry.has_type(req.handler_type):
             return ResponseError.not_supported(req.handler_type)
-        refusal = await self._refuse_if_draining(object_id)
-        if refusal is not None:
-            return refusal
-        addr = await self.get_or_create_placement(object_id)
-        mismatch = await self.check_address_mismatch(addr)
-        if mismatch is not None:
-            return mismatch
+        if self.registry.is_node_scoped(req.handler_type):
+            routing = await self._route_node_scoped(object_id)
+            if routing is not None:
+                return routing
+        else:
+            refusal = await self._refuse_if_draining(object_id)
+            if refusal is None:
+                refusal = await self._refuse_if_migrating(object_id)
+            if refusal is not None:
+                return refusal
+            addr = await self.get_or_create_placement(object_id)
+            mismatch = await self.check_address_mismatch(addr)
+            if mismatch is not None:
+                return mismatch
         start_err = await self.start_service_object(object_id)
         if start_err is not None:
             return start_err

@@ -122,6 +122,7 @@ class ServiceObject:
             try:
                 await self.before_load(ctx)
                 await self.load_state(ctx)
+                self._restore_migrated_state(ctx)
                 await self.after_load(ctx)
             except Exception as e:
                 raise ServiceObjectLifeCycleError(str(e)) from e
@@ -130,6 +131,19 @@ class ServiceObject:
             # re-activate the object on this (possibly draining) node.
             cancel_timers(self)
             await self.before_shutdown(ctx)
+
+    def _restore_migrated_state(self, ctx: AppData) -> None:
+        """Claim a migrated volatile snapshot, if one awaits this activation.
+
+        Runs between ``load_state`` and ``after_load`` so ``__restore_state__``
+        sees warm managed fields and ``after_load`` sees the restored
+        volatile state. A no-op without a migration manager or stash entry.
+        """
+        from .migration import MigrationManager
+
+        mgr = ctx.try_get(MigrationManager)
+        if mgr is not None:
+            mgr.restore_volatile(self)
 
     @handler
     async def _handle_reminder(self, msg: ReminderFired, ctx: AppData) -> None:

@@ -1,0 +1,679 @@
+"""Live-migration handoff protocol: single-activation fencing, state
+transfer, rebalancer actuation, and failure-path recovery.
+
+The e2e test is the acceptance bar for the subsystem: a placement-daemon
+rebalance moves seated stateful objects between live nodes under concurrent
+client traffic with zero lost updates and zero double-activations, and
+reminder-shard seat rows flow through the same ``apply_moves`` path.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from rio_tpu import (
+    AdminCommand,
+    AppData,
+    LocalObjectPlacement,
+    LocalStorage,
+    Registry,
+    ServiceObject,
+    handler,
+    message,
+    type_name,
+)
+from rio_tpu.commands import ServerInfo
+from rio_tpu.errors import ObjectNotFound
+from rio_tpu.migration import (
+    CONTROL_TYPE,
+    INBOX_TYPE,
+    InstallState,
+    MigrationAck,
+    MigrationManager,
+    MigrationStats,
+)
+from rio_tpu.object_placement.jax_placement import JaxObjectPlacement
+from rio_tpu.placement_daemon import PlacementDaemonConfig
+from rio_tpu.protocol import ResponseError
+from rio_tpu.registry import ObjectId
+from rio_tpu.reminders.daemon import SHARD_TYPE
+from rio_tpu.state import LocalState, StateProvider, managed_state
+
+from .server_utils import (
+    Cluster,
+    run_integration_test,
+    wait_for_active_members,
+)
+
+# Module-level activation guards, reset by each test that uses them.
+ACTIVATIONS: dict[str, int] = {}  # id -> lifetime LOAD count
+ACTIVE: dict[str, str] = {}  # id -> address currently holding a live instance
+DOUBLE: list[str] = []  # ids that activated while already active somewhere
+
+
+def _reset_guards() -> None:
+    ACTIVATIONS.clear()
+    ACTIVE.clear()
+    DOUBLE.clear()
+
+
+@message
+class Add:
+    amount: int = 0
+
+
+@message
+class Get:
+    pass
+
+
+@message
+class Totals:
+    total: int = 0
+    hot: int = 0
+    address: str = ""
+
+
+@message
+class CounterState:
+    total: int = 0
+
+
+class Counter(ServiceObject):
+    """Stateful actor with both managed and volatile migratable state.
+
+    ``hot`` mirrors ``state.total`` but lives only in memory: after any
+    number of coordinated handoffs the two must still be equal — a fresh
+    (non-migrated) activation would reset ``hot`` to 0 and expose a lost
+    volatile snapshot.
+    """
+
+    state = managed_state(CounterState)
+
+    def __init__(self):
+        self.hot = 0
+
+    def __migrate_state__(self):
+        return {"hot": self.hot}
+
+    def __restore_state__(self, value):
+        self.hot = int(value["hot"])
+
+    async def after_load(self, ctx: AppData) -> None:
+        ACTIVATIONS[self.id] = ACTIVATIONS.get(self.id, 0) + 1
+        addr = ctx.get(ServerInfo).address
+        if self.id in ACTIVE:
+            DOUBLE.append(self.id)
+        ACTIVE[self.id] = addr
+
+    async def before_shutdown(self, ctx: AppData) -> None:
+        ACTIVE.pop(self.id, None)
+
+    @handler
+    async def add(self, msg: Add, ctx: AppData) -> Totals:
+        self.state.total += msg.amount
+        self.hot += msg.amount
+        await self.save_state(ctx)
+        return Totals(
+            total=self.state.total, hot=self.hot, address=ctx.get(ServerInfo).address
+        )
+
+    @handler
+    async def get(self, msg: Get, ctx: AppData) -> Totals:
+        return Totals(
+            total=self.state.total, hot=self.hot, address=ctx.get(ServerInfo).address
+        )
+
+
+def build_registry() -> Registry:
+    return Registry().add_type(Counter)
+
+
+# ---------------------------------------------------------------------------
+# Admin-command handoff: managed + volatile state survive, stats move
+# ---------------------------------------------------------------------------
+
+
+def test_admin_migrate_moves_state_and_volatile():
+    _reset_guards()
+    state = LocalState()
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        try:
+            out = await client.send(Counter, "c1", Add(amount=7), returns=Totals)
+            source_addr = out.address
+            out = await client.send(Counter, "c1", Add(amount=3), returns=Totals)
+            assert (out.total, out.hot) == (10, 10)
+
+            source = next(
+                s for s in cluster.servers if s.local_address == source_addr
+            )
+            target = next(
+                s for s in cluster.servers if s.local_address != source_addr
+            )
+            source.admin_sender().send(
+                AdminCommand.migrate("Counter", "c1", target.local_address)
+            )
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while asyncio.get_event_loop().time() < deadline:
+                if source.migration_manager.stats.completed:
+                    break
+                await asyncio.sleep(0.02)
+            assert source.migration_manager.stats.completed == 1
+            assert source.migration_manager.stats.started == 1
+            assert source.migration_manager.stats.aborted == 0
+            assert source.migration_manager.stats.state_bytes > 0
+            assert target.migration_manager.stats.installs == 1
+
+            # Directory flipped; source no longer holds the instance.
+            assert (
+                await cluster.allocation_address("Counter", "c1")
+                == target.local_address
+            )
+            assert not source.registry.has("Counter", "c1")
+
+            # The next request activates on the target with BOTH kinds of
+            # state intact — managed via the backend, volatile via the
+            # inline transfer.
+            out = await client.send(Counter, "c1", Add(amount=1), returns=Totals)
+            assert out.address == target.local_address
+            assert (out.total, out.hot) == (11, 11)
+            assert ACTIVATIONS["c1"] == 2
+            assert DOUBLE == []
+        finally:
+            client.close()
+
+    async def wrapped(cluster: Cluster):
+        for s in cluster.servers:
+            s.app_data.set(state, as_type=StateProvider)
+        await body(cluster)
+
+    asyncio.run(
+        run_integration_test(wrapped, registry_builder=build_registry, num_servers=2)
+    )
+
+
+# ---------------------------------------------------------------------------
+# E2E acceptance: daemon rebalance = live handoffs under concurrent traffic
+# ---------------------------------------------------------------------------
+
+
+def test_rebalance_actuates_live_handoffs_under_traffic():
+    """Boot a third node into a loaded 2-node cluster: the placement daemon
+    re-solves on the liveness change and every solver move runs as a
+    coordinated handoff between LIVE nodes, while clients keep writing.
+    Zero lost updates, zero double-activations, volatile state rides along,
+    and a reminder-shard seat row flips through the same move path."""
+    _reset_guards()
+    state = LocalState()
+    placement = JaxObjectPlacement(mode="greedy", move_cost=0.5)
+    daemon_cfg = PlacementDaemonConfig(
+        poll_interval=0.1, debounce=0.05, min_rebalance_interval=0.1
+    )
+    n_objects = 12
+    keys = [f"c{i}" for i in range(n_objects)]
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        third = None
+        third_task = None
+        acked = {k: 0 for k in keys}
+        failures: list[str] = []
+        stop_traffic = asyncio.Event()
+
+        async def traffic():
+            while not stop_traffic.is_set():
+                k = random.choice(keys)
+                for attempt in range(3):
+                    try:
+                        await client.send(Counter, k, Add(amount=1), returns=Totals)
+                        acked[k] += 1
+                        break
+                    except Exception:
+                        if attempt == 2:
+                            failures.append(k)
+                        await asyncio.sleep(0.05)
+                await asyncio.sleep(0.005)
+
+        try:
+            for k in keys:
+                await client.send(Counter, k, Add(amount=1), returns=Totals)
+                acked[k] += 1
+            # Seed a reminder-shard seat row beside the object population.
+            from rio_tpu.object_placement import ObjectPlacementItem
+
+            shard_oid = ObjectId(SHARD_TYPE, "3")
+            await placement.update(
+                ObjectPlacementItem(shard_oid, cluster.addresses[0])
+            )
+
+            traffic_task = asyncio.create_task(traffic())
+            await asyncio.sleep(0.3)
+
+            # Boot the third node mid-traffic: its registration is the
+            # liveness change that arms every daemon.
+            from rio_tpu import Server
+            from rio_tpu.cluster.membership_protocol import LocalClusterProvider
+
+            third = Server(
+                address="127.0.0.1:0",
+                registry=build_registry(),
+                cluster_provider=LocalClusterProvider(cluster.members),
+                object_placement_provider=placement,
+                app_data=AppData().set(state, as_type=StateProvider),
+                placement_daemon=True,
+                placement_daemon_config=daemon_cfg,
+            )
+            await third.prepare()
+            await third.bind()
+            third_task = asyncio.create_task(third.run())
+            await wait_for_active_members(cluster.members, 3)
+
+            managers = [s.migration_manager for s in cluster.servers] + [
+                third.migration_manager
+            ]
+            deadline = asyncio.get_event_loop().time() + 20.0
+            while asyncio.get_event_loop().time() < deadline:
+                if sum(m.stats.completed for m in managers) > 0:
+                    break
+                await asyncio.sleep(0.05)
+            assert sum(m.stats.completed for m in managers) > 0, (
+                "no coordinated handoff ran after the liveness change"
+            )
+            await asyncio.sleep(0.5)  # let a little post-move traffic land
+
+            stop_traffic.set()
+            await traffic_task
+            assert not failures, f"writes failed outright: {failures}"
+
+            # Zero lost updates + volatile state followed every move.
+            all_addrs = set(cluster.addresses) | {third.local_address}
+            for k in keys:
+                out = await client.send(Counter, k, Get(), returns=Totals)
+                assert out.total == acked[k], (
+                    f"{k}: {acked[k]} acked writes but total={out.total}"
+                )
+                assert out.hot == out.total, (
+                    f"{k}: volatile state lost in handoff "
+                    f"(hot={out.hot}, total={out.total})"
+                )
+                assert out.address in all_addrs
+            assert DOUBLE == [], f"double activations: {DOUBLE}"
+
+            # Reminder-shard rows ride the same apply_moves path: ask a
+            # coordinator to move the seeded seat row; with no live
+            # activation to hand off it must flip the row directly.
+            mover = cluster.servers[0].migration_manager
+            src = await placement.lookup(shard_oid)
+            dst = next(a for a in sorted(all_addrs) if a != src)
+            moved = await mover.apply_moves([(f"{SHARD_TYPE}.3", src, dst)])
+            assert moved == 1
+            assert await placement.lookup(shard_oid) == dst
+        finally:
+            stop_traffic.set()
+            client.close()
+            if third_task is not None:
+                third_task.cancel()
+                await asyncio.gather(third_task, return_exceptions=True)
+
+    async def wrapped(cluster: Cluster):
+        for s in cluster.servers:
+            s.app_data.set(state, as_type=StateProvider)
+        await body(cluster)
+
+    asyncio.run(
+        run_integration_test(
+            wrapped,
+            registry_builder=build_registry,
+            num_servers=2,
+            placement=placement,
+            timeout=60.0,
+            server_kwargs={
+                "placement_daemon": True,
+                "placement_daemon_config": daemon_cfg,
+            },
+        )
+    )
+
+
+# ---------------------------------------------------------------------------
+# Chaos: source dies mid-migration → exactly-once reactivation from
+# last persisted state
+# ---------------------------------------------------------------------------
+
+
+def test_source_death_mid_migration_reactivates_once_from_persisted_state():
+    _reset_guards()
+    state = LocalState()
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        try:
+            out = await client.send(Counter, "c1", Add(amount=5), returns=Totals)
+            source = next(
+                s for s in cluster.servers if s.local_address == out.address
+            )
+            survivor = next(
+                s for s in cluster.servers if s.local_address != out.address
+            )
+
+            # The transfer RPC dies mid-handoff (network partition between
+            # deactivate and install): the migration must abort with the
+            # managed snapshot already persisted and the directory untouched.
+            async def failing_install(target, oid, payload):
+                raise OSError("network partition mid-transfer")
+
+            source.migration_manager._install_on = failing_install
+            ok = await source.migration_manager.migrate_out(
+                ObjectId("Counter", "c1"), survivor.local_address
+            )
+            assert ok is False
+            assert source.migration_manager.stats.aborted == 1
+            assert not source.registry.has("Counter", "c1")
+            assert (
+                await cluster.allocation_address("Counter", "c1")
+                == source.local_address
+            )
+
+            # Now the wounded source dies outright.
+            source.admin_sender().send(AdminCommand.server_exit())
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while asyncio.get_event_loop().time() < deadline:
+                if not await cluster.members.is_active(source.local_address):
+                    break
+                await asyncio.sleep(0.02)
+
+            # First read re-seats on the survivor and reloads the LAST
+            # PERSISTED state — exactly one reactivation, nothing doubled.
+            out = await client.send(Counter, "c1", Get(), returns=Totals)
+            assert out.address == survivor.local_address
+            assert out.total == 5  # the pre-abort snapshot survived
+            assert out.hot == 0  # volatile is gone by design: never installed
+            assert ACTIVATIONS["c1"] == 2  # initial + exactly one recovery
+        finally:
+            client.close()
+
+    async def wrapped(cluster: Cluster):
+        for s in cluster.servers:
+            s.app_data.set(state, as_type=StateProvider)
+        await body(cluster)
+
+    asyncio.run(
+        run_integration_test(wrapped, registry_builder=build_registry, num_servers=2)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Node-scoped control plane routing
+# ---------------------------------------------------------------------------
+
+
+def test_node_scoped_inbox_routes_by_address():
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        try:
+            target = cluster.servers[1]
+            # Sent blind through the cluster client: whichever node takes
+            # the request must redirect to the id-named node, which serves.
+            ack = await client.send(
+                INBOX_TYPE,
+                target.local_address,
+                InstallState(type_name="Counter", object_id="x", payload=b"\x01"),
+                returns=MigrationAck,
+            )
+            assert ack.ok
+            assert ("Counter", "x") in target.migration_manager._stash
+            # No directory row was written for the control actor.
+            assert (
+                await cluster.placement.lookup(
+                    ObjectId(INBOX_TYPE, target.local_address)
+                )
+                is None
+            )
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(body, registry_builder=build_registry, num_servers=2)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Unit: refusal/fence state machine
+# ---------------------------------------------------------------------------
+
+
+def _bare_manager(address="1.1.1.1:1", registry=None) -> MigrationManager:
+    return MigrationManager(
+        address=address,
+        registry=registry or Registry(),
+        placement=LocalObjectPlacement(),
+        members_storage=LocalStorage(),
+        app_data=AppData(),
+    )
+
+
+def test_pin_and_fence_refusals():
+    async def run():
+        mgr = _bare_manager()
+        oid = ObjectId("Counter", "c1")
+
+        assert await mgr.refusal_for(oid) is None
+        assert mgr.activation_refusal(oid) is None
+
+        mgr._pinned[("Counter", "c1")] = "2.2.2.2:2"
+        err = await mgr.refusal_for(oid)
+        assert err is not None and err.kind == ResponseError.deallocate().kind
+        err = mgr.activation_refusal(oid)
+        assert err is not None and err.kind == ResponseError.deallocate().kind
+        mgr._pinned.clear()
+
+        import time
+
+        mgr._fenced[("Counter", "c1")] = ("2.2.2.2:2", time.monotonic())
+        err = await mgr.refusal_for(oid)
+        assert err is not None and err.kind == ResponseError.redirect("x").kind
+        assert err.detail == "2.2.2.2:2"  # directory empty → remembered target
+        err = mgr.activation_refusal(oid)
+        assert err is not None and err.detail == "2.2.2.2:2"
+
+        # The fence clears itself when the directory seats the object
+        # back on this node (a later solve moved it home).
+        from rio_tpu.object_placement import ObjectPlacementItem
+
+        await mgr.placement.update(ObjectPlacementItem(oid, mgr.address))
+        assert await mgr.refusal_for(oid) is None
+        assert ("Counter", "c1") not in mgr._fenced
+        assert mgr.stats.refusals == 4
+
+    asyncio.run(run())
+
+
+def test_split_key_prefers_longest_registered_type():
+    @type_name("acme.Counter.v2")
+    class Dotted(ServiceObject):
+        pass
+
+    mgr = _bare_manager(registry=Registry().add_type(Dotted))
+    assert mgr._split_key("acme.Counter.v2.user.42") == ObjectId(
+        "acme.Counter.v2", "user.42"
+    )
+    # Framework shard rows parse without being registry types.
+    assert mgr._split_key(f"{SHARD_TYPE}.7") == ObjectId(SHARD_TYPE, "7")
+    # Foreign rows degrade to a first-dot split; the dotless are unroutable.
+    assert mgr._split_key("Other.x") == ObjectId("Other", "x")
+    assert mgr._split_key("nodots") is None
+
+
+def test_apply_moves_flips_dead_source_and_shard_rows():
+    async def run():
+        members = LocalStorage()
+        await members.set_active("9.9.9.9", 9)
+        placement = LocalObjectPlacement()
+        mgr = MigrationManager(
+            address="9.9.9.9:9",
+            registry=Registry(),
+            placement=placement,
+            members_storage=members,
+            app_data=AppData(),
+        )
+        from rio_tpu.object_placement import ObjectPlacementItem
+
+        shard = ObjectId(SHARD_TYPE, "3")
+        dead_obj = ObjectId("Ghost", "g1")
+        await placement.update(ObjectPlacementItem(shard, "1.1.1.1:1"))
+        await placement.update(ObjectPlacementItem(dead_obj, "1.1.1.1:1"))
+
+        moved = await mgr.apply_moves(
+            [
+                (f"{SHARD_TYPE}.3", "1.1.1.1:1", "2.2.2.2:2"),
+                ("Ghost.g1", "1.1.1.1:1", "2.2.2.2:2"),  # dead src, foreign type
+                ("Ghost.g1", "3.3.3.3:3", "3.3.3.3:3"),  # src==dst: skipped
+                ("nodots", "1.1.1.1:1", "2.2.2.2:2"),  # unroutable: skipped
+            ]
+        )
+        assert moved == 2
+        assert await placement.lookup(shard) == "2.2.2.2:2"
+        assert await placement.lookup(dead_obj) == "2.2.2.2:2"
+        assert mgr.stats.seat_flips == 2
+
+        # A row someone already re-seated must NOT be flipped again.
+        await placement.update(ObjectPlacementItem(shard, "5.5.5.5:5"))
+        moved = await mgr.apply_moves([(f"{SHARD_TYPE}.3", "1.1.1.1:1", "2.2.2.2:2")])
+        assert moved == 0
+        assert await placement.lookup(shard) == "5.5.5.5:5"
+
+    asyncio.run(run())
+
+
+def test_migrate_out_refuses_bad_targets():
+    async def run():
+        mgr = _bare_manager()
+        oid = ObjectId("Counter", "c1")
+        assert not await mgr.migrate_out(oid, "")  # no target
+        assert not await mgr.migrate_out(oid, mgr.address)  # self-move
+        assert not await mgr.migrate_out(oid, "2.2.2.2:2")  # target not active
+        assert mgr.stats.started == 0
+
+        mgr._pinned[("Counter", "c1")] = "3.3.3.3:3"
+        members = mgr.members_storage
+        await members.set_active("2.2.2.2", 2)
+        assert not await mgr.migrate_out(oid, "2.2.2.2:2")  # already pinned
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# Unit: registry deactivation under the dispatch lock
+# ---------------------------------------------------------------------------
+
+
+def test_registry_deactivate_fences_queued_dispatch():
+    """A request already queued on the object lock when deactivation wins
+    must surface ObjectNotFound, not run against the removed instance."""
+
+    @message
+    class Slow:
+        pass
+
+    release = asyncio.Event()
+    runs: list[str] = []
+
+    class Sleepy(ServiceObject):
+        @handler
+        async def slow(self, msg: Slow, ctx: AppData) -> int:
+            runs.append(self.id)
+            await release.wait()
+            return 1
+
+    async def run():
+        reg = Registry().add_type(Sleepy)
+        app = AppData()
+        reg.insert("Sleepy", "s1", reg.new_from_type("Sleepy", "s1"))
+
+        from rio_tpu import codec
+
+        first = asyncio.create_task(
+            reg.send_raw("Sleepy", "s1", "Slow", codec.serialize(Slow()), app)
+        )
+        await asyncio.sleep(0.01)  # first holds the lock
+        # Lock waiters wake FIFO: deactivation queues ahead of the request.
+        deact = asyncio.create_task(reg.deactivate("Sleepy", "s1", app))
+        await asyncio.sleep(0.01)
+        queued = asyncio.create_task(
+            reg.send_raw("Sleepy", "s1", "Slow", codec.serialize(Slow()), app)
+        )
+        await asyncio.sleep(0.01)
+        release.set()
+
+        await first  # completes normally
+        assert await deact is True
+        with pytest.raises(ObjectNotFound):
+            await queued
+        assert runs == ["s1"]  # the queued request never ran a handler
+        assert not reg.has("Sleepy", "s1")
+
+        # Deactivating a non-live object reports False.
+        assert await reg.deactivate("Sleepy", "s1", app) is False
+
+    asyncio.run(run())
+
+
+def test_registry_deactivate_runs_snapshot_under_lock():
+    async def run():
+        reg = Registry().add_type(Counter)
+        app = AppData()
+        obj = reg.new_from_type("Counter", "c9")
+        obj.hot = 42
+        reg.insert("Counter", "c9", obj)
+
+        seen: list[int] = []
+
+        async def snap(o):
+            seen.append(o.hot)
+
+        assert await reg.deactivate("Counter", "c9", app, before_remove=snap)
+        assert seen == [42]
+        assert not reg.has("Counter", "c9")
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# Satellite: otel gauges
+# ---------------------------------------------------------------------------
+
+
+def test_stats_gauges_flatten_and_exporter_gates():
+    from rio_tpu.otel import otlp_metrics_exporter, stats_gauges
+    from rio_tpu.placement_daemon import PlacementDaemonStats
+
+    gauges = stats_gauges(
+        placement_daemon=PlacementDaemonStats(polls=4, moves=2),
+        migration=MigrationStats(started=3, state_bytes=128),
+        absent=None,
+    )
+    assert gauges["rio.placement_daemon.polls"] == 4.0
+    assert gauges["rio.placement_daemon.moves"] == 2.0
+    assert gauges["rio.migration.started"] == 3.0
+    assert gauges["rio.migration.state_bytes"] == 128.0
+    assert not any(k.startswith("rio.absent") for k in gauges)
+
+    # The SDK-backed exporter is optional and must gate loudly without it.
+    with pytest.raises(ImportError, match="opentelemetry"):
+        otlp_metrics_exporter(lambda: gauges)
+
+
+def test_server_gauges_cover_wired_subsystems():
+    from rio_tpu.otel import server_gauges
+
+    async def body(cluster: Cluster):
+        gauges = server_gauges(cluster.servers[0])
+        assert "rio.migration.started" in gauges
+        assert "rio.registry.objects" in gauges
+
+    asyncio.run(
+        run_integration_test(body, registry_builder=build_registry, num_servers=1)
+    )

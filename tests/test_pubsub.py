@@ -103,6 +103,65 @@ def test_subscribe_from_cold_cache_follows_redirect():
     )
 
 
+def test_subscriber_follows_migrated_publisher():
+    """A live migration of the publisher terminates its streams with a
+    Redirect; the client's subscribe loop resubscribes at the new owner and
+    keeps receiving events published after the move."""
+
+    async def body(cluster: Cluster):
+        from rio_tpu import AdminCommand
+
+        client = cluster.client()
+        await client.send(Broadcaster, "b4", Publish(text="seed"), returns=Done)
+        source_addr = await cluster.allocation_address("Broadcaster", "b4")
+        source = next(s for s in cluster.servers if s.local_address == source_addr)
+        target = next(s for s in cluster.servers if s.local_address != source_addr)
+
+        stream = await client.subscribe(Broadcaster, "b4")
+        received: list[str] = []
+
+        async def consume():
+            async for event in stream:
+                received.append(event.text)
+                if "after-move" in received:
+                    return
+
+        consumer = asyncio.create_task(consume())
+        await asyncio.sleep(0.3)  # let the subscription attach on the source
+        await client.send(Broadcaster, "b4", Publish(text="before-move"), returns=Done)
+
+        source.admin_sender().send(
+            AdminCommand.migrate("Broadcaster", "b4", target.local_address)
+        )
+        deadline = asyncio.get_event_loop().time() + 10.0
+        while asyncio.get_event_loop().time() < deadline:
+            if source.migration_manager.stats.completed:
+                break
+            await asyncio.sleep(0.02)
+        assert source.migration_manager.stats.completed == 1
+
+        # Publish at the NEW owner until the resubscribed stream delivers:
+        # the redirect item and the reconnect race, so one publish may land
+        # between streams and is legitimately unreceived.
+        deadline = asyncio.get_event_loop().time() + 10.0
+        while asyncio.get_event_loop().time() < deadline and not consumer.done():
+            await client.send(
+                Broadcaster, "b4", Publish(text="after-move"), returns=Done
+            )
+            await asyncio.sleep(0.1)
+        await asyncio.wait_for(consumer, timeout=5)
+
+        assert "before-move" in received
+        assert "after-move" in received
+        assert (
+            await cluster.allocation_address("Broadcaster", "b4")
+            == target.local_address
+        )
+        client.close()
+
+    asyncio.run(run_integration_test(body, registry_builder=build_registry, num_servers=2))
+
+
 def test_multiple_subscribers_fan_out():
     async def body(cluster: Cluster):
         client = cluster.client()
