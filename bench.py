@@ -43,6 +43,7 @@ EXIT_INIT_FAIL = 97  # jax backend never came up — do not try more TPU tiers
 EXIT_SOLVE_FAIL = 98  # tier failed (e.g. OOM) — a smaller tier may fit
 EXIT_WATCHDOG = 99  # deadline hit during backend init — treat as wedged
 EXIT_TIER_TIMEOUT = 96  # deadline hit after a healthy probe — smaller tier may fit
+EXIT_PREFLIGHT_HANG = 95  # hier pre-flight PULL hung — relay likely wedged, not slow
 
 PROBE_DEADLINE_S = 120.0
 
@@ -168,13 +169,18 @@ def live_route_hops() -> dict:
 # ---------------------------------------------------------------------------
 
 
-def _arm_watchdog(seconds: float, code: int) -> threading.Timer:
+def _arm_watchdog(
+    seconds: float, code: int, note: str | None = None
+) -> threading.Timer:
     """Hard in-process deadline: fires even if the main thread is stuck in C."""
 
     def fire():
         # One stderr line before dying so a silent rc in the parent's log
         # is attributable (r4: the hier child vanished with bare rc=99).
-        print(f"# watchdog fired after {seconds:.0f}s -> exit {code}",
+        # `note` lets a caller distinguish WHAT was hung (r5: a clean
+        # "measured slow" skip and a hung-pull watchdog shared one rc).
+        print(f"# watchdog fired after {seconds:.0f}s -> exit {code}"
+              + (f" ({note})" if note else ""),
               file=sys.stderr, flush=True)
         os._exit(code)
 
@@ -1009,7 +1015,11 @@ def run_hier_tier(n_obj: int, deadline: float, platform: str = "tpu") -> None:
             # harmless class — but exiting in seconds beats exiting after
             # the parent gave up): bound the pre-flight with its own
             # short watchdog.
-            preflight_timer = _arm_watchdog(90.0, EXIT_TIER_TIMEOUT)
+            preflight_timer = _arm_watchdog(
+                90.0,
+                EXIT_PREFLIGHT_HANG,
+                note="hier pre-flight pull hung; relay likely wedged",
+            )
             pull_ms = float("inf")
             for _ in range(3):
                 x = jax.device_put(_np.zeros(1 << 20, _np.float32))
@@ -1331,6 +1341,32 @@ def rpc_throughput(baseline: float | None = None) -> dict:
     return rates
 
 
+def migration_drain() -> dict:
+    """Migrations/sec + mean pinned-window ms for a 1k-object drain,
+    batched+prefetch vs per-key actuation, measured in the SAME session
+    (the speedup ratio is the stable artifact; absolute rates drift with
+    the box like every host-stage number)."""
+    import asyncio
+
+    from rio_tpu.utils.migration_live import measure_migration_drain
+
+    out = asyncio.run(measure_migration_drain())
+    pk, bt = out["per_key"], out["batched"]
+    print(
+        f"# migration drain ({out['n_objects']} objects x "
+        f"{out['payload_bytes']} B volatile state, 2 servers): "
+        f"batched+prefetch {bt['migrations_per_sec']:,.0f}/s "
+        f"(pinned mean {bt['pinned_ms_mean']} ms, {bt['bursts']} bursts, "
+        f"{bt['prefetch_hits']} prefetch hits) vs per-key "
+        f"{pk['migrations_per_sec']:,.0f}/s "
+        f"(pinned mean {pk['pinned_ms_mean']} ms) = "
+        f"{out.get('speedup', 0):.2f}x, pinned-window ratio "
+        f"{out.get('pinned_window_ratio', 0):.3f}",
+        file=sys.stderr,
+    )
+    return out
+
+
 _TPU_PLATFORMS = os.environ.get("JAX_PLATFORMS")  # as the driver launched us
 
 
@@ -1512,8 +1548,15 @@ def main() -> None:
                 print(f"# row-5 hier tier: {hier}", file=sys.stderr)
             elif rc == EXIT_TIER_TIMEOUT:
                 print(
-                    "# hier tier skipped by child pre-flight (relay "
-                    "degraded); banked evidence stands",
+                    "# hier tier skipped by child pre-flight (measured "
+                    "slow, exited cleanly); banked evidence stands",
+                    file=sys.stderr,
+                )
+            elif rc == EXIT_PREFLIGHT_HANG:
+                print(
+                    "# hier pre-flight pull HUNG (watchdog exit, not a "
+                    "clean skip) — treat the relay as wedged; do not "
+                    "launch further TPU children this round",
                     file=sys.stderr,
                 )
     # Device tiers are done — bank them NOW, before the host-side stages
@@ -1528,6 +1571,10 @@ def main() -> None:
         detail["rpc_msgs_per_sec"] = rpc_throughput(baseline)
     except Exception as e:
         print(f"# rpc throughput failed: {e!r}", file=sys.stderr)
+    try:
+        detail["migration_drain"] = migration_drain()
+    except Exception as e:
+        print(f"# migration drain failed: {e!r}", file=sys.stderr)
     try:
         detail["scaled_routing"] = scaled_route_hops()
     except Exception as e:
@@ -1658,8 +1705,14 @@ if __name__ == "__main__":
     parser.add_argument("--deadline", type=float, default=300.0)
     parser.add_argument("--hier", action="store_true")
     parser.add_argument("--collapsed", action="store_true")
+    # Rehearse the migration-drain host stage alone (CPU-safe: in-process
+    # live cluster, never touches the relay).
+    parser.add_argument("--migration", action="store_true")
     args = parser.parse_args()
-    if args.tier is not None and args.hier:
+    if args.migration:
+        _pin_orchestrator_to_cpu()
+        print(json.dumps(migration_drain()))
+    elif args.tier is not None and args.hier:
         run_hier_tier(args.tier, args.deadline, args.platform)
     elif args.tier is not None and args.collapsed:
         run_collapsed_tier(args.tier, args.platform, args.deadline)

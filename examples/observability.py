@@ -12,6 +12,14 @@ subscriber layers are. This demo registers two sinks:
 * an in-process aggregator standing where an OTLP exporter would go —
   any callable ``Span -> None`` can forward to a collector.
 
+Spans cover the request path; the *counter* side of observability is
+``rio_tpu.otel.server_gauges``: one flat snapshot of every wired
+subsystem's stats (placement daemon, reminder daemon, migration manager,
+solver). This demo runs a :func:`gauge_reader` task alongside the servers
+— the in-process analogue of a Prometheus scrape loop — logging only the
+gauges that CHANGED since the previous tick, so a quiet cluster logs
+nothing and a busy one shows exactly which counters are moving.
+
 Spans carry contextvar-propagated ``trace_id``/``span_id``/``parent_id``:
 one request's ``request`` → ``placement_lookup`` → ``object_activate`` →
 ``handler_dispatch`` spans share a trace, exactly like the reference's
@@ -47,6 +55,39 @@ from rio_tpu import (
 )
 from rio_tpu import tracing
 from rio_tpu.cluster.membership_protocol import LocalClusterProvider
+from rio_tpu.otel import server_gauges
+
+gauge_log = logging.getLogger("rio_tpu.examples.gauges")
+
+
+async def gauge_reader(servers: list, interval: float = 0.5) -> None:
+    """Periodically log ``server_gauges`` DELTAS for every node.
+
+    The in-process stand-in for a metrics scrape loop (the exporter version
+    is ``rio_tpu.otel.otlp_metrics_exporter``): snapshot each node's flat
+    gauge dict every ``interval`` seconds and log the counters that moved,
+    as ``name +delta=now``. Runs until cancelled, like the server tasks.
+    """
+    previous: dict[int, dict[str, float]] = {}
+    while True:
+        await asyncio.sleep(interval)
+        for i, server in enumerate(servers):
+            now = server_gauges(server)
+            before = previous.get(i, {})
+            moved = {
+                k: (v - before.get(k, 0.0), v)
+                for k, v in now.items()
+                if v != before.get(k, 0.0)
+            }
+            previous[i] = now
+            if moved:
+                gauge_log.info(
+                    "node[%d] %s",
+                    i,
+                    " ".join(
+                        f"{k} {d:+g}={v:g}" for k, (d, v) in sorted(moved.items())
+                    ),
+                )
 
 
 @message
@@ -120,12 +161,14 @@ async def main() -> None:
         print(f"[server] traced node on {await s.bind()}")
         servers.append(s)
     tasks = [asyncio.create_task(s.run()) for s in servers]
+    tasks.append(asyncio.create_task(gauge_reader(servers, interval=0.05)))
     await asyncio.sleep(0.1)
 
     client = Client(members)
     for i in range(50):
         await client.send(Worker, f"w{i % 5}", Work(item=f"job-{i}"), returns=Ack)
     client.close()
+    await asyncio.sleep(0.1)  # let the gauge reader log the final deltas
 
     for t in tasks:
         t.cancel()

@@ -107,6 +107,16 @@ class ObjectPlacementError(RioError):
     """Placement directory operation failed."""
 
 
+class NoSchedulableCapacity(ObjectPlacementError, ValueError):
+    """A placement solve ran with zero registered nodes.
+
+    Raised by the solver backends (e.g. ``JaxObjectPlacement.assign_batch``)
+    when asked to seat objects before any node has registered — typically a
+    bring-up ordering bug (placing before ``register_node``/``sync_members``)
+    or a cluster that lost every member. Subclasses ``ValueError`` for
+    callers that caught the old bare error."""
+
+
 # ---------------------------------------------------------------------------
 # Client-side request errors (reference: protocol.rs:129-159 ClientError)
 # ---------------------------------------------------------------------------

@@ -27,22 +27,46 @@ coordinated handoff:
    request is answered with a ``Redirect`` to the new owner, so a stale
    source can never serve after the flip.
 
-Actuation comes from three places, all converging on
-:meth:`MigrationManager.migrate_out`: the placement daemon's rebalance
-(via the ``move_sink`` hook on ``JaxObjectPlacement.rebalance``), the admin
-command ``AdminCommand.migrate(...)``, and ``Server._drain_and_exit`` (a
-drain is just "migrate everything out, then stop"). Moves whose source is
-dead — or whose type has no live activation anywhere, like
-``rio.ReminderShard`` seat rows — degrade to a bare directory flip, which
-for those rows *is* the migration.
+Actuation is a **pipelined, batched engine** (the VM live-migration
+"warm-up then flip" shape — the unavailable window covers only the final
+delta, not the state copy):
+
+* **Batched bursts** — :meth:`MigrationManager.apply_moves` groups a
+  rebalance plan by ``(source, target)`` pair and ships one
+  :class:`MigrateBatch` per pair (chunked at
+  :attr:`MigrationConfig.batch_size`), amortizing framing and dispatch
+  over many keys; the transport's write-cork batches the state payloads.
+* **Target-initiated prefetch** — before any pin, the coordinator asks the
+  *target* (:class:`PrefetchPull`) to pull volatile snapshots straight
+  from the source's inbox (:class:`FetchStates`, served under each
+  object's dispatch lock via ``Registry.peek`` — consistent, object stays
+  live). At pin time the source re-snapshots; when the bytes are unchanged
+  the transfer inside the pinned window is **skipped entirely** (a
+  *prefetch hit*) and the window shrinks to deactivate + directory flip.
+* **Bounded in-flight** — a global burst budget plus a per-source-node
+  semaphore (:attr:`MigrationConfig.global_inflight` /
+  :attr:`~MigrationConfig.per_node_inflight`) so a 30k-displacement plan
+  cannot stampede a source's event loop or starve foreground traffic;
+  within a burst, handoffs overlap up to
+  :attr:`MigrationConfig.handoff_concurrency`.
+
+All three entry points converge on the same primitives: the placement
+daemon's rebalance (``move_sink`` → :meth:`~MigrationManager.apply_moves`),
+the admin command ``AdminCommand.migrate(...)`` and ``Server._drain_and_exit``
+(→ :meth:`~MigrationManager.migrate_out`). Moves whose source is dead — or
+whose type has no live activation anywhere, like ``rio.ReminderShard`` seat
+rows — degrade to a bare directory flip, which for those rows *is* the
+migration.
 
 Cross-node control traffic rides two **node-scoped** actors
 (``__node_scoped__ = True``: the object id is a node address; the service
 layer routes them without the directory, so the solver never re-seats
-them). :class:`MigrationControl` runs the long handoff; :class:`MigrationInbox`
-only stashes inbound snapshots. They are separate types on purpose: a
-symmetric A→B / B→A migration pair would distributed-deadlock if the
-snapshot install needed the same per-object lock the handoff holds.
+them). :class:`MigrationControl` runs the long handoffs; :class:`MigrationInbox`
+answers purely locally (stash an inbound snapshot, serve a prefetch read).
+The split is the deadlock argument: control handlers make cross-node calls
+only to inboxes, and inbox handlers never make cross-node calls at all, so
+the cross-node wait-for graph (coordinator → control → inbox → local
+object locks) is acyclic however symmetric the plan.
 """
 
 from __future__ import annotations
@@ -50,12 +74,13 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any
 
 from .. import codec
 from ..app_data import AppData
 from ..cluster.storage import MembershipStorage
+from ..errors import ObjectNotFound
 from ..message_router import MessageRouter
 from ..object_placement import ObjectPlacement, ObjectPlacementItem
 from ..protocol import ResponseError
@@ -68,13 +93,19 @@ log = logging.getLogger("rio_tpu.migration")
 __all__ = [
     "CONTROL_TYPE",
     "INBOX_TYPE",
+    "FetchStates",
     "InstallState",
+    "MigrateBatch",
+    "MigrateBatchAck",
     "MigrateObject",
     "MigrationAck",
+    "MigrationConfig",
     "MigrationControl",
     "MigrationInbox",
     "MigrationManager",
     "MigrationStats",
+    "PrefetchPull",
+    "StateBatch",
 ]
 
 #: Wire type-names of the node-scoped control actors.
@@ -87,6 +118,21 @@ STASH_TTL = 120.0
 #: Fences outlive the flip long enough for every straggler to re-resolve;
 #: after this the directory alone is authoritative again.
 FENCE_TTL = 300.0
+#: A prefetched snapshot only counts as a pin-time hit while comfortably
+#: inside the target's stash TTL — past this, install fresh rather than
+#: trust a stash entry the target may be about to prune.
+_PREFETCH_HIT_MAX_AGE = 30.0
+
+
+@dataclass
+class MigrationConfig:
+    """Knobs for the batched actuation pipeline (documented in MIGRATING.md)."""
+
+    batch_size: int = 128  # keys per MigrateBatch burst
+    per_node_inflight: int = 2  # concurrent bursts per source node
+    global_inflight: int = 8  # concurrent bursts across the whole plan
+    handoff_concurrency: int = 16  # overlapping pinned handoffs inside a burst
+    prefetch: bool = True  # pre-pin volatile-state warm-up pulls
 
 
 @dataclass
@@ -99,7 +145,19 @@ class MigrationStats:
     state_bytes: int = 0  # serialized volatile state transferred out
     seat_flips: int = 0  # moves with no live activation: directory-only
     refusals: int = 0  # requests bounced off a pin or fence
-    installs: int = 0  # inbound volatile snapshots stashed
+    installs: int = 0  # inbound volatile snapshots stashed at pin time
+    batches: int = 0  # MigrateBatch bursts run with this node as source
+    batch_keys: int = 0  # keys carried by those bursts
+    prefetch_served: int = 0  # snapshots served to a pulling target
+    prefetch_hits: int = 0  # pin-time snapshot unchanged: transfer skipped
+    prefetch_misses: int = 0  # state moved under the prefetch: fresh install
+    pinned_windows: int = 0  # completed pin→unpin windows
+    pinned_ms_total: float = 0.0  # sum of window durations (mean = total/windows)
+    pinned_ms_max: float = 0.0
+    pinned_le_1ms: int = 0  # histogram buckets over the window duration
+    pinned_le_10ms: int = 0
+    pinned_le_100ms: int = 0
+    pinned_gt_100ms: int = 0
 
 
 @message(name="rio.MigrateObject")
@@ -109,6 +167,45 @@ class MigrateObject:
     type_name: str = ""
     object_id: str = ""
     target: str = ""
+
+
+@message(name="rio.MigrateBatch")
+class MigrateBatch:
+    """One (source, target) burst of a rebalance plan: many keys, one RPC."""
+
+    target: str = ""
+    items: list = field(default_factory=list)  # [type_name, object_id] pairs
+
+
+@message(name="rio.MigrateBatchAck")
+class MigrateBatchAck:
+    done: int = 0
+    attempted: int = 0
+    detail: str = ""
+
+
+@message(name="rio.PrefetchPull")
+class PrefetchPull:
+    """Coordinator → target: pull state for ``items`` from ``source`` now,
+    ahead of the pins, so the pinned window carries no payload."""
+
+    source: str = ""
+    items: list = field(default_factory=list)  # [type_name, object_id] pairs
+
+
+@message(name="rio.FetchStates")
+class FetchStates:
+    """Target → source inbox: read volatile snapshots of live objects."""
+
+    items: list = field(default_factory=list)  # [type_name, object_id] pairs
+    requester: str = ""  # the pulling target's address
+
+
+@message(name="rio.StateBatch")
+class StateBatch:
+    """Prefetch response: ``[type_name, object_id, payload]`` triples."""
+
+    items: list = field(default_factory=list)
 
 
 @message(name="rio.InstallState")
@@ -130,10 +227,11 @@ class MigrationManager:
     """Per-node migration coordinator; injected into AppData by the Server.
 
     One instance per server: the *source* role (pin → deactivate → snapshot
-    → transfer → flip → fence) lives in :meth:`migrate_out`; the *target*
-    role (stash → restore) in :meth:`install`/:meth:`restore_volatile`; the
-    *coordinator* role (actuating a whole rebalance plan) in
-    :meth:`apply_moves`.
+    → transfer → flip → fence) lives in :meth:`migrate_out` and its batched
+    wrapper :meth:`migrate_batch`; the *target* role (prefetch-pull → stash
+    → restore) in :meth:`prefetch_pull`/:meth:`install`/
+    :meth:`restore_volatile`; the *coordinator* role (actuating a whole
+    rebalance plan with bounded in-flight) in :meth:`apply_moves`.
     """
 
     def __init__(
@@ -146,6 +244,7 @@ class MigrationManager:
         app_data: AppData,
         router: MessageRouter | None = None,
         client: Any | None = None,
+        config: MigrationConfig | None = None,
     ) -> None:
         self.address = address
         self.registry = registry
@@ -153,11 +252,24 @@ class MigrationManager:
         self.members_storage = members_storage
         self.app_data = app_data
         self.router = router
+        self.config = config or MigrationConfig()
         self.stats = MigrationStats()
         self._pinned: dict[tuple[str, str], str] = {}  # key -> target
         self._fenced: dict[tuple[str, str], tuple[str, float]] = {}
         self._stash: dict[tuple[str, str], tuple[bytes, float]] = {}
+        # Source-side record of what each target already pulled:
+        # key -> (payload, requester, monotonic ts). Consulted at pin time
+        # to skip the in-window transfer when the snapshot is unchanged.
+        self._served_prefetch: dict[tuple[str, str], tuple[bytes, str, float]] = {}
+        self._node_sems: dict[str, asyncio.Semaphore] = {}
+        self._global_sem = asyncio.Semaphore(max(1, self.config.global_inflight))
         self._client = client
+
+    @property
+    def active(self) -> bool:
+        """True while any pin or fence exists — the service layer's cheap
+        sync guard before awaiting the full directory-aware refusal check."""
+        return bool(self._pinned or self._fenced)
 
     # ------------------------------------------------------------------
     # Request-path refusals (single-activation fencing)
@@ -213,7 +325,9 @@ class MigrationManager:
     # Source role
     # ------------------------------------------------------------------
 
-    async def migrate_out(self, object_id: ObjectId, target: str) -> bool:
+    async def migrate_out(
+        self, object_id: ObjectId, target: str, *, target_checked: bool = False
+    ) -> bool:
         """Hand ``object_id`` (seated here) to ``target``; True on success.
 
         Safe orderings, in sequence: the pin goes up before anything else
@@ -222,19 +336,24 @@ class MigrationManager:
         barrier); managed state is persisted and volatile state serialized
         under the object's dispatch lock; the volatile snapshot is installed
         on the target *before* the flip (so the target's first activation
-        finds it); the fence is armed before the pin drops. Any failure
-        before the flip aborts with the directory untouched — the object
-        re-activates here (or wherever the lazy path seats it) from its
-        last persisted state.
+        finds it) — unless a prefetch already parked the identical bytes
+        there, in which case the window carries no transfer at all; the
+        fence is armed before the pin drops. Any failure before the flip
+        aborts with the directory untouched — the object re-activates here
+        (or wherever the lazy path seats it) from its last persisted state.
+
+        ``target_checked=True`` skips the per-key liveness probe — the
+        batched path (:meth:`migrate_batch`) checks once per burst.
         """
         key = (object_id.type_name, object_id.id)
         if not target or target == self.address or key in self._pinned:
             return False
-        if not await self.members_storage.is_active(target):
+        if not target_checked and not await self.members_storage.is_active(target):
             log.warning("migration of %s refused: target %s not active", object_id, target)
             return False
         self.stats.started += 1
         self._pinned[key] = target
+        pinned_at = time.perf_counter()
         fenced = False
         try:
             volatile: list[bytes] = []
@@ -260,8 +379,22 @@ class MigrationManager:
                     before_remove=_snapshot,
                 )
             if volatile:
-                self.stats.state_bytes += len(volatile[0])
-                await self._install_on(target, object_id, volatile[0])
+                payload = volatile[0]
+                served = self._served_prefetch.pop(key, None)
+                if (
+                    served is not None
+                    and served[0] == payload
+                    and served[1] == target
+                    and time.monotonic() - served[2] <= _PREFETCH_HIT_MAX_AGE
+                ):
+                    # The target already stashed these exact bytes during
+                    # the pre-pin prefetch: nothing to move in-window.
+                    self.stats.prefetch_hits += 1
+                else:
+                    if served is not None:
+                        self.stats.prefetch_misses += 1
+                    self.stats.state_bytes += len(payload)
+                    await self._install_on(target, object_id, payload)
             if await self.placement.lookup(object_id) == self.address:
                 await self.placement.update(
                     ObjectPlacementItem(object_id=object_id, server_address=target)
@@ -290,8 +423,45 @@ class MigrationManager:
             return False
         finally:
             self._pinned.pop(key, None)
+            self._record_pinned_window((time.perf_counter() - pinned_at) * 1e3)
             if fenced:
                 self._prune_fences()
+
+    async def migrate_batch(self, target: str, items: list) -> tuple[int, int]:
+        """Run one burst of handoffs from this node; ``(done, attempted)``.
+
+        The target's liveness is probed once for the whole burst; handoffs
+        then overlap up to ``config.handoff_concurrency`` — enough to hide
+        the install round-trip latency without monopolizing the event loop.
+        A failed key only loses that key (its row stands for the lazy
+        re-seat); the burst keeps going.
+        """
+        attempted = len(items)
+        if not attempted:
+            return 0, 0
+        if (
+            not target
+            or target == self.address
+            or not await self.members_storage.is_active(target)
+        ):
+            log.warning(
+                "burst of %d keys refused: bad or inactive target %r", attempted, target
+            )
+            return 0, attempted
+        self.stats.batches += 1
+        self.stats.batch_keys += attempted
+        sem = asyncio.Semaphore(max(1, self.config.handoff_concurrency))
+
+        async def one(tname: str, oid: str) -> bool:
+            async with sem:
+                return await self.migrate_out(
+                    ObjectId(tname, oid), target, target_checked=True
+                )
+
+        results = await asyncio.gather(
+            *(one(tname, oid) for tname, oid in items), return_exceptions=True
+        )
+        return sum(1 for r in results if r is True), attempted
 
     async def _install_on(
         self, target: str, object_id: ObjectId, payload: bytes
@@ -314,6 +484,78 @@ class MigrationManager:
         for key, (_, ts) in list(self._fenced.items()):
             if now - ts > FENCE_TTL:
                 self._fenced.pop(key, None)
+
+    def _record_pinned_window(self, ms: float) -> None:
+        s = self.stats
+        s.pinned_windows += 1
+        s.pinned_ms_total += ms
+        if ms > s.pinned_ms_max:
+            s.pinned_ms_max = ms
+        if ms <= 1.0:
+            s.pinned_le_1ms += 1
+        elif ms <= 10.0:
+            s.pinned_le_10ms += 1
+        elif ms <= 100.0:
+            s.pinned_le_100ms += 1
+        else:
+            s.pinned_gt_100ms += 1
+
+    # ------------------------------------------------------------------
+    # Prefetch (source serves, target pulls — both before any pin)
+    # ------------------------------------------------------------------
+
+    async def prefetch_serve(self, items: list, requester: str) -> list:
+        """Source side: snapshot live objects' volatile state *without*
+        deactivating them (``Registry.peek`` holds each object's dispatch
+        lock, so the snapshot is handler-consistent) and remember exactly
+        what ``requester`` received. Objects that are gone, already pinned,
+        or export no ``__migrate_state__`` are simply omitted — the
+        pin-time install covers them.
+        """
+        out: list = []
+        now = time.monotonic()
+        for key, (_, _, ts) in list(self._served_prefetch.items()):
+            if now - ts > STASH_TTL:
+                self._served_prefetch.pop(key, None)
+        for tname, oid in items:
+            if (tname, oid) in self._pinned:
+                continue  # handoff already running; its install wins
+            try:
+                payload = await self.registry.peek(tname, oid, self._volatile_snapshot)
+            except ObjectNotFound:
+                continue
+            if payload is None:
+                continue
+            self._served_prefetch[(tname, oid)] = (payload, requester, now)
+            self.stats.prefetch_served += 1
+            self.stats.state_bytes += len(payload)
+            out.append([tname, oid, payload])
+        return out
+
+    async def prefetch_pull(self, source: str, items: list) -> int:
+        """Target side: pull snapshots for ``items`` from ``source``'s inbox
+        and park them in the stash the LOAD lifecycle reads. Returns the
+        number of snapshots stashed."""
+        batch = await self._get_client().send(
+            INBOX_TYPE,
+            source,
+            FetchStates(items=items, requester=self.address),
+            returns=StateBatch,
+        )
+        now = time.monotonic()
+        for tname, oid, payload in batch.items:
+            self._stash[(tname, oid)] = (payload, now)
+        return len(batch.items)
+
+    @staticmethod
+    async def _volatile_snapshot(obj: Any) -> bytes | None:
+        snap = getattr(obj, "__migrate_state__", None)
+        if snap is None:
+            return None
+        value = snap()
+        if asyncio.iscoroutine(value):
+            value = await value
+        return codec.serialize(value)
 
     # ------------------------------------------------------------------
     # Target role
@@ -349,39 +591,60 @@ class MigrationManager:
     async def apply_moves(self, moves: list[tuple[str, str, str]]) -> int:
         """Actuate one rebalance plan: ``(directory_key, from, to)`` each.
 
-        Local sources run the handoff directly; live remote sources are
-        asked through their :class:`MigrationControl` actor; dead sources
-        and activation-less framework rows (reminder-shard seats) get the
-        bare directory flip, which for them *is* the migration. A failed
-        move leaves its row standing — the lazy request-path re-seat and
-        the next churn solve both cover it.
+        Moves with a live source are grouped by ``(source, target)`` and
+        shipped as :class:`MigrateBatch` bursts — prefetch first, then the
+        pinned handoffs — with burst concurrency bounded by the global
+        budget and a per-source semaphore. Dead sources and
+        activation-less framework rows (reminder-shard seats) get the bare
+        directory flip, which for them *is* the migration. A failed move
+        (or a whole failed burst — e.g. the source died mid-batch) leaves
+        its rows standing: the lazy request-path re-seat and the next
+        churn solve both cover them, and any pins die with the source.
         """
-        done = 0
+        groups: dict[tuple[str, str], list] = {}
+        flips: list[tuple[str, ObjectId, str, str]] = []
+        active: dict[str, bool] = {}
         for key, src, dst in moves:
             oid = self._split_key(key)
             if oid is None or src == dst:
                 if oid is None:
                     log.warning("unroutable directory key %r; row left in place", key)
                 continue
+            if src != self.address and src not in active and self.registry.has_type(
+                oid.type_name
+            ):
+                active[src] = await self.members_storage.is_active(src)
+            if src == self.address or (
+                self.registry.has_type(oid.type_name) and active.get(src, False)
+            ):
+                groups.setdefault((src, dst), []).append([oid.type_name, oid.id])
+            else:
+                flips.append((key, oid, src, dst))
+
+        done = 0
+        size = max(1, self.config.batch_size)
+        bursts = [
+            (src, dst, items[i : i + size])
+            for (src, dst), items in sorted(groups.items())
+            for i in range(0, len(items), size)
+        ]
+
+        async def run(src: str, dst: str, items: list) -> int:
             try:
-                if src == self.address:
-                    done += int(await self.migrate_out(oid, dst))
-                    continue
-                if self.registry.has_type(oid.type_name) and (
-                    await self.members_storage.is_active(src)
-                ):
-                    ack = await self._get_client().send(
-                        CONTROL_TYPE,
-                        src,
-                        MigrateObject(
-                            type_name=oid.type_name, object_id=oid.id, target=dst
-                        ),
-                        returns=MigrationAck,
-                    )
-                    done += int(ack.ok)
-                    continue
-                # Dead source, or a row kind with no live activation to
-                # hand off (rio.ReminderShard seats): flip if unmoved.
+                async with self._global_sem, self._node_sem(src):
+                    return await self._run_burst(src, dst, items)
+            except Exception as e:
+                self.stats.aborted += 1
+                log.warning(
+                    "burst %s -> %s (%d keys) failed: %r", src, dst, len(items), e
+                )
+                return 0
+
+        if bursts:
+            done += sum(await asyncio.gather(*(run(*b) for b in bursts)))
+
+        for key, oid, src, dst in flips:
+            try:
                 if await self.placement.lookup(oid) == src:
                     await self.placement.update(
                         ObjectPlacementItem(object_id=oid, server_address=dst)
@@ -392,6 +655,37 @@ class MigrationManager:
                 self.stats.aborted += 1
                 log.warning("move %s %s->%s failed: %r", key, src, dst, e)
         return done
+
+    async def _run_burst(self, src: str, dst: str, items: list) -> int:
+        """One (source, target) chunk: warm the target, then fire the burst."""
+        if self.config.prefetch:
+            try:
+                await self._get_client().send(
+                    CONTROL_TYPE,
+                    dst,
+                    PrefetchPull(source=src, items=items),
+                    returns=MigrateBatchAck,
+                )
+            except Exception as e:  # noqa: BLE001 - prefetch is best-effort
+                log.debug("prefetch pull %s <- %s failed: %r", dst, src, e)
+        if src == self.address:
+            burst_done, _ = await self.migrate_batch(dst, items)
+            return burst_done
+        ack = await self._get_client().send(
+            CONTROL_TYPE,
+            src,
+            MigrateBatch(target=dst, items=items),
+            returns=MigrateBatchAck,
+        )
+        return ack.done
+
+    def _node_sem(self, addr: str) -> asyncio.Semaphore:
+        sem = self._node_sems.get(addr)
+        if sem is None:
+            sem = self._node_sems[addr] = asyncio.Semaphore(
+                max(1, self.config.per_node_inflight)
+            )
+        return sem
 
     def _split_key(self, key: str) -> ObjectId | None:
         """Invert ``ObjectId.__str__`` (``f"{type_name}.{id}"``).
@@ -446,12 +740,30 @@ class MigrationControl(ServiceObject):
         ok = await mgr.migrate_out(ObjectId(msg.type_name, msg.object_id), msg.target)
         return MigrationAck(ok=ok)
 
+    @handler
+    async def migrate_batch(self, msg: MigrateBatch, ctx: AppData) -> MigrateBatchAck:
+        mgr = ctx.try_get(MigrationManager)
+        if mgr is None:
+            return MigrateBatchAck(detail="migration disabled on this node")
+        done, attempted = await mgr.migrate_batch(msg.target, msg.items)
+        return MigrateBatchAck(done=done, attempted=attempted)
+
+    @handler
+    async def prefetch_pull(self, msg: PrefetchPull, ctx: AppData) -> MigrateBatchAck:
+        mgr = ctx.try_get(MigrationManager)
+        if mgr is None:
+            return MigrateBatchAck(detail="migration disabled on this node")
+        stashed = await mgr.prefetch_pull(msg.source, msg.items)
+        return MigrateBatchAck(done=stashed, attempted=len(msg.items))
+
 
 @type_name(INBOX_TYPE)
 class MigrationInbox(ServiceObject):
     """Node-scoped snapshot receiver, deliberately separate from
     :class:`MigrationControl`: installs must never queue behind a handoff
-    this node is running (symmetric migrations would deadlock)."""
+    this node is running (symmetric migrations would deadlock), and its
+    handlers never make cross-node calls — that keeps the migration
+    wait-for graph acyclic."""
 
     __node_scoped__ = True
 
@@ -462,3 +774,10 @@ class MigrationInbox(ServiceObject):
             return MigrationAck(ok=False, detail="migration disabled on this node")
         mgr.install(msg.type_name, msg.object_id, msg.payload)
         return MigrationAck(ok=True)
+
+    @handler
+    async def fetch_states(self, msg: FetchStates, ctx: AppData) -> StateBatch:
+        mgr = ctx.try_get(MigrationManager)
+        if mgr is None:
+            return StateBatch()
+        return StateBatch(items=await mgr.prefetch_serve(msg.items, msg.requester))

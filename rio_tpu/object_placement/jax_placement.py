@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..errors import NoSchedulableCapacity
 from ..registry import ObjectId
 from ..ops import (
     build_cost_matrix,
@@ -216,7 +217,10 @@ def _least_loaded_spread(load, alive, cap, n_real: int, count: int) -> np.ndarra
     ``clean_server`` zeroes a dead node's load, ranking fresh corpses
     first.)"""
     if n_real <= 0:
-        raise ValueError("placement solve with no registered nodes")
+        raise NoSchedulableCapacity(
+            "placement solve with no registered nodes: register_node/"
+            "sync_members must run before any placement is requested"
+        )
     a = np.asarray(alive)[:n_real]
     c = np.asarray(cap)[:n_real]
     sched = (a > 0) & (c > 0)
@@ -647,6 +651,11 @@ class JaxObjectPlacement(ObjectPlacement):
         between chunks may have dropped keys placed earlier, so stragglers
         are re-placed under one last lock hold — no unlocked await separates
         that re-place from the read, so the resolution cannot miss.
+
+        Raises :class:`rio_tpu.errors.NoSchedulableCapacity` (a
+        ``ValueError`` subclass) when no node has registered yet — the
+        batch cannot be seated anywhere, and silently parking it would
+        strand every key.
         """
         keys = [str(o) for o in object_ids]
         for start in range(0, len(keys), self._MAX_PLACE_CHUNK):
@@ -1207,6 +1216,10 @@ class JaxObjectPlacement(ObjectPlacement):
                 history=hist,
             )
         if planned:
+            # Grouped emission: the migration engine batches one burst per
+            # (source, target) pair, so hand it the plan already ordered by
+            # that pair — contiguous runs become whole MigrateBatch frames.
+            planned.sort(key=lambda m: (m[1], m[2]))
             # Outside the lock on purpose: each handoff calls back into
             # update()/lookup(), which take it.
             await move_sink(planned)

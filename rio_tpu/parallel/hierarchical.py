@@ -274,7 +274,9 @@ def sharded_hierarchical_assign(
     turning into a global all-to-all. Node-side inputs are replicated
     (O(M), tiny next to the object axis); the overflow counter is psum'd.
     """
-    from jax import shard_map
+    import inspect
+
+    from . import shard_map  # version-gated import (top-level vs experimental)
 
     axes = mesh.axis_names
     obj_feat = jax.device_put(obj_feat, NamedSharding(mesh, P(axes, None)))
@@ -291,6 +293,10 @@ def sharded_hierarchical_assign(
             overflow=jax.lax.psum(res.overflow, axes),
         )
 
+    # The replication-check kwarg was renamed across jax versions
+    # (check_rep -> check_vma); pass whichever this install understands.
+    params = inspect.signature(shard_map).parameters
+    check_kw = next((k for k in ("check_vma", "check_rep") if k in params), None)
     fn = shard_map(
         local_solve,
         mesh=mesh,
@@ -298,6 +304,6 @@ def sharded_hierarchical_assign(
         out_specs=HierarchicalResult(
             assignment=P(axes), group=P(axes), overflow=P()
         ),
-        check_vma=False,
+        **({check_kw: False} if check_kw else {}),
     )
     return fn(obj_feat, node_feat, node_capacity, alive)

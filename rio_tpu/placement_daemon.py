@@ -48,6 +48,8 @@ class PlacementDaemonStats:
     rebalances_discarded: int = 0  # lost an epoch race; retried next poll
     retries_abandoned: int = 0  # discard-retry budget exhausted; wait for churn
     moves: int = 0
+    bursts: int = 0  # MigrateBatch bursts this daemon's rebalances produced
+    burst_keys: int = 0  # keys those bursts carried
     errors: int = 0
 
 
@@ -109,9 +111,26 @@ class PlacementDaemon:
             import inspect
 
             if "move_sink" in inspect.signature(self.placement.rebalance).parameters:
-                return await self.placement.rebalance(
+                mst = self.migrator.stats
+                before = (mst.batches, mst.batch_keys, mst.prefetch_hits)
+                moved = await self.placement.rebalance(
                     mode=mode, move_sink=self.migrator.apply_moves
                 )
+                # Attribute this rebalance's actuation to the daemon so
+                # per-daemon gauges show how batched the plan came out
+                # (migrator stats are node-global and shared).
+                self.stats.bursts += mst.batches - before[0]
+                self.stats.burst_keys += mst.batch_keys - before[1]
+                hits = mst.prefetch_hits - before[2]
+                if moved:
+                    log.info(
+                        "rebalance actuated: %d moves in %d bursts "
+                        "(%d prefetch hits)",
+                        moved,
+                        mst.batches - before[0],
+                        hits,
+                    )
+                return moved
         return await self.placement.rebalance(mode=mode)
 
     @property

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import inspect
 from typing import Any, Callable
 
 from .. import codec
@@ -218,6 +219,32 @@ class Registry:
             if self._objects.get(key) is entry:
                 del self._objects[key]
         return True
+
+    async def peek(
+        self,
+        type_name: str,
+        object_id: str,
+        fn: Callable[[Any], Any],
+    ) -> Any:
+        """Run ``fn(obj)`` under the object's dispatch lock, without removing it.
+
+        The read-side twin of :meth:`deactivate`: the migration prefetch uses
+        it to snapshot volatile state *before* the pin (no handler can run
+        concurrently, so the snapshot is consistent), leaving the object live
+        and serving. ``fn`` may return an awaitable. Raises
+        :class:`ObjectNotFound` when the object is not (or no longer) seated.
+        """
+        key = (type_name, object_id)
+        entry = self._objects.get(key)
+        if entry is None:
+            raise ObjectNotFound(f"{type_name}/{object_id}")
+        async with entry.lock:
+            if self._objects.get(key) is not entry:
+                raise ObjectNotFound(f"{type_name}/{object_id}")
+            result = fn(entry.obj)
+            if inspect.isawaitable(result):
+                result = await result
+        return result
 
     def count_objects(self) -> int:
         return len(self._objects)

@@ -121,6 +121,7 @@ class ReminderDaemon:
         self.stats = ReminderDaemonStats()
         self._client = client
         self._held: dict[int, int] = {}  # shard -> lease epoch we hold
+        self._handed_off: dict[int, float] = {}  # shard -> when we released it
         self._draining = False
 
     def _get_client(self) -> Client:
@@ -179,7 +180,12 @@ class ReminderDaemon:
     async def _seat_is_stale(self, shard: int, owner: str, now: float) -> bool:
         lease = await self.storage.get_lease(shard)
         if lease is None:
-            return True  # seated but never ticked
+            # Seated but no lease. If WE just released this shard on seeing
+            # the seat move (a rebalance/migration handed it off), the gap
+            # is the new owner's normal claim race, not proof it is dead —
+            # stealing now would flip the seat straight back and revert the
+            # migration. Give the new owner a full TTL to claim first.
+            return now - self._handed_off.get(shard, float("-inf")) > self.config.lease_ttl
         if lease.owner != owner:
             return False  # directory lag behind a lease someone else holds
         return lease.expires_at + self.config.lease_ttl <= now
@@ -188,6 +194,7 @@ class ReminderDaemon:
         epoch = self._held.pop(shard, None)
         if epoch is not None:
             self.stats.releases += 1
+            self._handed_off[shard] = time.time()
             with contextlib.suppress(Exception):
                 await self.storage.release_lease(shard, self.address, epoch)
 

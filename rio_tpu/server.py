@@ -91,6 +91,7 @@ class Server:
         placement_daemon_config=None,
         reminder_daemon: bool = False,
         reminder_daemon_config=None,
+        migration_config=None,
     ) -> None:
         if transport not in ("asyncio", "native", "auto"):
             raise ValueError(f"unknown transport {transport!r}")
@@ -120,6 +121,9 @@ class Server:
         self._listener: asyncio.Server | None = None
         self._native_transport = None
         self._local_addr: str | None = None
+        # Batching/prefetch/in-flight knobs for the migration engine
+        # (a rio_tpu.migration.MigrationConfig; None → defaults).
+        self.migration_config = migration_config
         self.migration_manager = None  # created at bind() (needs the address)
         self._admin = AdminSender()
         self._internal = InternalClientSender()
@@ -233,6 +237,7 @@ class Server:
                 members_storage=self.members_storage,
                 app_data=self.app_data,
                 router=self.app_data.get(MessageRouter),
+                config=self.migration_config,
             )
             self.app_data.set(self.migration_manager)
             self.registry.add_type(MigrationControl)

@@ -109,7 +109,11 @@ class Service:
         return ResponseError.deallocate()
 
     async def _refuse_if_migrating(self, object_id: ObjectId) -> ResponseError | None:
-        if self._migrator is None:
+        if self._migrator is None or not self._migrator.active:
+            # Sync fast path: no pin or fence exists anywhere on this node,
+            # so the directory-aware refusal check (which may await a
+            # placement lookup) cannot refuse — skip it. `active` flips
+            # before any pin goes up, in the same tick.
             return None
         return await self._migrator.refusal_for(object_id)
 

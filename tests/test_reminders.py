@@ -371,6 +371,75 @@ def test_reminder_failover_on_graceful_drain():
     )
 
 
+def test_reminder_fires_through_shard_migration():
+    """Fire a reminder WHILE its shard seat migrates (twice, there and
+    back, through the same ``apply_moves`` path the rebalancer uses).
+
+    The shard row has no live activation, so the migration is a directory
+    flip racing the old owner's poll loop; the lease is what serializes
+    the two daemons across that race. Contract: no double-fire (no two
+    deliveries of one due slot) and no missed tick (``fired.missed`` stays
+    0 — the schedule never skipped a period) across both handoffs.
+    """
+    storage = LocalReminderStorage()
+    RECORD.pop("mig-r", None)
+    period = 0.25
+
+    async def body(cluster: Cluster):
+        client = fast_client(cluster)
+        await client.send(
+            Waker, "m1", StartReminder(name="mig-r", period=period, first_in=0.1),
+            returns=Ticks,
+        )
+        await wait_until(lambda: len(RECORD["mig-r"]) >= 2, 10.0)
+        shard = storage.shard_for("Waker", "m1")
+        key = f"{SHARD_TYPE}.{shard}"
+
+        # NOTE RECORD addresses name the node hosting the Waker ACTOR (it
+        # never moves here); which daemon delivered is visible through the
+        # lease owner and each daemon's tick counter.
+        for _ in range(2):  # there and back again
+            owner = await cluster.placement.lookup(ObjectId(SHARD_TYPE, str(shard)))
+            assert owner in cluster.addresses
+            other = next(a for a in cluster.addresses if a != owner)
+            mover = _find_server(cluster, owner)
+            new_daemon = _find_server(cluster, other).reminder_daemon
+            ticks_before = new_daemon.stats.ticks
+            # Ticking continues while the seat row rides apply_moves.
+            moved = await mover.migration_manager.apply_moves([(key, owner, other)])
+            assert moved == 1
+            # Delivery resumes from the NEW owner's daemon without waiting
+            # out the lease TTL (the old daemon releases on seeing the
+            # flipped seat) and without the old daemon stealing the seat
+            # back (the handoff grace in ``_seat_is_stale``).
+            await wait_until(
+                lambda: new_daemon.stats.ticks > ticks_before, 10.0
+            )
+            lease = await storage.get_lease(shard)
+            assert lease is not None and lease.owner == other
+            seat = await cluster.placement.lookup(ObjectId(SHARD_TYPE, str(shard)))
+            assert seat == other
+
+        ticks = RECORD["mig-r"]
+        # No missed tick: every delivery ran within one period of its due
+        # time, including the ones straddling the handoffs.
+        assert all(m == 0 for _, m, _ in ticks), ticks
+        # No double-fire: the lease serialized the daemons, so no due slot
+        # was delivered twice — any pair of deliveries is at least a good
+        # fraction of a period apart.
+        stamps = sorted(ts for _, _, ts in ticks)
+        gaps = [b - a for a, b in zip(stamps, stamps[1:])]
+        assert all(g > period / 4 for g in gaps), gaps
+        client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body, registry_builder=build_registry, num_servers=2, timeout=40.0,
+            **reminder_cluster_kwargs(storage),
+        )
+    )
+
+
 # ---------------------------------------------------------------------------
 # daemon-level determinism: catch-up policies + at-least-once
 # ---------------------------------------------------------------------------
