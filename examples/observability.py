@@ -15,7 +15,10 @@ subscriber layers are. This demo registers two sinks:
 Spans cover the request path; the *counter* side of observability is
 ``rio_tpu.otel.server_gauges``: one flat snapshot of every wired
 subsystem's stats (placement daemon, reminder daemon, migration manager,
-solver). This demo runs a :func:`gauge_reader` task alongside the servers
+solver, and — since servers run a ``LoadMonitor`` by default — the local
+load sample and admission-control shed counter (``rio.load.*``) plus the
+gossip-derived ``rio.cluster_load.<addr>.*`` view of every peer's
+lag/inflight/staleness; no extra wiring needed). This demo runs a :func:`gauge_reader` task alongside the servers
 — the in-process analogue of a Prometheus scrape loop — logging only the
 gauges that CHANGED since the previous tick, so a quiet cluster logs
 nothing and a busy one shows exactly which counters are moving.

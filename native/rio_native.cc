@@ -236,6 +236,9 @@ struct Parser {
 
 // [false, [kind, detail, payload]] error arm shared by ResponseEnvelope and
 // SubscriptionResponse. Fills kind + offs/lens[0]=detail, [1]=payload.
+// The kind value is an opaque uint here — new Python-side ErrorKind members
+// (e.g. 8 = SERVER_BUSY, the retryable overload shed) need no C++ change,
+// only a byte-parity case in tests/test_native.py.
 bool parse_error_arm(Parser& pr, uint32_t* kind, uint32_t* offs, uint32_t* lens) {
   if (pr.array_header() != 3) return false;
   uint64_t k;

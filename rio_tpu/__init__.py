@@ -19,7 +19,13 @@ from .client.pool import ClientPool
 from .cluster.membership_protocol import ClusterProvider, LocalClusterProvider
 from .cluster.storage import LocalStorage, Member, MembershipStorage
 from .commands import AdminCommand, AdminSender, InternalClientSender, ServerInfo
-from .errors import RioError
+from .errors import RioError, ServerBusy
+from .load import (
+    ClusterLoadView,
+    LoadMonitor,
+    LoadThresholds,
+    LoadVector,
+)
 from .message_router import MessageRouter
 from .migration import MigrationManager, MigrationStats
 from .object_placement import LocalObjectPlacement, ObjectPlacement, ObjectPlacementItem
@@ -44,11 +50,15 @@ __all__ = [
     "Client",
     "ClientPool",
     "ClientBuilder",
+    "ClusterLoadView",
     "ClusterProvider",
     "InternalClientSender",
     "LifecycleKind",
     "LifecycleMessage",
     "LocalClusterProvider",
+    "LoadMonitor",
+    "LoadThresholds",
+    "LoadVector",
     "LocalObjectPlacement",
     "LocalStorage",
     "Member",
@@ -68,6 +78,7 @@ __all__ = [
     "LocalReminderStorage",
     "RioError",
     "Server",
+    "ServerBusy",
     "ServerInfo",
     "ServiceObject",
     "handler",

@@ -23,6 +23,7 @@ from ..errors import (
     ClientError,
     Disconnect,
     RetryExhausted,
+    ServerBusy,
     ServerNotAvailable,
 )
 from ..protocol import (
@@ -143,6 +144,7 @@ class ClientStats:
     roundtrips: int = 0
     redirects: int = 0
     dial_failures: int = 0  # attempts that died before a response (dead addr)
+    busy_retries: int = 0  # SERVER_BUSY sheds answered with backoff + re-route
 
 
 class Client:
@@ -324,6 +326,20 @@ class Client:
                 self.stats.redirects += 1
                 avoid.discard(err.detail)
                 self._placement.put(key, err.detail)
+                continue
+            if err.kind == ErrorKind.SERVER_BUSY:
+                # Overload shed: back off and retry AGAINST ANOTHER MEMBER —
+                # the busy node joins this request's avoid set and its
+                # placement-cache entry is dropped, so the next pick lands
+                # elsewhere and self-assigns. Unlike a dial failure the
+                # connection is healthy (the server answered), so the pool
+                # is NOT invalidated.
+                last = ServerBusy(address or "", err.detail)
+                self.stats.busy_retries += 1
+                if address is not None:
+                    avoid.add(address)
+                self._placement.pop(key)
+                await asyncio.sleep(delay)
                 continue
             if err.kind in (ErrorKind.DEALLOCATE, ErrorKind.ALLOCATE):
                 last = ClientError(f"{err.kind.name}: {err.detail}")

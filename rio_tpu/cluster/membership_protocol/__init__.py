@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import abc
 import asyncio
+from typing import Callable
 
 from ..storage import Member, MembershipStorage
 
@@ -16,6 +17,11 @@ __all__ = ["ClusterProvider", "LocalClusterProvider"]
 
 
 class ClusterProvider(abc.ABC):
+    # Zero-arg callable returning this node's encoded load vector
+    # (``LoadMonitor.encoded_snapshot``); providers fold it into every
+    # heartbeat push so the vector piggybacks on the membership row.
+    _load_source: Callable[[], str] | None = None
+
     @abc.abstractmethod
     def members_storage(self) -> MembershipStorage: ...
 
@@ -23,10 +29,25 @@ class ClusterProvider(abc.ABC):
     async def serve(self, address: str) -> None:
         """Run until cancelled; must register ``address`` as an active member."""
 
+    def set_load_source(self, source: Callable[[], str] | None) -> None:
+        self._load_source = source
+
+    def _load_snapshot(self) -> str:
+        """Encoded load for the next heartbeat push ('' when unmonitored
+        or the monitor's snapshot fails — telemetry never blocks liveness)."""
+        if self._load_source is None:
+            return ""
+        try:
+            return self._load_source()
+        except Exception:  # noqa: BLE001
+            return ""
+
 
 class LocalClusterProvider(ClusterProvider):
     """Test no-op provider (reference ``local.rs:13-32``): registers self,
-    then idles — liveness is whatever the shared storage says."""
+    then idles — liveness is whatever the shared storage says. With a load
+    source wired it re-pushes its heartbeat row frequently so load vectors
+    propagate even without a gossip loop."""
 
     def __init__(self, members_storage: MembershipStorage) -> None:
         self._storage = members_storage
@@ -35,6 +56,14 @@ class LocalClusterProvider(ClusterProvider):
         return self._storage
 
     async def serve(self, address: str) -> None:
-        await self._storage.push(Member.from_address(address, active=True))
+        await self._storage.push(
+            Member.from_address(address, active=True, load=self._load_snapshot())
+        )
         while True:
-            await asyncio.sleep(3600)
+            if self._load_source is None:
+                await asyncio.sleep(3600)
+                continue
+            await asyncio.sleep(0.2)
+            await self._storage.push(
+                Member.from_address(address, active=True, load=self._load_snapshot())
+            )

@@ -91,7 +91,9 @@ class PeerToPeerClusterProvider(ClusterProvider):
     # -- main loop (reference peer_to_peer.rs:144-209) ------------------------
 
     async def serve(self, address: str) -> None:
-        await self._storage.push(Member.from_address(address, active=True))
+        await self._storage.push(
+            Member.from_address(address, active=True, load=self._load_snapshot())
+        )
         client = Client(self._storage, connect_timeout=self.config.ping_timeout)
         try:
             while True:
@@ -105,8 +107,13 @@ class PeerToPeerClusterProvider(ClusterProvider):
                 await self._drop_stale(members)
                 # Keep our own registration fresh — re-push (not just
                 # set_active) so a node whose row was dropped while it was
-                # partitioned can rejoin once reachable again.
-                await self._storage.push(Member.from_address(address, active=True))
+                # partitioned can rejoin once reachable again. The push also
+                # refreshes this node's load vector for peers' views.
+                await self._storage.push(
+                    Member.from_address(
+                        address, active=True, load=self._load_snapshot()
+                    )
+                )
                 elapsed = time.monotonic() - tick_start
                 await asyncio.sleep(max(0.0, self.config.interval_secs - elapsed))
         finally:

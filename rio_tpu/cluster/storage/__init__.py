@@ -22,15 +22,24 @@ class Member:
     port: int
     active: bool = False
     last_seen: float = 0.0  # unix seconds
+    # Encoded load vector (rio_tpu.load.LoadVector.encode()); empty when the
+    # node runs no LoadMonitor or the backend predates the column. Riding the
+    # heartbeat row is what lets every peer derive a ClusterLoadView from the
+    # storage it already polls — no new RPCs.
+    load: str = ""
 
     @property
     def address(self) -> str:
         return f"{self.ip}:{self.port}"
 
     @classmethod
-    def from_address(cls, address: str, active: bool = False) -> "Member":
+    def from_address(
+        cls, address: str, active: bool = False, load: str = ""
+    ) -> "Member":
         ip, _, port = address.rpartition(":")
-        return cls(ip=ip, port=int(port), active=active, last_seen=time.time())
+        return cls(
+            ip=ip, port=int(port), active=active, last_seen=time.time(), load=load
+        )
 
 
 class MembershipStorage(abc.ABC):

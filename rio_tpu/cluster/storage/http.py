@@ -21,7 +21,13 @@ log = logging.getLogger("rio_tpu.http_members")
 
 
 def _member_json(m: Member) -> dict:
-    return {"ip": m.ip, "port": m.port, "active": m.active, "last_seen": m.last_seen}
+    return {
+        "ip": m.ip,
+        "port": m.port,
+        "active": m.active,
+        "last_seen": m.last_seen,
+        "load": m.load,
+    }
 
 
 async def serve_members_http(address: str, storage: MembershipStorage) -> None:
@@ -82,7 +88,8 @@ class HttpMembershipStorage(MembershipStorage):
     async def members(self) -> list[Member]:
         rows = await self._get("/members") or []
         return [
-            Member(ip=r["ip"], port=r["port"], active=r["active"], last_seen=r["last_seen"])
+            Member(ip=r["ip"], port=r["port"], active=r["active"],
+                   last_seen=r["last_seen"], load=r.get("load", ""))
             for r in rows
         ]
 

@@ -20,6 +20,7 @@ MIGRATIONS = [
         port      INTEGER NOT NULL,
         active    INTEGER NOT NULL DEFAULT 0,
         last_seen DOUBLE PRECISION NOT NULL DEFAULT 0,
+        load_vec  TEXT NOT NULL DEFAULT '',
         PRIMARY KEY (ip, port)
     );
     CREATE TABLE IF NOT EXISTS cluster_provider_member_failures (
@@ -39,3 +40,7 @@ class PostgresMembershipStorage(SqliteMembershipStorage):
 
     async def prepare(self) -> None:
         await self.db.migrate(MIGRATIONS)
+        # Guarded ALTER (inherited) rather than ADD COLUMN IF NOT EXISTS:
+        # the DBAPI fake (tests/fake_pg.py) runs these migrations against
+        # sqlite, which doesn't parse the PG-only IF NOT EXISTS form.
+        await self._ensure_load_column()

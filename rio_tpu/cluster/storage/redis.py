@@ -35,13 +35,18 @@ class RedisMembershipStorage(MembershipStorage):
     @staticmethod
     def _encode(member: Member, last_seen: float | None = None) -> str:
         ts = member.last_seen if last_seen is None else last_seen
-        return f"{member.ip};{member.port};{int(member.active)};{ts}"
+        # The load vector is comma-joined floats (LoadVector.encode), so it
+        # can never collide with this value's own ';' separator.
+        return f"{member.ip};{member.port};{int(member.active)};{ts};{member.load}"
 
     @staticmethod
     def _decode(raw: bytes) -> Member:
-        ip, port, active, last_seen = raw.decode().split(";")
+        # Tolerate 4-field values written before the load column existed.
+        parts = raw.decode().split(";")
+        ip, port, active, last_seen = parts[:4]
+        load = parts[4] if len(parts) > 4 else ""
         return Member(ip=ip, port=int(port), active=active == "1",
-                      last_seen=float(last_seen))
+                      last_seen=float(last_seen), load=load)
 
     async def push(self, member: Member) -> None:
         # Timestamp goes into the stored value only — the caller's Member is

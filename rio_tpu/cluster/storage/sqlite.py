@@ -20,6 +20,7 @@ MIGRATIONS = [
         port      INTEGER NOT NULL,
         active    INTEGER NOT NULL DEFAULT 0,
         last_seen REAL NOT NULL DEFAULT 0,
+        load_vec  TEXT NOT NULL DEFAULT '',
         PRIMARY KEY (ip, port)
     );
     CREATE TABLE IF NOT EXISTS cluster_provider_member_failures (
@@ -39,13 +40,29 @@ class SqliteMembershipStorage(MembershipStorage):
 
     async def prepare(self) -> None:
         await self.db.migrate(MIGRATIONS)
+        await self._ensure_load_column()
+
+    async def _ensure_load_column(self) -> None:
+        """Add ``load_vec`` to member tables created before the load
+        subsystem existed. ``migrate()`` keeps no applied-ledger (it re-runs
+        every statement each call) and sqlite has no ``ADD COLUMN IF NOT
+        EXISTS`` — so the upgrade is a guarded ALTER: the duplicate-column
+        error on an already-upgraded table is the expected no-op."""
+        try:
+            await self.db.execute(
+                "ALTER TABLE cluster_provider_members "
+                "ADD COLUMN load_vec TEXT NOT NULL DEFAULT ''"
+            )
+        except Exception:
+            pass
 
     async def push(self, member: Member) -> None:
         await self.db.execute(
-            "INSERT INTO cluster_provider_members (ip, port, active, last_seen) "
-            "VALUES (?,?,?,?) ON CONFLICT(ip, port) DO UPDATE SET "
-            "active=excluded.active, last_seen=excluded.last_seen",
-            member.ip, member.port, int(member.active), time.time(),
+            "INSERT INTO cluster_provider_members (ip, port, active, last_seen, load_vec) "
+            "VALUES (?,?,?,?,?) ON CONFLICT(ip, port) DO UPDATE SET "
+            "active=excluded.active, last_seen=excluded.last_seen, "
+            "load_vec=excluded.load_vec",
+            member.ip, member.port, int(member.active), time.time(), member.load,
         )
 
     async def remove(self, ip: str, port: int) -> None:
@@ -71,9 +88,14 @@ class SqliteMembershipStorage(MembershipStorage):
 
     async def members(self) -> list[Member]:
         rows = await self.db.execute(
-            "SELECT ip, port, active, last_seen FROM cluster_provider_members"
+            "SELECT ip, port, active, last_seen, load_vec "
+            "FROM cluster_provider_members"
         )
-        return [Member(ip=r[0], port=r[1], active=bool(r[2]), last_seen=r[3]) for r in rows]
+        return [
+            Member(ip=r[0], port=r[1], active=bool(r[2]), last_seen=r[3],
+                   load=r[4] or "")
+            for r in rows
+        ]
 
     async def notify_failure(self, ip: str, port: int) -> None:
         await self.db.execute(

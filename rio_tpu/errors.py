@@ -134,6 +134,20 @@ class Disconnect(ClientError):
     """The connection dropped mid-request."""
 
 
+class ServerBusy(ClientError):
+    """The server shed the request under overload (``ErrorKind.SERVER_BUSY``).
+
+    Retryable: the client's backoff middleware avoids the busy node and
+    retries against another member; only after the retry budget is
+    exhausted does it surface (wrapped in :class:`RetryExhausted`).
+    """
+
+    def __init__(self, address: str = "", detail: str = ""):
+        super().__init__(f"server busy at {address or '?'}: {detail or 'overloaded'}")
+        self.address = address
+        self.detail = detail
+
+
 class RequestTimeout(ClientError):
     """The request did not complete within the configured deadline."""
 

@@ -63,17 +63,29 @@ class MessageRouter:
         ch = self._channels.get((type_name, object_id))
         if ch is not None:
             ch.unsubscribe(q)
+            if not ch.queues:
+                # Last subscriber gone: drop the channel entry. Without this
+                # (and the lookup-only publish below) every object ever
+                # published to or subscribed from leaves a permanent
+                # _Broadcast in _channels — unbounded growth on a server
+                # with actor churn.
+                self._channels.pop((type_name, object_id), None)
 
     def publish(self, type_name: str, object_id: str, msg: Any) -> int:
         """Serialize and fan out ``msg`` to subscribers; returns receiver count.
 
         Reference ``message_router.rs:37-43`` (handlers call this through
-        AppData, e.g. black-jack ``table.rs:72-86``).
+        AppData, e.g. black-jack ``table.rs:72-86``). Publishing to an
+        object with no subscribers is a no-op returning 0 — it must not
+        materialize a channel (leak path: fire-and-forget publishers).
         """
+        ch = self._channels.get((type_name, object_id))
+        if ch is None:
+            return 0
         resp = SubscriptionResponse(
             body=codec.serialize(msg), message_type=type_id(type(msg))
         )
-        return self._channel(type_name, object_id).publish(resp)
+        return ch.publish(resp)
 
     def close_subscriptions(self, type_name: str, object_id: str, error) -> int:
         """Terminate every live subscription on one object with ``error``.

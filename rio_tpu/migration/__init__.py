@@ -271,6 +271,19 @@ class MigrationManager:
         sync guard before awaiting the full directory-aware refusal check."""
         return bool(self._pinned or self._fenced)
 
+    def _note_state_bytes(self, key: str, nbytes: int) -> None:
+        """Feed an observed snapshot size into the placement provider's
+        affinity tracker (when it carries one): the solver's per-object
+        move price then reflects how many bytes this actor actually costs
+        to relocate. Telemetry only — never allowed to fail a handoff."""
+        tracker = getattr(self.placement, "affinity_tracker", None)
+        if tracker is None or not hasattr(tracker, "note_state_bytes"):
+            return
+        try:
+            tracker.note_state_bytes(key, nbytes)
+        except Exception:  # noqa: BLE001
+            log.exception("state-bytes note failed for %s", key)
+
     # ------------------------------------------------------------------
     # Request-path refusals (single-activation fencing)
     # ------------------------------------------------------------------
@@ -394,6 +407,7 @@ class MigrationManager:
                     if served is not None:
                         self.stats.prefetch_misses += 1
                     self.stats.state_bytes += len(payload)
+                    self._note_state_bytes(str(object_id), len(payload))
                     await self._install_on(target, object_id, payload)
             if await self.placement.lookup(object_id) == self.address:
                 await self.placement.update(
@@ -529,6 +543,7 @@ class MigrationManager:
             self._served_prefetch[(tname, oid)] = (payload, requester, now)
             self.stats.prefetch_served += 1
             self.stats.state_bytes += len(payload)
+            self._note_state_bytes(f"{tname}.{oid}", len(payload))
             out.append([tname, oid, payload])
         return out
 

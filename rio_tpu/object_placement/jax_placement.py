@@ -101,6 +101,17 @@ class AffinityTracker:
         self.stickiness = stickiness
         self._obj: dict[str, np.ndarray] = {}
         self._node_cache: dict[str, np.ndarray] = {}
+        # Measured per-object cost features (rio_tpu/load): request counts
+        # accumulated since the last fold_rates() tick, the folded req/sec
+        # EMA, and the last observed migration-snapshot size. move_weights()
+        # turns these into per-object move prices for the solver; the
+        # LoadMonitor's sampling loop drives fold_rates(). All maps follow
+        # the same atomic-swap discipline as _obj (solver thread reads
+        # concurrently).
+        self._req_window: dict[str, float] = {}
+        self._rates: dict[str, float] = {}
+        self._state_bytes: dict[str, float] = {}
+        self._rate_fold_t = time.monotonic()
 
     def _node_vec(self, address: str) -> np.ndarray:
         vec = self._node_cache.get(address)
@@ -116,6 +127,7 @@ class AffinityTracker:
         ``weight`` scales the pull (e.g. request count since last observe,
         or bytes of state touched).  Alpha is capped below 1 so a single
         heavy observation can never fully erase accumulated warmth."""
+        self._req_window[key] = self._req_window.get(key, 0.0) + max(0.0, weight)
         alpha = min(0.95, self.stickiness * weight)
         if alpha <= 0.0:
             return
@@ -148,6 +160,57 @@ class AffinityTracker:
         if not addresses:
             return np.zeros((0, self.dim), np.float32)
         return np.stack([self._node_vec(a) for a in addresses]).astype(np.float32)
+
+    # ------------------------------------------- measured cost features
+    def fold_rates(self, beta: float = 0.3, min_dt: float = 0.05) -> None:
+        """Fold the since-last-tick request window into per-object req/sec
+        EMAs (driven by the LoadMonitor's sampling loop). Builds fresh
+        dicts and swaps — never mutates in place, the solver thread reads
+        move_weights() concurrently."""
+        now = time.monotonic()
+        dt = now - self._rate_fold_t
+        if dt < min_dt:
+            return
+        self._rate_fold_t = now
+        window, self._req_window = self._req_window, {}
+        rates: dict[str, float] = {}
+        for k, old in self._rates.items():
+            new = (1.0 - beta) * old + beta * (window.pop(k, 0.0) / dt)
+            if new > 1e-6:  # drop cooled-off objects: the map stays bounded
+                rates[k] = new
+        for k, cnt in window.items():
+            rates[k] = beta * (cnt / dt)
+        self._rates = rates
+
+    def total_rate(self) -> float:
+        return float(sum(self._rates.values()))
+
+    def note_state_bytes(self, key: str, nbytes: int) -> None:
+        """Record the object's last migration-snapshot size (its state
+        weight). Called by the migration manager at handoff time."""
+        self._state_bytes[key] = float(max(0, nbytes))
+
+    def move_weights(
+        self,
+        keys: list[str],
+        *,
+        rate_scale: float = 10.0,
+        bytes_scale: float = 1 << 20,
+        max_weight: float = 16.0,
+    ) -> np.ndarray:
+        """(n,) per-object move prices for the solver's stay-put discount.
+
+        ``1.0`` for a cold object, growing with measured request rate
+        (cache warmth lost on a move) and snapshot size (bytes that must
+        cross the wire), capped so one pathological actor can't dominate
+        the objective. ``JaxObjectPlacement`` consumes this via its
+        ``object_costs`` hook."""
+        rates, sizes = self._rates, self._state_bytes  # snapshot refs
+        out = np.ones((len(keys),), np.float32)
+        for i, k in enumerate(keys):
+            w = 1.0 + rates.get(k, 0.0) / rate_scale + sizes.get(k, 0.0) / bytes_scale
+            out[i] = min(max_weight, w)
+        return out
 
 
 def _profiler_trace(name: str):
@@ -300,6 +363,10 @@ class _NodeSlot:
     cordoned: bool = False  # drained: serving, but priced out of the solver
     load: float = 0.0
     index: int = 0
+    # Measured-load capacity multiplier from sync_load (ClusterLoadView):
+    # 1.0 idle, down to MIN_DERATE for an overloaded node. Quantized so
+    # per-second load reports don't thrash the solve epoch.
+    reported_derate: float = 1.0
 
 
 @dataclass
@@ -337,6 +404,7 @@ class JaxObjectPlacement(ObjectPlacement):
         obj_features=None,
         node_features=None,
         affinity_tracker: "AffinityTracker | None" = None,
+        object_costs=None,
     ) -> None:
         self._eps = eps
         self._n_iters = n_iters
@@ -380,6 +448,17 @@ class JaxObjectPlacement(ObjectPlacement):
             node_features = node_features or affinity_tracker.node_features
         self._obj_features = obj_features or _hash_features
         self._node_features = node_features or _hash_features
+        # Per-object move prices (keys -> (n,) weights, 1.0 = baseline):
+        # scales the stay-put discount so hot/heavy actors cost more to
+        # relocate than cold ones (rio_tpu/load). Works with EVERY mode
+        # that prices moves (the OT solves); defaults to the tracker's
+        # measured move_weights when one is wired. Uniform output is
+        # equivalent to the classic scalar move_cost and keeps the
+        # collapsed O(M^2) fast path; non-uniform weights route flat
+        # solves through the dense (or at scale, hierarchical) pipeline.
+        if object_costs is None and affinity_tracker is not None:
+            object_costs = affinity_tracker.move_weights
+        self._object_costs = object_costs
         # Host-mirrored directory: "{type}.{id}" -> node index.
         self._placements: dict[str, int] = {}
         # Per-node key index (node index -> keys): keeps clean_server and
@@ -507,6 +586,35 @@ class JaxObjectPlacement(ObjectPlacement):
             self._epoch += 1
             self._g = None  # potentials are stale once liveness changes
 
+    # Derates quantize to 1/8 steps: sync_load runs every monitor tick
+    # (~seconds), and an un-quantized float would change on every call,
+    # bumping the epoch each time — which would discard every in-flight
+    # solve longer than a tick (the big ones are minutes). A bucket flip
+    # is a real regime change and worth the re-solve.
+    _DERATE_STEP = 8.0
+
+    def sync_load(self, view) -> None:
+        """Feed measured cluster load (``rio_tpu.load.ClusterLoadView``)
+        into the cost model: each node's solver capacity column becomes
+        ``capacity * derate``. Loop-side and lock-free, exactly like
+        ``sync_members`` (snapshot-solve-apply covers concurrent solves);
+        called by the LoadMonitor's view refresh and the placement
+        daemon's poll. ``view=None`` (or an unknown/stale entry) resets a
+        node to its full capacity."""
+        changed = False
+        for addr, slot in self._nodes.items():
+            d = 1.0 if view is None else float(view.derate(addr))
+            if not (d == d):  # NaN guard (view sanitizes; belt-and-braces)
+                d = 1.0
+            d = min(1.0, max(0.1, d))
+            q = round(d * self._DERATE_STEP) / self._DERATE_STEP
+            if q != slot.reported_derate:
+                slot.reported_derate = q
+                changed = True
+        if changed:
+            self._epoch += 1
+            self._g = None
+
     # --------------------------------------------------------------- drain
     def cordon(self, address: str) -> None:
         """Drain a node gracefully (the kubectl-cordon analog; no reference
@@ -560,7 +668,10 @@ class JaxObjectPlacement(ObjectPlacement):
         for addr in self._node_order:
             s = self._nodes[addr]
             load[s.index] = s.load
-            cap[s.index] = s.capacity
+            # Measured load shrinks the capacity column (sync_load): a hot
+            # node takes proportionally fewer new/rebalanced seats, with a
+            # floor so it never vanishes from the solve entirely.
+            cap[s.index] = s.capacity * s.reported_derate
             # Cordoned nodes price exactly like dead ones (no NEW seats; a
             # rebalance drains them) — but their directory rows stand and
             # they keep serving until the operator stops them.
@@ -751,7 +862,7 @@ class JaxObjectPlacement(ObjectPlacement):
 
     def _hierarchical_solve(
         self, keys: list[str], node_order: list[str], cap, alive,
-        cur_idx=None, move_cost: float = 0.0,
+        cur_idx=None, move_cost: float = 0.0, move_w=None,
     ):
         """Two-level OT re-solve over hashed identity features.
 
@@ -839,6 +950,12 @@ class JaxObjectPlacement(ObjectPlacement):
             seated = (seat >= 0) & (seat < len(node_order))
             pull = np.zeros_like(obj_feat)
             pull[seated] = node_emb[seat[seated]]
+            if move_w is not None:
+                # Per-object move prices (object_costs): a hot/heavy
+                # actor's pull toward its current seat scales with its
+                # measured weight, mirroring the dense path's scaled
+                # stay-put discount.
+                pull = pull * np.asarray(move_w, np.float32)[:, None]
             obj_feat = obj_feat + np.float32(move_cost) * pull
         if bucket_n != n:
             obj_feat = np.concatenate(
@@ -944,9 +1061,34 @@ class JaxObjectPlacement(ObjectPlacement):
                     return cur_idx.copy(), None, (
                         time.perf_counter() - t0
                     ) * 1e3, solved_as
+            # Per-object move prices (object_costs hook; tracker-measured
+            # request rates + snapshot bytes by default). Evaluated in the
+            # solver thread — hooks must read only atomically-swapped
+            # state, the contract AffinityTracker already follows. Any
+            # hook failure or shape mismatch degrades to uniform pricing:
+            # load telemetry must never break a rebalance.
+            obj_w = None
+            if self._object_costs is not None:
+                try:
+                    w = np.asarray(self._object_costs(keys), np.float32)
+                except Exception:  # noqa: BLE001
+                    w = None
+                if w is not None and w.shape == (n,):
+                    w = np.clip(np.nan_to_num(w, nan=1.0, posinf=1.0), 0.0, 1e6)
+                    if n and float(np.ptp(w)) > 0.0:
+                        obj_w = w
+                    # Uniform weights are the scalar move_cost case:
+                    # leave obj_w None and keep the collapsed fast path.
             # Decide the actual code path up front so traces, profiler
             # labels, and SolveStats.mode all agree on what ran.
-            collapse = mode in ("sinkhorn", "scaling") and self._mesh is None
+            # Non-uniform per-object prices break the identical-cost-rows
+            # precondition of the O(M^2) class collapse, so priced solves
+            # take the dense (or at scale, hierarchical) pipeline.
+            collapse = (
+                mode in ("sinkhorn", "scaling")
+                and self._mesh is None
+                and obj_w is None
+            )
             # Above _FLAT_REBALANCE_MAX_ROWS the flat collapsed pipeline is
             # compile-infeasible on the TPU backend (superlinear compile:
             # the 10.5M-row expansion never finished a 900 s budget on
@@ -1011,6 +1153,7 @@ class JaxObjectPlacement(ObjectPlacement):
                         keys, node_order, cap, alive,
                         cur_idx=cur_idx if route_hier else None,
                         move_cost=self._move_cost if route_hier else 0.0,
+                        move_w=obj_w if route_hier else None,
                     )
                 elif collapse:
                     # CLASS-COLLAPSED exact solve (ops/structured.py): the
@@ -1073,33 +1216,54 @@ class JaxObjectPlacement(ObjectPlacement):
                         # so only capacity pressure (dead nodes, skew) moves
                         # anything. Discounts on dead seats are inert — the
                         # dead column is already priced at DEAD_NODE_COST.
+                        # With per-object prices (obj_w) a hot/heavy actor's
+                        # seat is discounted MORE, so when capacity forces
+                        # some share to move the solver evicts cold objects
+                        # first.
+                        stay = (
+                            self._move_cost
+                            if obj_w is None
+                            else self._move_cost * jnp.asarray(obj_w)
+                        )
                         cost = cost.at[jnp.arange(n), jnp.asarray(cur_idx)].add(
-                            -self._move_cost
+                            -stay
                         )
                     mass = jnp.concatenate(
                         [jnp.ones((n,), jnp.float32), jnp.zeros((bucket - n,), jnp.float32)]
                     )
                     if mode in ("sinkhorn", "scaling"):
-                        # Only reachable with a mesh (the collapsed branch
-                        # owns every non-mesh flat solve): shard-local
-                        # capacity splits break the pure-class structure,
-                        # so the dense sharded solvers run here.
-                        from ..parallel import (
-                            shard_cost,
-                            sharded_scaling_sinkhorn,
-                            sharded_sinkhorn,
-                        )
+                        # Reachable with a mesh (shard-local capacity
+                        # splits break the pure-class structure) or with
+                        # per-object prices (obj_w makes cost rows
+                        # distinct, so the class collapse is off and the
+                        # dense single-chip solvers run).
+                        if self._mesh is not None:
+                            from ..parallel import (
+                                shard_cost,
+                                sharded_scaling_sinkhorn,
+                                sharded_sinkhorn,
+                            )
 
-                        cost = shard_cost(self._mesh, cost)
-                        sharded = (
-                            sharded_scaling_sinkhorn
-                            if mode == "scaling"
-                            else sharded_sinkhorn
-                        )
-                        f, g = sharded(
-                            self._mesh, cost, mass, cap * alive,
-                            eps=self._eps, n_iters=self._n_iters,
-                        )
+                            cost = shard_cost(self._mesh, cost)
+                            sharded = (
+                                sharded_scaling_sinkhorn
+                                if mode == "scaling"
+                                else sharded_sinkhorn
+                            )
+                            f, g = sharded(
+                                self._mesh, cost, mass, cap * alive,
+                                eps=self._eps, n_iters=self._n_iters,
+                            )
+                        else:
+                            dense = (
+                                scaling_sinkhorn
+                                if mode == "scaling"
+                                else sinkhorn
+                            )
+                            f, g, _err = dense(
+                                cost, mass, cap * alive,
+                                eps=self._eps, n_iters=self._n_iters,
+                            )
                         assignment = plan_rounded_assign(cost, f, g, self._eps)
                         # Exact-capacity repair (bucket-shaped for trace
                         # reuse; padding rows ride a sentinel column; see

@@ -70,15 +70,20 @@ def server_gauges(server: Any) -> dict[str, float]:
     rdaemon = getattr(server, "reminder_daemon", None)
     migrator = getattr(server, "migration_manager", None)
     placement = getattr(server, "object_placement", None)
+    monitor = getattr(server, "load_monitor", None)
     gauges = stats_gauges(
         placement_daemon=getattr(daemon, "stats", None),
         reminder_daemon=getattr(rdaemon, "stats", None),
         migration=getattr(migrator, "stats", None),
         placement_solve=getattr(placement, "stats", None),
+        load=getattr(monitor, "stats", None),
     )
     registry = getattr(server, "registry", None)
     if registry is not None:
         gauges["rio.registry.objects"] = float(registry.count_objects())
+    view = getattr(monitor, "cluster_view", None)
+    if view is not None:
+        gauges.update(view.gauges())
     return gauges
 
 

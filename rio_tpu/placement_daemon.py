@@ -42,6 +42,7 @@ log = logging.getLogger("rio_tpu.placement_daemon")
 @dataclass
 class PlacementDaemonStats:
     polls: int = 0
+    load_syncs: int = 0  # ClusterLoadView pushes into the provider
     liveness_changes: int = 0
     rebalances: int = 0
     rebalances_skipped: int = 0  # sibling daemon on a shared provider won
@@ -143,6 +144,18 @@ class PlacementDaemon:
         members = await self.members_storage.members()
         return frozenset((m.address, bool(m.active)) for m in members), members
 
+    def _sync_load(self, members: list) -> None:
+        """Feed the members' piggybacked load vectors into the provider on
+        every poll (not just liveness changes): capacity derates shape the
+        NEXT solve whenever it happens, and the quantized derate keeps the
+        epoch from thrashing. No-op for providers without ``sync_load``."""
+        if not hasattr(self.placement, "sync_load"):
+            return
+        from .load import ClusterLoadView
+
+        self.placement.sync_load(ClusterLoadView.from_members(members))
+        self.stats.load_syncs += 1
+
     def _solve_epoch(self):
         """The provider's last COMMITTED-solve epoch, when it exposes one.
 
@@ -176,6 +189,7 @@ class PlacementDaemon:
             try:
                 liveness, members = await self._liveness()
                 self.stats.polls += 1
+                self._sync_load(members)
                 retry = self._retry_solve and loop.time() >= self._retry_not_before
                 changed = liveness != self._last_liveness
                 if changed:

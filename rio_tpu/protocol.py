@@ -53,6 +53,11 @@ class ErrorKind(IntEnum):
     APPLICATION = 5
     HANDLER_NOT_FOUND = 6
     SERIALIZATION = 7
+    # Overload shed (rio_tpu/load): retryable — the client backs off and
+    # retries the request against another member. The C++ codec
+    # (native/rio_native.cc) treats the kind as a generic uint, so this
+    # needs no structural wire change; tests/test_native.py pins parity.
+    SERVER_BUSY = 8
 
 
 @dataclass
@@ -91,6 +96,10 @@ class ResponseError:
     @classmethod
     def unknown(cls, detail: str) -> "ResponseError":
         return cls(ErrorKind.UNKNOWN, detail=detail)
+
+    @classmethod
+    def server_busy(cls, detail: str = "") -> "ResponseError":
+        return cls(ErrorKind.SERVER_BUSY, detail=detail)
 
 
 @dataclass

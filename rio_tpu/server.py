@@ -92,6 +92,8 @@ class Server:
         reminder_daemon: bool = False,
         reminder_daemon_config=None,
         migration_config=None,
+        load_monitor: bool = True,
+        load_thresholds=None,
     ) -> None:
         if transport not in ("asyncio", "native", "auto"):
             raise ValueError(f"unknown transport {transport!r}")
@@ -151,6 +153,30 @@ class Server:
         tracker = getattr(self.object_placement, "affinity_tracker", None)
         if tracker is not None and DispatchObserver not in self.app_data:
             self.app_data.set(DispatchObserver(tracker.observe))
+        # Load telemetry + admission control (rio_tpu/load): on by default
+        # — with no thresholds configured it only samples and publishes the
+        # node's load vector on the membership heartbeat; thresholds turn
+        # on ServerBusy shedding. The migration-stats getter is lazy: the
+        # manager is created at bind().
+        self.load_monitor = None
+        if load_monitor:
+            from .load import LoadMonitor
+
+            self.load_monitor = LoadMonitor(
+                registry=self.registry,
+                affinity_tracker=tracker,
+                migration_stats=lambda: getattr(
+                    self.migration_manager, "stats", None
+                ),
+                members_storage=self.members_storage,
+                placement=self.object_placement,
+                thresholds=load_thresholds,
+            )
+            self.app_data.set(self.load_monitor)
+            # Heartbeat pushes carry this node's encoded vector from now on.
+            self.cluster_provider.set_load_source(
+                self.load_monitor.encoded_snapshot
+            )
 
     # ------------------------------------------------------------------
 
@@ -449,6 +475,8 @@ class Server:
             asyncio.ensure_future(self._consume_admin_commands()),
             asyncio.ensure_future(self._stopped.wait()),
         ]
+        if self.load_monitor is not None:
+            tasks.append(asyncio.ensure_future(self.load_monitor.run()))
         if self.placement_daemon_enabled:
             from .placement_daemon import PlacementDaemon
 

@@ -68,6 +68,12 @@ class Service:
         from .migration import MigrationManager
 
         self._migrator = app_data.try_get(MigrationManager)
+        from .load import LoadMonitor
+
+        # Admission control + telemetry (None when the server runs without
+        # a monitor): every dispatch is counted, and over-threshold load
+        # sheds with the retryable SERVER_BUSY wire error.
+        self._load = app_data.try_get(LoadMonitor)
 
     # ------------------------------------------------------------------
     # Placement (reference service.rs:193-298)
@@ -107,6 +113,30 @@ class Service:
         if await self.members_storage.is_active(object_id.id):
             return ResponseError.redirect(object_id.id)
         return ResponseError.deallocate()
+
+    async def _shed_if_overloaded(self, object_id: ObjectId) -> ResponseError | None:
+        """Admission control: refuse work an overloaded node can DIVERT.
+
+        Sheds only requests that would activate a new object here — objects
+        already activated keep being served (bouncing them would only
+        redirect-ping-pong: their state lives here until a migration moves
+        it). Node-scoped control-plane actors are exempt one level up: a
+        saturated node must still answer MigrateObject/InstallState, which
+        are exactly how load LEAVES it. A not-yet-activated directory row
+        pointing here is un-seated (the drain-refusal pattern) so the
+        client's retry self-assigns on a healthy member instead of being
+        redirected straight back.
+        """
+        if self._load is None or self.registry.has(object_id.type_name, object_id.id):
+            return None
+        reason = self._load.shed_reason()
+        if reason is None:
+            return None
+        addr = await self.object_placement.lookup(object_id)
+        if addr == self.address:
+            await self.object_placement.remove(object_id)
+        self._load.stats.sheds += 1
+        return ResponseError.server_busy(reason)
 
     async def _refuse_if_migrating(self, object_id: ObjectId) -> ResponseError | None:
         if self._migrator is None or not self._migrator.active:
@@ -192,7 +222,13 @@ class Service:
     async def call(self, req: RequestEnvelope) -> ResponseEnvelope:
         """One request end-to-end; roots the trace its child spans join."""
         with span("request", object=req.handler_type, id=req.handler_id):
-            return await self._call(req)
+            if self._load is None:
+                return await self._call(req)
+            self._load.request_started()
+            try:
+                return await self._call(req)
+            finally:
+                self._load.request_finished()
 
     async def _call(self, req: RequestEnvelope) -> ResponseEnvelope:
         object_id = ObjectId(req.handler_type, req.handler_id)
@@ -207,6 +243,9 @@ class Service:
             if routing is not None:
                 return ResponseEnvelope.err(routing)
         else:
+            shed = await self._shed_if_overloaded(object_id)
+            if shed is not None:
+                return ResponseEnvelope.err(shed)
             refusal = await self._refuse_if_draining(object_id)
             if refusal is None:
                 refusal = await self._refuse_if_migrating(object_id)

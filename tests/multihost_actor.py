@@ -1,0 +1,53 @@
+"""Shared actor for the cross-process migration test.
+
+Imported by BOTH sides of the real-socket run: the server child process
+(``multihost_server_child.py``) registers it; the parent test imports it so
+the ``@message`` decorators register the same wire names for the client's
+codec. Keep it dependency-light — the child boots with a clean env.
+"""
+
+from rio_tpu import AppData, Registry, ServerInfo, ServiceObject, handler, message
+
+
+@message(name="mh.Bump")
+class Bump:
+    amount: int = 0
+
+
+@message(name="mh.Get")
+class Get:
+    pass
+
+
+@message(name="mh.Val")
+class Val:
+    hot: int = 0
+    address: str = ""
+
+
+class MhCounter(ServiceObject):
+    """Volatile-state-only counter: ``hot`` lives purely in memory, so it
+    survives a migration ONLY if the inline InstallState transfer really
+    carried it — a fresh activation on the target would reset it to 0."""
+
+    def __init__(self):
+        self.hot = 0
+
+    def __migrate_state__(self):
+        return {"hot": self.hot}
+
+    def __restore_state__(self, value):
+        self.hot = int(value["hot"])
+
+    @handler
+    async def bump(self, msg: Bump, ctx: AppData) -> Val:
+        self.hot += msg.amount
+        return Val(hot=self.hot, address=ctx.get(ServerInfo).address)
+
+    @handler
+    async def get(self, msg: Get, ctx: AppData) -> Val:
+        return Val(hot=self.hot, address=ctx.get(ServerInfo).address)
+
+
+def build_registry() -> Registry:
+    return Registry().add_type(MhCounter)

@@ -146,6 +146,7 @@ def test_hierarchical_dead_members_excluded_under_extreme_skew():
     assert live_loads.max() - live_loads.min() <= 2, live_loads
 
 
+@pytest.mark.slow
 @pytest.mark.skipif(
     not os.environ.get("RIO_TPU_SCALE_MESH"),
     reason="opt-in (RIO_TPU_SCALE_MESH=1): 1M x 1024 on the 8-CPU mesh, minutes",
@@ -186,6 +187,7 @@ def test_sharded_hierarchical_1m_x_1024_on_mesh():
     assert live_loads.min() >= 0.9 * fair, (live_loads.min(), fair)
 
 
+@pytest.mark.slow
 @pytest.mark.skipif(
     os.environ.get("RIO_TPU_SCALE_MESH") != "full",
     reason="opt-in (RIO_TPU_SCALE_MESH=full): the FULL BASELINE row-5 shape, minutes + GBs",

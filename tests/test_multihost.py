@@ -138,6 +138,116 @@ def test_two_process_multicontroller_solve_parity(tmp_path):
     np.testing.assert_array_equal(a, ref)
 
 
+def test_cross_process_migration_installs_state_over_sockets(tmp_path):
+    """REAL cross-process migration: two server OS processes joined only by
+    sqlite membership/placement files, a client in the parent. A volatile
+    counter (no persisted state) is seated on one process, migrated to the
+    other via MigrateObject to the node-scoped control actor, and must
+    arrive with its in-memory value intact — proving the inline
+    InstallState transfer ran over real sockets between real processes."""
+    import asyncio
+    import socket
+    import subprocess
+    import sys as _sys
+
+    ports = []
+    socks = []
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    repo = str(Path(__file__).resolve().parent.parent)
+    child = str(Path(__file__).resolve().parent / "multihost_server_child.py")
+    env = {
+        # Clean env: the ambient axon sitecustomize must not leak in.
+        "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+        "HOME": os.environ.get("HOME", "/tmp"),
+        "PYTHONPATH": repo,
+    }
+    procs = [
+        subprocess.Popen(
+            [_sys.executable, child, str(port), str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        )
+        for port in ports
+    ]
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+
+    async def drive():
+        from rio_tpu import Client
+        from rio_tpu.cluster.storage.sqlite import SqliteMembershipStorage
+        from rio_tpu.migration import CONTROL_TYPE, MigrateObject, MigrationAck
+        from rio_tpu.object_placement.sqlite import SqliteObjectPlacement
+
+        from .multihost_actor import Bump, Get, MhCounter, Val
+
+        members = SqliteMembershipStorage(str(tmp_path / "members.db"))
+        placement = SqliteObjectPlacement(str(tmp_path / "placement.db"))
+        try:
+            deadline = asyncio.get_event_loop().time() + 60.0
+            while asyncio.get_event_loop().time() < deadline:
+                if any(p.poll() is not None for p in procs):
+                    raise AssertionError("a server child exited early")
+                try:
+                    active = {m.address for m in await members.active_members()}
+                except Exception:
+                    active = set()
+                if set(addrs) <= active:
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                raise TimeoutError("children never became active members")
+
+            client = Client(members)
+            try:
+                out = await client.send(MhCounter, "m1", Bump(amount=7), returns=Val)
+                assert out.hot == 7 and out.address in addrs
+                source = out.address
+                target = next(a for a in addrs if a != source)
+
+                ack = await client.send(
+                    CONTROL_TYPE,
+                    source,
+                    MigrateObject(
+                        type_name="MhCounter", object_id="m1", target=target
+                    ),
+                    returns=MigrationAck,
+                )
+                assert ack.ok, ack.detail
+
+                # Directory flipped in the shared sqlite placement.
+                from rio_tpu.registry import ObjectId
+
+                assert await placement.lookup(ObjectId("MhCounter", "m1")) == target
+
+                # The next request reactivates on the target with the
+                # volatile value intact — only InstallState could carry it.
+                out = await client.send(MhCounter, "m1", Get(), returns=Val)
+                assert out.address == target
+                assert out.hot == 7
+                out = await client.send(MhCounter, "m1", Bump(amount=1), returns=Val)
+                assert (out.address, out.hot) == (target, 8)
+            finally:
+                client.close()
+        finally:
+            members.close()
+            placement.close()
+
+    try:
+        asyncio.run(drive())
+    finally:
+        outs = []
+        for p in procs:
+            p.kill()
+            out, _ = p.communicate(timeout=30)
+            outs.append(out.decode(errors="replace"))
+        # Surface child logs on any failure for debuggability.
+        del outs
+
+
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
 def test_distributed_array_matches_device_put_and_feeds_solver():
     mesh = make_mesh(jax.devices()[:8])

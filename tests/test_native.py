@@ -54,9 +54,19 @@ def test_response_frame_parity():
     none_body = protocol.ResponseEnvelope.ok(None)
     assert codec.frame(none_body.to_bytes()) == lib.encode_response_ok_frame(b"")
     assert protocol.ResponseEnvelope.from_bytes(none_body.to_bytes()).body == b""
+    # SERVER_BUSY (kind 8): the overload-shed error rides the same arm —
+    # the C++ side treats kind as an opaque uint, so parity must hold with
+    # no native change.
+    busy = protocol.ResponseEnvelope.err(
+        protocol.ResponseError.server_busy("inflight>256")
+    )
+    assert codec.frame(busy.to_bytes()) == lib.encode_response_err_frame(
+        int(protocol.ErrorKind.SERVER_BUSY), b"inflight>256", b""
+    )
     # Decoders agree with the Python ones.
     assert lib.decode_response(ok.to_bytes()) == (True, b"hello")
     assert lib.decode_response(err.to_bytes()) == (False, 5, b"MyErr", b"errbytes")
+    assert lib.decode_response(busy.to_bytes()) == (False, 8, b"inflight>256", b"")
     assert lib.decode_response(b"\x00garbage") is None
 
 

@@ -185,3 +185,36 @@ def test_multiple_subscribers_fan_out():
         client.close()
 
     asyncio.run(run_integration_test(body, registry_builder=build_registry, num_servers=2))
+
+
+def test_router_channels_do_not_leak():
+    """Regression: ``_channels`` grew without bound — ``publish`` to an
+    object with no subscribers materialized a permanent ``_Broadcast``
+    (fire-and-forget publishers), and the last ``drop_subscription`` left
+    the empty channel behind. Both paths must leave the map empty."""
+    router = MessageRouter()
+
+    # Publish-only path: no subscriber ever existed -> no channel created.
+    for i in range(1000):
+        assert router.publish(type_id(Broadcaster), f"ghost-{i}", Event(seq=i)) == 0
+    assert len(router._channels) == 0
+
+    # Subscribe/unsubscribe path: the last drop prunes the channel.
+    q1 = router.create_subscription("T", "a")
+    q2 = router.create_subscription("T", "a")
+    assert len(router._channels) == 1
+    assert router.publish("T", "a", Event(seq=1)) == 2
+    router.drop_subscription("T", "a", q1)
+    assert len(router._channels) == 1  # one live subscriber keeps it
+    assert router.publish("T", "a", Event(seq=2)) == 1
+    router.drop_subscription("T", "a", q2)
+    assert len(router._channels) == 0
+    # Dropping again (or on an unknown key) stays a no-op.
+    router.drop_subscription("T", "a", q2)
+    assert len(router._channels) == 0
+
+    # close_subscriptions pops too (migration handoff path).
+    q3 = router.create_subscription("T", "b")
+    assert router.close_subscriptions("T", "b", error=None) == 1
+    assert len(router._channels) == 0
+    assert q3.qsize() == 1  # the final error item was delivered
