@@ -105,6 +105,8 @@ __all__ = [
     "MigrationManager",
     "MigrationStats",
     "PrefetchPull",
+    "ReplicaAck",
+    "ReplicaAppend",
     "StateBatch",
 ]
 
@@ -220,6 +222,27 @@ class InstallState:
 @message(name="rio.MigrationAck")
 class MigrationAck:
     ok: bool = False
+    detail: str = ""
+
+
+@message(name="rio.ReplicaAppend")
+class ReplicaAppend:
+    """Primary → standby inbox: one log-shipped state delta for a
+    replicated object. ``epoch`` is the directory fence the primary read
+    from its standby row — a standby that has seen a newer epoch nacks the
+    append, so a deposed primary can never overwrite post-failover state."""
+
+    type_name: str = ""
+    object_id: str = ""
+    epoch: int = 0
+    seq: int = 0
+    payload: bytes = b""
+
+
+@message(name="rio.ReplicaAck")
+class ReplicaAck:
+    ok: bool = False
+    epoch: int = 0  # the standby's current epoch for the key (on nack)
     detail: str = ""
 
 
@@ -585,19 +608,22 @@ class MigrationManager:
         self._stash[(tname, object_id)] = (payload, now)
         self.stats.installs += 1
 
-    def restore_volatile(self, obj: Any) -> None:
+    def restore_volatile(self, obj: Any) -> bool:
         """LOAD-lifecycle hook: hand a stashed snapshot to the fresh
         activation's ``__restore_state__`` (runs after ``load_state``, so
-        managed fields are already warm)."""
+        managed fields are already warm). Returns True when a snapshot was
+        applied — the replication fallback restore yields to it (a
+        coordinated-handoff stash is newer than any shipped replica)."""
         key = (type_id(type(obj)), obj.id)
         stashed = self._stash.pop(key, None)
         if stashed is None:
-            return
+            return False
         payload, ts = stashed
         restore = getattr(obj, "__restore_state__", None)
         if restore is None or time.monotonic() - ts > STASH_TTL:
-            return
+            return False
         restore(codec.deserialize(payload, Any))
+        return True
 
     # ------------------------------------------------------------------
     # Coordinator role (the rebalancer's move sink)
@@ -796,3 +822,16 @@ class MigrationInbox(ServiceObject):
         if mgr is None:
             return StateBatch()
         return StateBatch(items=await mgr.prefetch_serve(msg.items, msg.requester))
+
+    @handler
+    async def replica_append(self, msg: ReplicaAppend, ctx: AppData) -> ReplicaAck:
+        # Replication rides the same node-scoped inbox as migration installs
+        # (same acyclic wait-for-graph argument: apply_append is purely
+        # local). Lazy import — rio_tpu.replication imports this module for
+        # the wire types.
+        from ..replication import ReplicationManager
+
+        mgr = ctx.try_get(ReplicationManager)
+        if mgr is None:
+            return ReplicaAck(ok=False, detail="replication disabled on this node")
+        return mgr.apply_append(msg)

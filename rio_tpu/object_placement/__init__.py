@@ -64,6 +64,38 @@ class ObjectPlacement(abc.ABC):
         whose warm restart reloads the whole directory."""
         raise NotImplementedError(f"{type(self).__name__} cannot enumerate")
 
+    # ------------------------------------------------------------------
+    # Replica rows (replication subsystem). Every backend stores, next to
+    # the primary row, an optional ``(standbys, epoch)`` pair per object.
+    # The epoch is the fence: it only ever moves through
+    # :meth:`promote_standby`'s compare-and-swap, so a partitioned old
+    # primary still shipping state with a stale epoch can be detected and
+    # nacked by the standby side (see ``rio_tpu/replication``).
+    # ------------------------------------------------------------------
+
+    async def set_standbys(self, object_id: ObjectId, addresses: list[str]) -> int:
+        """Replace the standby set; the epoch is preserved (created at 0).
+
+        Returns the row's current epoch so the caller can fence its ships.
+        """
+        raise NotImplementedError(f"{type(self).__name__} stores no standbys")
+
+    async def standbys(self, object_id: ObjectId) -> tuple[list[str], int]:
+        """``(standby addresses, epoch)``; ``([], 0)`` when no replica row
+        exists (an epoch-0 row and no row are indistinguishable on purpose:
+        promotion from either state produces epoch 1)."""
+        raise NotImplementedError(f"{type(self).__name__} stores no standbys")
+
+    async def promote_standby(
+        self, object_id: ObjectId, address: str, expected_epoch: int
+    ) -> int | None:
+        """CAS promotion: if ``address`` is a current standby and the row's
+        epoch equals ``expected_epoch``, make it the primary (primary row
+        flipped, ``address`` removed from the standby set, epoch bumped)
+        and return the new epoch. Returns ``None`` when the CAS loses —
+        someone else promoted first, or the standby set changed."""
+        raise NotImplementedError(f"{type(self).__name__} stores no standbys")
+
 
 class LocalObjectPlacement(ObjectPlacement):
     """In-memory directory; clones alias the same dict.
@@ -74,6 +106,7 @@ class LocalObjectPlacement(ObjectPlacement):
 
     def __init__(self) -> None:
         self._placements: dict[str, str] = {}
+        self._standbys: dict[str, tuple[list[str], int]] = {}
 
     async def update(self, item: ObjectPlacementItem) -> None:
         key = str(item.object_id)
@@ -92,6 +125,33 @@ class LocalObjectPlacement(ObjectPlacement):
 
     async def remove(self, object_id: ObjectId) -> None:
         self._placements.pop(str(object_id), None)
+        self._standbys.pop(str(object_id), None)
+
+    async def set_standbys(self, object_id: ObjectId, addresses: list[str]) -> int:
+        key = str(object_id)
+        _, epoch = self._standbys.get(key, ([], 0))
+        if addresses:
+            self._standbys[key] = (list(addresses), epoch)
+        elif epoch:
+            self._standbys[key] = ([], epoch)
+        else:
+            self._standbys.pop(key, None)
+        return epoch
+
+    async def standbys(self, object_id: ObjectId) -> tuple[list[str], int]:
+        held, epoch = self._standbys.get(str(object_id), ([], 0))
+        return list(held), epoch
+
+    async def promote_standby(
+        self, object_id: ObjectId, address: str, expected_epoch: int
+    ) -> int | None:
+        key = str(object_id)
+        held, epoch = self._standbys.get(key, ([], 0))
+        if epoch != expected_epoch or address not in held:
+            return None
+        self._standbys[key] = ([a for a in held if a != address], epoch + 1)
+        self._placements[key] = address
+        return epoch + 1
 
     async def items(self) -> list[ObjectPlacementItem]:
         return [

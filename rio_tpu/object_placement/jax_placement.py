@@ -355,6 +355,100 @@ def _apply_class_quotas(quotas: np.ndarray, cur_idx: np.ndarray) -> np.ndarray:
     return out
 
 
+# Anti-affinity penalty for the multi-seat (replica) solve. Relative to the
+# default eps this puts cost-range/eps far beyond the exp underflow knee
+# (~88) — exactly the wide-cost-range regime the PER-ROW gauge shift exists
+# for (CLAUDE.md; test_scaling_survives_wide_cost_ranges). The log-domain
+# sinkhorn used below is stable at any range.
+_ANTI_AFFINITY_COST = 1e4
+
+
+def multi_seat_plan(
+    primary_idx: np.ndarray,
+    k: int,
+    load: np.ndarray,
+    cap: np.ndarray,
+    alive: np.ndarray,
+    *,
+    eps: float = 0.05,
+    n_iters: int = 30,
+) -> np.ndarray:
+    """K standby seats per object under hard anti-affinity.
+
+    The multi-seat problem collapses the same way the flat rebalance does:
+    every object with the same *forbidden set* (primary + seats chosen in
+    earlier rounds) has an identical cost row, so each of the K rounds is a
+    class-collapsed ``(C x M)`` solve — ``C <= M`` on the first round, the
+    uniform case the O(M^2) path covers — not an ``(N x M)`` one. Each
+    round runs the log-domain Sinkhorn (:func:`rio_tpu.ops.sinkhorn.sinkhorn`,
+    the per-row gauge-shifted semantic reference) over the class cost with
+    ``_ANTI_AFFINITY_COST`` on forbidden columns, then rounds each class's
+    soft plan row to integer seat quotas by largest remainder. Forbidden
+    columns are zeroed before rounding, so a primary and its standbys can
+    NEVER co-locate; classes with no schedulable allowed column get their
+    seat back as -1 (degraded replication, never a violation).
+
+    Returns an ``(n, k)`` int32 array of node indices, -1 for unfillable
+    seats. Pure function of its snapshot inputs — safe to run in a solver
+    thread (the loop-side-snapshot rule) and to property-test directly.
+    """
+    primary_idx = np.asarray(primary_idx, np.int64)
+    n = int(primary_idx.shape[0])
+    m = int(cap.shape[0])
+    seats = np.full((n, k), -1, np.int32)
+    if n == 0 or k <= 0:
+        return seats
+    load = np.asarray(load, np.float32).copy()
+    cap_alive = np.asarray(cap, np.float32) * (np.asarray(alive, np.float32) > 0)
+    taken = np.zeros((n, m), bool)
+    has_primary = (primary_idx >= 0) & (primary_idx < m)
+    taken[np.arange(n)[has_primary], primary_idx[has_primary]] = True
+    for r in range(k):
+        classes, inverse = np.unique(taken, axis=0, return_inverse=True)
+        counts = np.bincount(inverse, minlength=classes.shape[0]).astype(
+            np.float32
+        )
+        allowed = (~classes) & (cap_alive > 0)[None, :]
+        solvable = allowed.any(axis=1)
+        if not solvable.any():
+            break
+        # Load-aware base cost (fill ratio) + the anti-affinity wall.
+        fill = load / np.maximum(cap_alive, 1e-6)
+        cost = np.where(allowed, fill[None, :], _ANTI_AFFINITY_COST).astype(
+            np.float32
+        )
+        res = sinkhorn(
+            jnp.asarray(cost),
+            jnp.asarray(counts * solvable),
+            jnp.asarray(cap_alive),
+            eps=eps,
+            n_iters=n_iters,
+        )
+        f = np.asarray(res.f, np.float64)[:, None]
+        g = np.asarray(res.g, np.float64)[None, :]
+        with np.errstate(invalid="ignore"):
+            expo = np.where(
+                np.isfinite(f) & np.isfinite(g), f + g - cost, -np.inf
+            )
+        weights = np.exp(np.clip(expo / eps, -80.0, 80.0)) * allowed
+        for c in np.nonzero(solvable)[0]:
+            rows_c = np.nonzero(inverse == c)[0]
+            w = weights[c]
+            if w.sum() <= 0:
+                w = allowed[c].astype(np.float64)
+            share = w / w.sum() * rows_c.shape[0]
+            quota = np.floor(share).astype(np.int64)
+            short = rows_c.shape[0] - int(quota.sum())
+            if short > 0:
+                rem_order = np.argsort(-(share - quota), kind="stable")
+                quota[rem_order[:short]] += 1
+            targets = np.repeat(np.arange(m), quota)[: rows_c.shape[0]]
+            seats[rows_c, r] = targets
+            taken[rows_c, targets] = True
+            np.add.at(load, targets, 1.0)
+    return seats
+
+
 @dataclass
 class _NodeSlot:
     address: str
@@ -461,6 +555,10 @@ class JaxObjectPlacement(ObjectPlacement):
         self._object_costs = object_costs
         # Host-mirrored directory: "{type}.{id}" -> node index.
         self._placements: dict[str, int] = {}
+        # Replica rows: "{type}.{id}" -> (standby addresses, epoch). Kept by
+        # address (not node index) so a standby row survives node-axis
+        # growth and mirrors the durable backends' schema 1:1.
+        self._standby_rows: dict[str, tuple[list[str], int]] = {}
         # Per-node key index (node index -> keys): keeps clean_server and
         # load recounts O(objects-on-node), the same reason the Redis
         # backend keeps a per-server set (object_placement/redis.py).
@@ -532,6 +630,14 @@ class JaxObjectPlacement(ObjectPlacement):
         if idx is not None:
             self._by_node.get(idx, set()).discard(key)
         return idx
+
+    def _set_standby_row(self, key: str, addresses: list[str], epoch: int) -> None:
+        """Single mutation seam for replica rows (lock held) — like
+        ``_set_placement``, so write-behind subclasses see every change."""
+        self._standby_rows[key] = (list(addresses), epoch)
+
+    def _drop_standby_row(self, key: str) -> None:
+        self._standby_rows.pop(key, None)
 
     # ---------------------------------------------------------------- nodes
     def _node_index(self, address: str) -> int:
@@ -729,11 +835,90 @@ class JaxObjectPlacement(ObjectPlacement):
 
     async def remove(self, object_id: ObjectId) -> None:
         async with self._lock:
-            if self._drop_placement(str(object_id)) is not None:
+            key = str(object_id)
+            if key in self._standby_rows:
+                self._drop_standby_row(key)
+            if self._drop_placement(key) is not None:
                 self._epoch += 1
 
     def count(self) -> int:
         return len(self._placements)
+
+    # ------------------------------------------------------- replica rows
+    async def set_standbys(self, object_id: ObjectId, addresses: list[str]) -> int:
+        key = str(object_id)
+        async with self._lock:
+            _, epoch = self._standby_rows.get(key, ([], 0))
+            if addresses or epoch:
+                self._set_standby_row(key, list(addresses), epoch)
+            elif key in self._standby_rows:
+                self._drop_standby_row(key)
+            return epoch
+
+    async def standbys(self, object_id: ObjectId) -> tuple[list[str], int]:
+        # Lock-free read, like lookup(): single-assignment snapshot of an
+        # immutable (list, epoch) tuple.
+        held, epoch = self._standby_rows.get(str(object_id), ([], 0))
+        return list(held), epoch
+
+    async def promote_standby(
+        self, object_id: ObjectId, address: str, expected_epoch: int
+    ) -> int | None:
+        key = str(object_id)
+        async with self._lock:
+            held, epoch = self._standby_rows.get(key, ([], 0))
+            if epoch != expected_epoch or address not in held:
+                return None
+            self._set_standby_row(
+                key, [a for a in held if a != address], epoch + 1
+            )
+            self._set_placement(key, self._node_index(address))
+            self._epoch += 1
+            return epoch + 1
+
+    async def assign_standbys(
+        self, object_ids: list[ObjectId], k: int = 1
+    ) -> list[list[str]]:
+        """Compute K anti-affinity standby seats per object (compute only —
+        the caller persists the choice through :meth:`set_standbys`, so the
+        epoch fence stays in one place).
+
+        Snapshot-solve discipline as everywhere else: node vectors and
+        primary seats are snapshotted under the lock on the loop, the
+        class-collapsed :func:`multi_seat_plan` runs in a thread, and no
+        live provider state is read from that thread.
+        """
+        if not object_ids or k <= 0:
+            return [[] for _ in object_ids]
+        async with self._lock:
+            keys = [str(o) for o in object_ids]
+            primary = np.asarray(
+                [self._placements.get(key, -1) for key in keys], np.int64
+            )
+            load, cap, alive = self._node_vectors()
+            node_order = list(self._node_order)
+            no_capacity = self._no_schedulable_capacity_host()
+        if no_capacity:
+            return [[] for _ in object_ids]
+        eps, n_iters = self._eps, self._n_iters
+
+        def _solve() -> np.ndarray:
+            return multi_seat_plan(
+                primary,
+                k,
+                np.asarray(load),
+                np.asarray(cap),
+                np.asarray(alive),
+                eps=eps,
+                n_iters=n_iters,
+            )
+
+        seats = await asyncio.to_thread(_solve)
+        n_real = len(node_order)
+        return [
+            [node_order[j] for j in row if 0 <= j < n_real]
+            for row in seats
+        ]
 
     # ------------------------------------------------------- batched solve
     async def lookup_batch(self, object_ids: list[ObjectId]) -> list[str | None]:

@@ -54,6 +54,7 @@ class PersistentJaxObjectPlacement(JaxObjectPlacement):
         self._backing = backing
         self._flush_interval = flush_interval
         self._dirty: dict[str, str | None] = {}  # key -> address | None=delete
+        self._dirty_standbys: dict[str, list[str]] = {}  # key -> standby set
         self._flusher: asyncio.Task | None = None
         self._flush_wake: asyncio.Event | None = None  # created on the loop
         self._flush_lock = asyncio.Lock()  # serializes manual + background
@@ -108,8 +109,23 @@ class PersistentJaxObjectPlacement(JaxObjectPlacement):
             self._mark(key, None)
         return idx
 
+    def _set_standby_row(self, key: str, addresses: list[str], epoch: int) -> None:
+        super()._set_standby_row(key, addresses, epoch)
+        if not self._restoring:
+            self._dirty_standbys[key] = list(addresses)
+            self._wake_flusher()
+
+    def _drop_standby_row(self, key: str) -> None:
+        super()._drop_standby_row(key)
+        if not self._restoring:
+            self._dirty_standbys[key] = []
+            self._wake_flusher()
+
     def _mark(self, key: str, address: str | None) -> None:
         self._dirty[key] = address
+        self._wake_flusher()
+
+    def _wake_flusher(self) -> None:
         if self._flush_wake is None:
             self._flush_wake = asyncio.Event()
         self._flush_wake.set()
@@ -146,8 +162,9 @@ class PersistentJaxObjectPlacement(JaxObjectPlacement):
             return await self._flush_locked()
 
     async def _flush_locked(self) -> int:
+        flushed = await self._flush_standbys_locked()
         if not self._dirty:
-            return 0
+            return flushed
         dirty, self._dirty = self._dirty, {}
         try:
             # ONE batched write for updates AND deletes: every backend's
@@ -170,7 +187,79 @@ class PersistentJaxObjectPlacement(JaxObjectPlacement):
             for k, addr in dirty.items():
                 self._dirty.setdefault(k, addr)
             raise
-        return len(dirty)
+        return flushed + len(dirty)
+
+    async def _flush_standbys_locked(self) -> int:
+        if not self._dirty_standbys:
+            return 0
+        dirty, self._dirty_standbys = self._dirty_standbys, {}
+        done = 0
+        try:
+            # Per-key writes (the trait has no standby batch hook): replica
+            # sets change at placement/repair cadence, not per request, so
+            # the write volume is nothing like the primary-row stream. The
+            # backing preserves its own epoch — only promote_standby (write-
+            # THROUGH below) ever moves it.
+            for k, addrs in list(dirty.items()):
+                await self._backing.set_standbys(
+                    ObjectId(*k.split(".", 1)), addrs
+                )
+                dirty.pop(k)
+                done += 1
+        except BaseException:
+            for k, addrs in dirty.items():
+                self._dirty_standbys.setdefault(k, addrs)
+            raise
+        return done
+
+    # ------------------------------------------------------- replica rows
+    # Standby SETS ride the write-behind like primary rows; the EPOCH is
+    # different — it is the failover fence, so it must be durable the
+    # instant it moves. promote_standby is therefore write-THROUGH: the
+    # backing store's CAS is the arbiter, the mirror follows its verdict.
+
+    async def standbys(self, object_id) -> tuple[list[str], int]:
+        key = str(object_id)
+        row = self._standby_rows.get(key)
+        if row is not None:
+            held, epoch = row
+            return list(held), epoch
+        # Mirror miss (cold restart): read through. Not cached — a row is
+        # only mirrored once this node writes it, keeping restore lazy.
+        return await self._backing.standbys(object_id)
+
+    async def set_standbys(self, object_id, addresses: list[str]) -> int:
+        # Seed the mirror with the BACKING's epoch on first touch after a
+        # restart, or the returned fence would restart at 0 while the
+        # durable row is ahead of it.
+        key = str(object_id)
+        if key not in self._standby_rows:
+            _, epoch = await self._backing.standbys(object_id)
+            async with self._lock:
+                if key not in self._standby_rows:
+                    self._set_standby_row(key, list(addresses), epoch)
+                    return epoch
+        return await super().set_standbys(object_id, addresses)
+
+    async def promote_standby(
+        self, object_id, address: str, expected_epoch: int
+    ) -> int | None:
+        # The durable CAS must see this node's standby writes first.
+        await self.flush()
+        new_epoch = await self._backing.promote_standby(
+            object_id, address, expected_epoch
+        )
+        if new_epoch is None:
+            return None
+        key = str(object_id)
+        async with self._lock:
+            held, _ = self._standby_rows.get(key, ([], 0))
+            self._set_standby_row(
+                key, [a for a in held if a != address], new_epoch
+            )
+            self._set_placement(key, self._node_index(address))
+            self._epoch += 1
+        return new_epoch
 
     async def aclose(self) -> None:
         """Final flush + stop the flusher (planned shutdown)."""

@@ -21,7 +21,16 @@ MIGRATIONS = [
     );
     CREATE INDEX IF NOT EXISTS idx_object_placement_server
         ON object_placement (server_address)
+    """,
     """
+    CREATE TABLE IF NOT EXISTS object_standby (
+        struct_name TEXT NOT NULL,
+        object_id   TEXT NOT NULL,
+        standbys    TEXT NOT NULL DEFAULT '',
+        epoch       INTEGER NOT NULL DEFAULT 0,
+        PRIMARY KEY (struct_name, object_id)
+    )
+    """,
 ]
 
 

@@ -26,6 +26,9 @@ class RedisObjectPlacement(ObjectPlacement):
     def _server_key(self, address: str) -> str:
         return f"{self.prefix}:placement_server:{address}"
 
+    def _standby_key(self, key: str) -> str:
+        return f"{self.prefix}:standby:{key}"
+
     async def update(self, item: ObjectPlacementItem) -> None:
         await self.update_batch([item])
 
@@ -83,10 +86,48 @@ class RedisObjectPlacement(ObjectPlacement):
     async def remove(self, object_id: ObjectId) -> None:
         key = str(object_id)
         old = await self.client.execute("GET", self._obj_key(key))
-        cmds: list[tuple] = [("DEL", self._obj_key(key))]
+        cmds: list[tuple] = [("DEL", self._obj_key(key)), ("DEL", self._standby_key(key))]
         if old is not None:
             cmds.insert(0, ("SREM", self._server_key(old.decode()), key))
         await self.client.execute_pipeline(cmds)
+
+    async def _standby_row(self, key: str) -> tuple[list[str], int]:
+        raw = await self.client.execute("GET", self._standby_key(key))
+        if not isinstance(raw, bytes):
+            return [], 0
+        epoch_s, _, held = raw.decode().partition("|")
+        return [a for a in held.split(",") if a], int(epoch_s)
+
+    async def set_standbys(self, object_id: ObjectId, addresses: list[str]) -> int:
+        # Value is ``"{epoch}|{addr,...}"``; epoch only moves in
+        # promote_standby, so a plain SET preserving the read epoch is the
+        # same check-then-act exposure class clean_server documents.
+        key = str(object_id)
+        _, epoch = await self._standby_row(key)
+        if addresses or epoch:
+            await self.client.execute(
+                "SET", self._standby_key(key), f"{epoch}|{','.join(addresses)}"
+            )
+        else:
+            await self.client.execute("DEL", self._standby_key(key))
+        return epoch
+
+    async def standbys(self, object_id: ObjectId) -> tuple[list[str], int]:
+        return await self._standby_row(str(object_id))
+
+    async def promote_standby(
+        self, object_id: ObjectId, address: str, expected_epoch: int
+    ) -> int | None:
+        key = str(object_id)
+        held, epoch = await self._standby_row(key)
+        if epoch != expected_epoch or address not in held:
+            return None
+        remaining = ",".join(a for a in held if a != address)
+        await self.client.execute(
+            "SET", self._standby_key(key), f"{epoch + 1}|{remaining}"
+        )
+        await self.update(ObjectPlacementItem(object_id, address))
+        return epoch + 1
 
     async def lookup_batch(self, object_ids: list[ObjectId]) -> list[str | None]:
         raws = await self.client.execute_pipeline(

@@ -69,12 +69,14 @@ def server_gauges(server: Any) -> dict[str, float]:
     daemon = getattr(server, "placement_daemon", None)
     rdaemon = getattr(server, "reminder_daemon", None)
     migrator = getattr(server, "migration_manager", None)
+    replicator = getattr(server, "replication_manager", None)
     placement = getattr(server, "object_placement", None)
     monitor = getattr(server, "load_monitor", None)
     gauges = stats_gauges(
         placement_daemon=getattr(daemon, "stats", None),
         reminder_daemon=getattr(rdaemon, "stats", None),
         migration=getattr(migrator, "stats", None),
+        replication=getattr(replicator, "stats", None),
         placement_solve=getattr(placement, "stats", None),
         load=getattr(monitor, "stats", None),
     )

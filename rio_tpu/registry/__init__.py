@@ -89,6 +89,7 @@ class Registry:
         self._handlers: dict[tuple[str, str], HandlerSpec] = {}
         self._objects: dict[tuple[str, str], _Entry] = {}
         self._node_scoped: set[str] = set()
+        self._replicated: set[str] = set()
 
     # -- type / handler registration (reference registry/mod.rs:82-182) ----
 
@@ -114,6 +115,12 @@ class Registry:
             # redirects everything else. Framework control planes (e.g.
             # migration) use this so the solver never re-seats them.
             self._node_scoped.add(tname)
+        if getattr(cls, "__replicated__", False):
+            # Replicated actors (``__replicated__ = True``) opt into hot
+            # standbys: the service layer ships their volatile state to the
+            # standby set after every acknowledged request
+            # (rio_tpu/replication).
+            self._replicated.add(tname)
         for spec in resolve_handlers(cls):
             # Lifecycle dispatch (activation Load) and reminder wakeups are
             # framework plumbing and must exist regardless of the declared
@@ -148,6 +155,9 @@ class Registry:
 
     def is_node_scoped(self, type_name: str) -> bool:
         return type_name in self._node_scoped
+
+    def is_replicated(self, type_name: str) -> bool:
+        return type_name in self._replicated
 
     def has_handler(self, type_name: str, message_type: str) -> bool:
         return (type_name, message_type) in self._handlers

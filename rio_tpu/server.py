@@ -92,6 +92,7 @@ class Server:
         reminder_daemon: bool = False,
         reminder_daemon_config=None,
         migration_config=None,
+        replication_config=None,
         load_monitor: bool = True,
         load_thresholds=None,
     ) -> None:
@@ -127,6 +128,10 @@ class Server:
         # (a rio_tpu.migration.MigrationConfig; None → defaults).
         self.migration_config = migration_config
         self.migration_manager = None  # created at bind() (needs the address)
+        # Hot-standby replication for ``__replicated__`` actor types
+        # (a rio_tpu.replication.ReplicationConfig; None → disabled).
+        self.replication_config = replication_config
+        self.replication_manager = None  # created at bind() (needs the address)
         self._admin = AdminSender()
         self._internal = InternalClientSender()
         self._draining = ServerDraining()
@@ -268,6 +273,19 @@ class Server:
             self.app_data.set(self.migration_manager)
             self.registry.add_type(MigrationControl)
             self.registry.add_type(MigrationInbox)
+        if self.replication_manager is None and self.replication_config is not None:
+            # Rides the MigrationInbox registered above — no extra actor.
+            from .replication import ReplicationManager
+
+            self.replication_manager = ReplicationManager(
+                address=self._local_addr,
+                registry=self.registry,
+                placement=self.object_placement,
+                members_storage=self.members_storage,
+                app_data=self.app_data,
+                config=self.replication_config,
+            )
+            self.app_data.set(self.replication_manager)
         return self._local_addr
 
     def _advertised(self, bound_host: str, bound_port: int) -> str:
@@ -477,6 +495,8 @@ class Server:
         ]
         if self.load_monitor is not None:
             tasks.append(asyncio.ensure_future(self.load_monitor.run()))
+        if self.replication_manager is not None:
+            tasks.append(asyncio.ensure_future(self.replication_manager.run()))
         if self.placement_daemon_enabled:
             from .placement_daemon import PlacementDaemon
 
@@ -531,6 +551,8 @@ class Server:
                 await self._listener.wait_closed()
             if self.migration_manager is not None:
                 self.migration_manager.close()
+            if self.replication_manager is not None:
+                self.replication_manager.close()
             # Leaving the cluster: mark self inactive so peers stop routing here.
             with contextlib.suppress(Exception):
                 host, _, port = self.local_address.rpartition(":")

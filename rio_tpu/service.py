@@ -68,6 +68,12 @@ class Service:
         from .migration import MigrationManager
 
         self._migrator = app_data.try_get(MigrationManager)
+        from .replication import ReplicationManager
+
+        # Hot-standby engine (None unless the server was built with a
+        # replication_config): ships replicated actors' state on ack and
+        # drives epoch-fenced failover from the dead-owner branch.
+        self._replication = app_data.try_get(ReplicationManager)
         from .load import LoadMonitor
 
         # Admission control + telemetry (None when the server runs without
@@ -160,10 +166,28 @@ class Service:
                 await self.object_placement.remove(object_id)
                 addr = None
             elif addr != self.address and not await self.members_storage.is_active(addr):
-                # Owner is dead: bulk-unassign everything it held
+                # Owner is dead. A replicated object fails over FIRST: the
+                # epoch CAS flips the primary row to a live standby, and that
+                # row — no longer pointing at the dead node — survives the
+                # clean_server sweep below. Everything else falls through to
+                # the lazy self-assign, as before.
+                promoted = None
+                if self._replication is not None:
+                    promoted = await self._replication.maybe_promote(object_id, addr)
+                # Bulk-unassign everything the dead node held
                 # (reference service.rs:227-238).
                 await self.object_placement.clean_server(addr)
-                addr = None
+                addr = promoted
+        if (
+            addr is None
+            and self._replication is not None
+            and self.registry.is_replicated(object_id.type_name)
+        ):
+            # Unplaced but replicated: a standby row may outlive the primary
+            # row (clean_server after a failover wipes every row the dead
+            # node held). Adopt a live standby — it holds the shipped
+            # replica — instead of self-assigning a fresh instance.
+            addr = await self._replication.maybe_promote(object_id)
         if addr is None:
             addr = self.address
             await self.object_placement.update(
@@ -279,6 +303,14 @@ class Service:
                     self._observe(f"{req.handler_type}.{req.handler_id}", self.address)
                 except Exception:
                     log.exception("dispatch observer failed")
+            if self._replication is not None and self.registry.is_replicated(
+                req.handler_type
+            ):
+                # Ship-on-ack: the state delta reaches every standby BEFORE
+                # the client sees this response, so a primary death cannot
+                # lose an acknowledged write. Never raises — a failed ship
+                # degrades to the anti-entropy retry, not a failed request.
+                await self._replication.ship_on_ack(object_id)
             return ResponseEnvelope.ok(body)
         except ApplicationRaised as e:
             # Typed user error: object stays alive (reference Err path).

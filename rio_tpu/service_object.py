@@ -137,13 +137,22 @@ class ServiceObject:
 
         Runs between ``load_state`` and ``after_load`` so ``__restore_state__``
         sees warm managed fields and ``after_load`` sees the restored
-        volatile state. A no-op without a migration manager or stash entry.
+        volatile state. A migration stash wins over a shipped replica (a
+        coordinated handoff is newer than any log-shipped delta); the
+        replica covers the path with no handoff at all — activation on a
+        promoted standby after the primary died. A no-op without either
+        manager or entry.
         """
         from .migration import MigrationManager
 
         mgr = ctx.try_get(MigrationManager)
-        if mgr is not None:
-            mgr.restore_volatile(self)
+        if mgr is not None and mgr.restore_volatile(self):
+            return
+        from .replication import ReplicationManager
+
+        repl = ctx.try_get(ReplicationManager)
+        if repl is not None:
+            repl.restore_replica(self)
 
     @handler
     async def _handle_reminder(self, msg: ReminderFired, ctx: AppData) -> None:

@@ -117,6 +117,51 @@ async def check_placement(p: ObjectPlacement):
     assert len(rows) == 6  # 5 batch rows + the dotted one
     restored = {(i.object_id.type_name, i.object_id.id) for i in await p.items()}
     assert ("Svc", "dotted.id.0") in restored
+    await check_standbys(p)
+
+
+async def check_standbys(p: ObjectPlacement):
+    """Replica-row matrix every directory backend must pass identically:
+    epoch-preserving set, CAS promotion (epoch fence + membership guard),
+    clean_server survival (rows keyed by object, not address), remove."""
+    oid = ObjectId("Svc", "r1")
+    # No row and an epoch-0 row are indistinguishable on purpose.
+    assert await p.standbys(oid) == ([], 0)
+    # set_standbys preserves the fence: rows are created at epoch 0 and
+    # replacement never moves the epoch (only promote_standby does).
+    assert await p.set_standbys(oid, ["s1:1", "s2:2"]) == 0
+    assert await p.standbys(oid) == (["s1:1", "s2:2"], 0)
+    assert await p.set_standbys(oid, ["s2:2", "s3:3"]) == 0
+    # Losing CAS: wrong epoch, or the address is not a current standby.
+    assert await p.promote_standby(oid, "s2:2", 5) is None
+    assert await p.promote_standby(oid, "s9:9", 0) is None
+    assert await p.standbys(oid) == (["s2:2", "s3:3"], 0)
+    # Winning CAS: primary row flipped to the winner, winner leaves the
+    # standby set, epoch bumps exactly once.
+    await p.update(ObjectPlacementItem(oid, "h1:1"))
+    assert await p.promote_standby(oid, "s2:2", 0) == 1
+    assert await p.lookup(oid) == "s2:2"
+    assert await p.standbys(oid) == (["s3:3"], 1)
+    # The deposed primary's retry against the old epoch is fenced off.
+    assert await p.promote_standby(oid, "s3:3", 0) is None
+    # Standby rows are keyed by object: clean_server of the new primary
+    # wipes its primary row but the replica row (and fence) survive —
+    # the second failover depends on this.
+    await p.clean_server("s2:2")
+    assert await p.lookup(oid) is None
+    assert await p.standbys(oid) == (["s3:3"], 1)
+    assert await p.promote_standby(oid, "s3:3", 1) == 2
+    assert await p.lookup(oid) == "s3:3"
+    assert await p.standbys(oid) == ([], 2)
+    # Repair after the second failover keeps the advanced fence, even
+    # through an emptied set.
+    assert await p.set_standbys(oid, ["s4:4"]) == 2
+    assert await p.set_standbys(oid, []) == 2
+    assert await p.standbys(oid) == ([], 2)
+    # remove() clears the replica row with the primary row.
+    await p.set_standbys(oid, ["s5:5"])
+    await p.remove(oid)
+    assert await p.standbys(oid) == ([], 0)
 
 
 @pytest.mark.asyncio

@@ -207,3 +207,128 @@ def test_carry_falls_back_to_legacy_when_tpu_sidecar_corrupt(tmp_path):
         banked = _read(tmp_path, name)
         assert banked["collapsed_tier"]["run"] == 2
         assert banked["baseline_row5_hier"]["run"] == 1
+
+
+# ---------------------------------------------------------------------------
+# relay_health annotation + the cpu-fallback tpu_banked block
+# ---------------------------------------------------------------------------
+
+from bench import _tpu_banked_block  # noqa: E402
+
+
+def test_relay_health_annotated_on_tpu_write(tmp_path):
+    """Every tpu bank carries a relay-condition verdict and an explicit
+    list of sync-contaminated fields — a reader must not have to know the
+    tunnel's timing semantics to avoid misreading pull_ms as device time."""
+    fresh = {
+        "collapsed_tier": {"platform": "tpu", "pull_ms": 300.0,
+                           "single_shot_ms": 290.0, "full_ms": 260.0},
+        "baseline_row5_hier": {"ok": True, "preflight_pull_ms": 310.0},
+    }
+    _write_detail(fresh, here=str(tmp_path))
+    for name in ("BENCH_DETAIL.tpu.json", "BENCH_DETAIL.json"):
+        health = _read(tmp_path, name)["relay_health"]
+        assert health["trend"] == "stable"
+        assert health["first_pull_ms"] == 300.0
+        assert health["hier_preflight_min3_ms"] == 310.0
+        assert "collapsed_tier.pull_ms" in health["sync_contaminated"]
+        assert "collapsed_tier.single_shot_ms" in health["sync_contaminated"]
+        assert "collapsed_tier.full_ms" not in health["sync_contaminated"]
+    # The annotation never leaks into the caller's dict.
+    assert "relay_health" not in fresh
+
+
+def test_relay_health_flags_in_run_degradation(tmp_path):
+    """Rising pull latency in-run is the r4/r5 wedge precursor — the bank
+    must say so (ceiling breach, or 2x growth even under the ceiling)."""
+    _write_detail(
+        {
+            "collapsed_tier": {"platform": "tpu", "pull_ms": 212.0},
+            "baseline_row5_hier": {"ok": True, "preflight_pull_ms": 800.0},
+        },
+        here=str(tmp_path),
+    )
+    assert _read(tmp_path, "BENCH_DETAIL.tpu.json")["relay_health"]["trend"] == (
+        "degrading"
+    )
+    _write_detail(
+        {
+            "collapsed_tier": {"platform": "tpu", "pull_ms": 212.0},
+            "baseline_row5_hier": {"ok": True, "preflight_pull_ms": 500.0},
+        },
+        here=str(tmp_path),
+    )
+    assert _read(tmp_path, "BENCH_DETAIL.tpu.json")["relay_health"]["trend"] == (
+        "degrading"
+    )
+    _write_detail(
+        {"collapsed_tier": {"platform": "tpu", "pull_ms": 900.0}},
+        here=str(tmp_path),
+    )
+    assert _read(tmp_path, "BENCH_DETAIL.tpu.json")["relay_health"]["trend"] == (
+        "degraded"
+    )
+
+
+def test_relay_health_ignores_carried_tier_samples(tmp_path):
+    """A carried tier's pull latency describes a PRIOR session's window —
+    it must not feed this run's trend verdict."""
+    _write_detail(
+        {
+            "collapsed_tier": {"platform": "tpu", "pull_ms": 1100.0},
+            "solve_tier": {"platform": "tpu"},
+        },
+        here=str(tmp_path),
+    )
+    # Next run: collapsed tier skipped, carried from the bank.
+    _write_detail({"solve_tier": {"platform": "tpu"}}, here=str(tmp_path))
+    banked = _read(tmp_path, "BENCH_DETAIL.tpu.json")
+    assert banked["collapsed_tier_carried"] == "prior tpu capture"
+    health = banked["relay_health"]
+    assert health["trend"] == "unknown"
+    assert "first_pull_ms" not in health
+    # The contamination markers still cover the carried tier's fields.
+    assert "collapsed_tier.pull_ms" in health["sync_contaminated"]
+
+
+def test_cpu_sidecar_has_no_relay_health(tmp_path):
+    _write_detail({"solve_tier": {"platform": "cpu"}}, here=str(tmp_path))
+    assert "relay_health" not in _read(tmp_path, "BENCH_DETAIL.cpu.json")
+    assert "relay_health" not in _read(tmp_path, "BENCH_DETAIL.json")
+
+
+def test_tpu_banked_block_contract(tmp_path):
+    """The cpu-fallback final line's tpu_banked block: rate + vs_baseline
+    from the CAPTURE's own session, captured_at, relay state, and a
+    provenance string that forbids scoring the fallback as hardware."""
+    assert _tpu_banked_block(here=str(tmp_path)) is None  # no capture
+    _write_detail(
+        {
+            "sqlite_baseline_rate": 40000,
+            "collapsed_tier": {"platform": "tpu", "rate": 4000000.0,
+                               "pull_ms": 900.0},
+        },
+        here=str(tmp_path),
+    )
+    block = _tpu_banked_block(here=str(tmp_path))
+    assert block["rate"] == 4000000.0
+    assert block["vs_baseline"] == 100.0  # banked rate / banked baseline
+    assert block["relay"] == "degraded"
+    assert "cpu fallback" in block["provenance"]
+    assert block["captured_at"].endswith("Z")
+    # A cpu-only sidecar can never masquerade as hardware evidence.
+    (tmp_path / "BENCH_DETAIL.tpu.json").write_text(
+        json.dumps({"collapsed_tier": {"platform": "cpu", "rate": 1.0}})
+    )
+    assert _tpu_banked_block(here=str(tmp_path)) is None
+
+
+def test_committed_tpu_capture_carries_relay_health():
+    """The repo's banked r5 capture is annotated: captured while the relay
+    was degrading, with every sync-contaminated field enumerated."""
+    committed = Path(__file__).resolve().parent.parent / "BENCH_DETAIL.tpu.json"
+    health = json.loads(committed.read_text())["relay_health"]
+    assert health["trend"] == "degrading"
+    assert "collapsed_tier.pull_ms" in health["sync_contaminated"]
+    block = _tpu_banked_block()
+    assert block is not None and block["relay"] == "degrading"

@@ -1,0 +1,204 @@
+"""Golden-wire conformance: the EXACT statements the Postgres and Redis
+backends emit for a standard op matrix, committed as golden files.
+
+The fakes (tests/fake_pg.py, tests/fake_redis.py) already make the real
+backend code paths execute in this environment; this module additionally
+pins what crosses the driver boundary — every SQL statement (with bound
+params) reaching the DBAPI cursor, every RESP2 command array reaching the
+server — byte for byte. A schema migration, a changed WHERE clause, a
+reordered pipeline, or a new roundtrip on a hot path shows up as a golden
+diff and has to be a conscious decision.
+
+Regenerate after an intentional wire change::
+
+    RIO_TPU_REGEN_GOLDEN=1 python -m pytest tests/test_golden_wire.py
+
+then review the golden diff like any other code change.
+"""
+
+from __future__ import annotations
+
+import difflib
+import os
+import pathlib
+
+import pytest
+
+from rio_tpu.cluster.storage import Member
+from rio_tpu.object_placement import ObjectId, ObjectPlacementItem
+from rio_tpu.utils.resp import RedisClient
+
+from .fake_redis import FakeRedisServer
+
+GOLDEN_DIR = pathlib.Path(__file__).parent / "golden"
+
+# Member.push / notify_failure stamp time.time() into stored values; the
+# matrix freezes it so the captured wire bytes are run-independent.
+FROZEN_TIME = 1700000000.0
+
+# Connection-handshake commands are pool-shape dependent (how many conns
+# the client opens, and when, is an implementation detail of the pool, not
+# of the backends under test) — they are filtered from the RESP capture.
+HANDSHAKE = {"PING", "SELECT", "AUTH", "FLUSHDB"}
+
+
+def _assert_golden(name: str, text: str) -> None:
+    path = GOLDEN_DIR / name
+    if os.environ.get("RIO_TPU_REGEN_GOLDEN"):
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(text)
+        return
+    assert path.exists(), (
+        f"missing golden file {path} — run with RIO_TPU_REGEN_GOLDEN=1 to create"
+    )
+    expected = path.read_text()
+    if text != expected:
+        diff = "\n".join(
+            difflib.unified_diff(
+                expected.splitlines(), text.splitlines(),
+                fromfile=f"golden/{name}", tofile="captured", lineterm="",
+            )
+        )
+        raise AssertionError(f"wire stream drifted from golden/{name}:\n{diff}")
+
+
+async def _membership_matrix(storage, mark) -> None:
+    mark("membership.prepare")
+    await storage.prepare()
+    mark("membership.push")
+    await storage.push(Member(ip="10.0.0.1", port=5000, active=True))
+    mark("membership.push_upsert")
+    await storage.push(Member(ip="10.0.0.1", port=5000, active=True))
+    mark("membership.members")
+    await storage.members()
+    mark("membership.active_members")
+    await storage.active_members()
+    mark("membership.is_active")
+    await storage.is_active("10.0.0.1:5000")
+    mark("membership.set_inactive")
+    await storage.set_inactive("10.0.0.1", 5000)
+    mark("membership.set_active")
+    await storage.set_active("10.0.0.1", 5000)
+    mark("membership.notify_failure")
+    await storage.notify_failure("10.0.0.1", 5000)
+    mark("membership.member_failures")
+    await storage.member_failures("10.0.0.1", 5000)
+    mark("membership.remove")
+    await storage.remove("10.0.0.1", 5000)
+
+
+async def _placement_matrix(p, mark) -> None:
+    oid = ObjectId("Svc", "g1")
+    mark("placement.prepare")
+    await p.prepare()
+    mark("placement.update")
+    await p.update(ObjectPlacementItem(oid, "h1:1"))
+    mark("placement.lookup")
+    await p.lookup(oid)
+    mark("placement.update_move")
+    await p.update(ObjectPlacementItem(oid, "h2:2"))
+    mark("placement.update_batch")
+    await p.update_batch(
+        [ObjectPlacementItem(ObjectId("Svc", f"b{i}"), "h3:3") for i in range(2)]
+    )
+    mark("placement.lookup_batch")
+    await p.lookup_batch([ObjectId("Svc", "b0"), ObjectId("Svc", "b1")])
+    mark("placement.items")
+    await p.items()
+    mark("placement.clean_server")
+    await p.clean_server("h3:3")
+    # Replication directory rows: epoch-preserving set, fenced CAS (one
+    # losing attempt, one winning), then removal.
+    mark("placement.set_standbys")
+    await p.set_standbys(oid, ["s1:1", "s2:2"])
+    mark("placement.standbys")
+    await p.standbys(oid)
+    mark("placement.promote_standby_lose")
+    await p.promote_standby(oid, "s1:1", 7)
+    mark("placement.promote_standby_win")
+    await p.promote_standby(oid, "s1:1", 0)
+    mark("placement.remove")
+    await p.remove(oid)
+
+
+@pytest.mark.asyncio
+async def test_postgres_wire_golden(monkeypatch):
+    from tests import fake_pg
+
+    fake_pg.install()
+    fake_pg.reset()
+    monkeypatch.setattr("rio_tpu.cluster.storage.sqlite.time.time",
+                        lambda: FROZEN_TIME)
+
+    log: list[tuple[str, ...]] = []
+    orig_execute = fake_pg.FakeCursor.execute
+
+    def spy(self, sql, params=()):
+        log.append(("sql", sql, repr(tuple(params or ()))))
+        return orig_execute(self, sql, params)
+
+    monkeypatch.setattr(fake_pg.FakeCursor, "execute", spy)
+
+    from rio_tpu.cluster.storage.postgres import PostgresMembershipStorage
+    from rio_tpu.object_placement.postgres import PostgresObjectPlacement
+
+    dsn = "postgresql://fake-pg/golden-wire"
+    await _membership_matrix(
+        PostgresMembershipStorage(dsn), lambda op: log.append(("op", op))
+    )
+    await _placement_matrix(
+        PostgresObjectPlacement(dsn), lambda op: log.append(("op", op))
+    )
+
+    lines: list[str] = []
+    for entry in log:
+        if entry[0] == "op":
+            lines.append(f"== {entry[1]}")
+        else:
+            _, sql, params = entry
+            lines.append(" ".join(sql.split()))
+            lines.append(f"-- params={params}")
+    _assert_golden("postgres_wire.txt", "\n".join(lines) + "\n")
+
+
+@pytest.mark.asyncio
+async def test_redis_wire_golden(monkeypatch):
+    monkeypatch.setattr("rio_tpu.cluster.storage.redis.time.time",
+                        lambda: FROZEN_TIME)
+
+    server = await FakeRedisServer().start()
+    log: list[tuple[str, ...]] = []
+    orig_dispatch = FakeRedisServer._dispatch
+
+    def spy(self, cmd):
+        name = cmd[0].decode().upper()
+        if name not in HANDSHAKE:
+            log.append(
+                ("cmd", " ".join(c.decode("utf-8", "backslashreplace")
+                                 for c in cmd))
+            )
+        return orig_dispatch(self, cmd)
+
+    monkeypatch.setattr(FakeRedisServer, "_dispatch", spy)
+    try:
+        from rio_tpu.cluster.storage.redis import RedisMembershipStorage
+        from rio_tpu.object_placement.redis import RedisObjectPlacement
+
+        client = RedisClient("127.0.0.1", server.port)
+        await _membership_matrix(
+            RedisMembershipStorage(client, key_prefix="g_mem"),
+            lambda op: log.append(("op", op)),
+        )
+        await _placement_matrix(
+            RedisObjectPlacement(client, key_prefix="g_place"),
+            lambda op: log.append(("op", op)),
+        )
+        client.close()
+    finally:
+        await server.stop()
+
+    lines = [
+        f"== {e[1]}" if e[0] == "op" else e[1]
+        for e in log
+    ]
+    _assert_golden("redis_wire.txt", "\n".join(lines) + "\n")

@@ -1,0 +1,382 @@
+"""Replicated actors: ship-on-ack, anti-affinity seats, epoch-fenced failover.
+
+The kill-primary chaos test is the acceptance bar for the subsystem: the
+primary's server dies mid-traffic with NO shutdown lifecycle, and the
+promoted standby serves every subsequent request with zero lost
+acknowledged writes (volatile state included — the part no state backend
+covers) and zero double-activations.
+"""
+
+import asyncio
+
+import pytest
+
+from rio_tpu import (
+    AdminCommand,
+    AppData,
+    LocalObjectPlacement,
+    LocalStorage,
+    Registry,
+    ServiceObject,
+    handler,
+    message,
+)
+from rio_tpu.commands import ServerInfo
+from rio_tpu.migration import ReplicaAppend
+from rio_tpu.registry import ObjectId
+from rio_tpu.replication import ReplicationConfig, ReplicationManager
+from rio_tpu.state import LocalState, StateProvider, managed_state
+
+from .server_utils import Cluster, run_integration_test
+
+# Module-level activation guards, reset by each test that uses them.
+ACTIVATIONS: dict[str, int] = {}  # id -> lifetime LOAD count
+ACTIVE: dict[str, str] = {}  # id -> address currently holding a live instance
+DOUBLE: list[str] = []  # ids that activated while already active somewhere
+
+
+def _reset_guards() -> None:
+    ACTIVATIONS.clear()
+    ACTIVE.clear()
+    DOUBLE.clear()
+
+
+@message
+class RAdd:
+    amount: int = 0
+
+
+@message
+class RGet:
+    pass
+
+
+@message
+class RTotals:
+    total: int = 0
+    hot: int = 0
+    address: str = ""
+
+
+@message
+class LedgerState:
+    total: int = 0
+
+
+class Ledger(ServiceObject):
+    """Replicated stateful actor: managed ``state.total`` + volatile ``hot``.
+
+    ``hot`` mirrors the acknowledged write count but lives only in memory;
+    after a primary death it can ONLY survive through the shipped replica —
+    a fresh (unreplicated) activation resets it to 0 and exposes the loss.
+    """
+
+    __replicated__ = True
+
+    state = managed_state(LedgerState)
+
+    def __init__(self):
+        self.hot = 0
+
+    def __migrate_state__(self):
+        return {"hot": self.hot}
+
+    def __restore_state__(self, value):
+        self.hot = int(value["hot"])
+
+    async def after_load(self, ctx: AppData) -> None:
+        ACTIVATIONS[self.id] = ACTIVATIONS.get(self.id, 0) + 1
+        addr = ctx.get(ServerInfo).address
+        if self.id in ACTIVE:
+            DOUBLE.append(self.id)
+        ACTIVE[self.id] = addr
+
+    async def before_shutdown(self, ctx: AppData) -> None:
+        ACTIVE.pop(self.id, None)
+
+    @handler
+    async def add(self, msg: RAdd, ctx: AppData) -> RTotals:
+        self.state.total += msg.amount
+        self.hot += msg.amount
+        await self.save_state(ctx)
+        return RTotals(
+            total=self.state.total, hot=self.hot, address=ctx.get(ServerInfo).address
+        )
+
+    @handler
+    async def get(self, msg: RGet, ctx: AppData) -> RTotals:
+        return RTotals(
+            total=self.state.total, hot=self.hot, address=ctx.get(ServerInfo).address
+        )
+
+
+def build_registry() -> Registry:
+    return Registry().add_type(Ledger)
+
+
+async def _wait_dead(cluster: Cluster, address: str, timeout: float = 10.0) -> None:
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if not await cluster.members.is_active(address):
+            return
+        await asyncio.sleep(0.02)
+    raise TimeoutError(f"{address} never went inactive")
+
+
+def _retire_hard_killed(address: str) -> None:
+    # server_exit is a HARD exit (no shutdown lifecycle): a real process
+    # death takes its activations with it, but the in-process guard can't
+    # see that — retire them by hand so re-seats aren't misread as doubles.
+    for k, addr in list(ACTIVE.items()):
+        if addr == address:
+            ACTIVE.pop(k)
+
+
+# ---------------------------------------------------------------------------
+# Chaos: kill the primary mid-traffic → promoted standby serves with zero
+# lost acknowledged writes and zero double-activations
+# ---------------------------------------------------------------------------
+
+
+def test_kill_primary_promoted_standby_keeps_every_acked_write():
+    _reset_guards()
+    state = LocalState()
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        try:
+            acked = 0
+            out = await client.send(Ledger, "L1", RAdd(amount=1), returns=RTotals)
+            acked += 1
+            primary_addr = out.address
+            for _ in range(9):
+                out = await client.send(Ledger, "L1", RAdd(amount=1), returns=RTotals)
+                acked += 1
+
+            # Ship-on-ack ran before every ack: the standby row exists, the
+            # seat is off-primary (anti-affinity), and the standby node
+            # already holds the latest delta.
+            held, epoch = await cluster.placement.standbys(ObjectId("Ledger", "L1"))
+            assert held and primary_addr not in held
+            standby_srv = next(
+                s for s in cluster.servers if s.local_address == held[0]
+            )
+            assert standby_srv.replication_manager.stats.appends >= 1
+            primary = next(
+                s for s in cluster.servers if s.local_address == primary_addr
+            )
+            assert primary.replication_manager.stats.shipped >= 1
+
+            # Primary dies hard, mid-conversation.
+            primary.admin_sender().send(AdminCommand.server_exit())
+            await _wait_dead(cluster, primary_addr)
+            _retire_hard_killed(primary_addr)
+
+            # Resumed traffic fails over on first touch: a survivor's
+            # dead-owner branch promotes the standby through the epoch CAS,
+            # the client's redirect machinery lands on it, and its first
+            # activation restores the shipped replica.
+            for _ in range(5):
+                out = await client.send(Ledger, "L1", RAdd(amount=1), returns=RTotals)
+                acked += 1
+            assert out.address == held[0]
+
+            out = await client.send(Ledger, "L1", RGet(), returns=RTotals)
+            assert out.address == held[0]
+            # THE guarantee: no acknowledged write lost — volatile included.
+            assert (out.total, out.hot) == (acked, acked)
+            assert DOUBLE == []
+            assert ACTIVATIONS["L1"] == 2  # initial + exactly one failover
+
+            promotions = sum(
+                s.replication_manager.stats.promotions
+                for s in cluster.servers
+                if s.replication_manager is not None
+            )
+            assert promotions == 1
+            restores = standby_srv.replication_manager.stats.replica_restores
+            assert restores == 1
+            # The epoch fence moved exactly once, through the CAS.
+            _, epoch2 = await cluster.placement.standbys(ObjectId("Ledger", "L1"))
+            assert epoch2 == epoch + 1
+        finally:
+            client.close()
+
+    async def wrapped(cluster: Cluster):
+        for s in cluster.servers:
+            s.app_data.set(state, as_type=StateProvider)
+        await body(cluster)
+
+    asyncio.run(
+        run_integration_test(
+            wrapped,
+            registry_builder=build_registry,
+            num_servers=3,
+            server_kwargs={
+                "replication_config": ReplicationConfig(
+                    k=1, anti_entropy_interval=0.3, seat_ttl=0.3
+                )
+            },
+        )
+    )
+
+
+# ---------------------------------------------------------------------------
+# Epoch fence: the standby-side append filter
+# ---------------------------------------------------------------------------
+
+
+def test_apply_append_fences_stale_epochs_and_local_primaries():
+    async def run():
+        registry = build_registry()
+        mgr = ReplicationManager(
+            address="127.0.0.1:1",
+            registry=registry,
+            placement=LocalObjectPlacement(),
+            members_storage=LocalStorage(),
+            app_data=AppData(),
+        )
+
+        def append(oid, epoch, seq, payload=b"p"):
+            return mgr.apply_append(
+                ReplicaAppend(
+                    type_name="Ledger", object_id=oid, epoch=epoch, seq=seq,
+                    payload=payload,
+                )
+            )
+
+        ack = append("x", epoch=3, seq=1, payload=b"a")
+        assert ack.ok and mgr.stats.appends == 1
+
+        # A deposed primary (older epoch) is fenced off — and told the
+        # newer epoch so it re-reads the directory.
+        stale = append("x", epoch=2, seq=9)
+        assert not stale.ok and stale.epoch == 3
+        assert mgr.stats.append_nacks == 1
+        assert mgr._replica_store[("Ledger", "x")][0] == b"a"
+
+        # Same-epoch replays ack idempotently without regressing the store.
+        replay = append("x", epoch=3, seq=1, payload=b"old")
+        assert replay.ok
+        assert mgr._replica_store[("Ledger", "x")][0] == b"a"
+
+        # The post-promotion primary's newer epoch supersedes.
+        newer = append("x", epoch=4, seq=1, payload=b"b")
+        assert newer.ok
+        assert mgr._replica_store[("Ledger", "x")][0] == b"b"
+
+        # A node actively SERVING the object nacks appends outright: after
+        # failover, late deltas from the old primary can never overwrite
+        # the promoted activation.
+        registry.insert("Ledger", "y", registry.new_from_type("Ledger", "y"))
+        here = append("y", epoch=9, seq=1)
+        assert not here.ok and "primary" in here.detail
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# Soak (nightly slow lane): sustained traffic over many replicated objects
+# with a mid-run primary kill; anti-entropy repairs the seats afterwards
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_replication_soak_survives_kill_and_repairs_seats():
+    _reset_guards()
+    state = LocalState()
+    n_objects = 8
+    keys = [f"s{i}" for i in range(n_objects)]
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        try:
+            acked = dict.fromkeys(keys, 0)
+            owners: dict[str, str] = {}
+            for k in keys:
+                out = await client.send(Ledger, k, RAdd(amount=1), returns=RTotals)
+                acked[k] += 1
+                owners[k] = out.address
+
+            async def pump(rounds: int) -> None:
+                for _ in range(rounds):
+                    for k in keys:
+                        out = await client.send(
+                            Ledger, k, RAdd(amount=1), returns=RTotals
+                        )
+                        acked[k] += 1
+                    await asyncio.sleep(0.01)
+
+            await pump(20)
+
+            # Kill whichever node owns the most objects.
+            counts: dict[str, int] = {}
+            for k in keys:
+                counts[owners[k]] = counts.get(owners[k], 0) + 1
+            victim_addr = max(counts, key=lambda a: counts[a])
+            victim = next(
+                s for s in cluster.servers if s.local_address == victim_addr
+            )
+            victim.admin_sender().send(AdminCommand.server_exit())
+            await _wait_dead(cluster, victim_addr)
+            _retire_hard_killed(victim_addr)
+
+            await pump(20)
+
+            survivors = {
+                s.local_address
+                for s in cluster.servers
+                if s.local_address != victim_addr
+            }
+            for k in keys:
+                out = await client.send(Ledger, k, RGet(), returns=RTotals)
+                assert out.address in survivors
+                # Zero lost acknowledged writes across the whole population.
+                assert (out.total, out.hot) == (acked[k], acked[k]), k
+            assert DOUBLE == []
+
+            # Give anti-entropy a few rounds, then require every object's
+            # standby set to be live, off-primary, and non-empty again —
+            # seats that pointed at the victim were repaired.
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while True:
+                healthy = 0
+                for k in keys:
+                    oid = ObjectId("Ledger", k)
+                    held, _ = await cluster.placement.standbys(oid)
+                    primary = await cluster.placement.lookup(oid)
+                    if (
+                        held
+                        and victim_addr not in held
+                        and primary not in held
+                        and all(h in survivors for h in held)
+                    ):
+                        healthy += 1
+                if healthy == n_objects:
+                    break
+                if asyncio.get_event_loop().time() > deadline:
+                    raise AssertionError(
+                        f"only {healthy}/{n_objects} standby sets repaired"
+                    )
+                await asyncio.sleep(0.1)
+        finally:
+            client.close()
+
+    async def wrapped(cluster: Cluster):
+        for s in cluster.servers:
+            s.app_data.set(state, as_type=StateProvider)
+        await body(cluster)
+
+    asyncio.run(
+        run_integration_test(
+            wrapped,
+            registry_builder=build_registry,
+            num_servers=3,
+            timeout=60.0,
+            server_kwargs={
+                "replication_config": ReplicationConfig(
+                    k=1, anti_entropy_interval=0.2, seat_ttl=0.2
+                )
+            },
+        )
+    )

@@ -116,3 +116,116 @@ def test_sentinel_spill_guard_randomized():
         assert (out[spilled] == int(np.argmax(cap))).all(), case
         untouched = ~spilled
         assert (out[untouched] == local[untouched]).all(), case
+
+
+# ---------------------------------------------------------------------------
+# K-seat anti-affinity standby placement (rio_tpu/replication)
+# ---------------------------------------------------------------------------
+
+
+def test_multi_seat_plan_randomized_anti_affinity_contract():
+    """The replication acceptance bar: across random shapes, dead nodes,
+    load skew, and K, a filled seat NEVER lands on the primary or on an
+    earlier seat of the same object, never on a dead/zero-capacity node,
+    and every seat that is feasible (enough live allowed nodes) is filled.
+    """
+    from rio_tpu.object_placement.jax_placement import multi_seat_plan
+
+    rng = np.random.RandomState(11)
+    for case in range(25):
+        m = int(rng.randint(3, 11))
+        n = int(rng.randint(5, 200))
+        k = int(rng.randint(1, 4))
+        alive = (rng.rand(m) > 0.25).astype(np.float32)
+        if alive.sum() == 0:
+            alive[rng.randint(m)] = 1.0
+        cap = rng.uniform(0.5, 4.0, size=m).astype(np.float32)
+        cap[rng.rand(m) < 0.15] = 0.0  # schedulable = alive AND cap > 0
+        load = rng.uniform(0.0, 50.0, size=m).astype(np.float32)
+        schedulable = (alive > 0) & (cap > 0)
+        # Primaries seated anywhere, including (rarely) unseated rows (-1).
+        primary = rng.randint(0, m, size=n).astype(np.int64)
+        primary[rng.rand(n) < 0.05] = -1
+
+        seats = multi_seat_plan(primary, k, load, cap, alive)
+        assert seats.shape == (n, k)
+        for i in range(n):
+            filled = [int(s) for s in seats[i] if s >= 0]
+            # Hard anti-affinity: no seat on the primary, seats distinct.
+            assert primary[i] not in filled, (case, i)
+            assert len(filled) == len(set(filled)), (case, i)
+            # Seats only on schedulable nodes.
+            for s in filled:
+                assert schedulable[s], (case, i, s)
+            # Feasibility: seat r is fillable iff the schedulable pool
+            # minus the primary minus earlier seats is non-empty.
+            pool = int(schedulable.sum()) - (
+                1 if 0 <= primary[i] < m and schedulable[primary[i]] else 0
+            )
+            for r in range(k):
+                if pool - r >= 1:
+                    assert seats[i, r] >= 0, (case, i, r, pool)
+                else:
+                    assert seats[i, r] == -1, (case, i, r, pool)
+
+
+def test_multi_seat_plan_degrades_not_violates():
+    """Two schedulable nodes, every primary on node 0, k=2: seat 0 must be
+    node 1 for every object and seat 1 must come back -1 — replication
+    degrades rather than ever co-locating."""
+    from rio_tpu.object_placement.jax_placement import multi_seat_plan
+
+    n = 64
+    seats = multi_seat_plan(
+        np.zeros(n, np.int64),
+        2,
+        np.zeros(2, np.float32),
+        np.ones(2, np.float32),
+        np.ones(2, np.float32),
+    )
+    assert (seats[:, 0] == 1).all()
+    assert (seats[:, 1] == -1).all()
+
+
+def test_multi_seat_plan_balances_standby_load():
+    """Uniform symmetric cluster: standby seats spread across nodes instead
+    of piling onto one (the solver, not a fixed fallback, places them)."""
+    from rio_tpu.object_placement.jax_placement import multi_seat_plan
+
+    rng = np.random.RandomState(3)
+    m, n = 8, 800
+    primary = rng.randint(0, m, size=n).astype(np.int64)
+    seats = multi_seat_plan(
+        primary,
+        1,
+        np.zeros(m, np.float32),
+        np.ones(m, np.float32),
+        np.ones(m, np.float32),
+    )
+    assert (seats[:, 0] >= 0).all()
+    counts = np.bincount(seats[:, 0], minlength=m)
+    fair = n / m
+    assert counts.max() <= 2.5 * fair, counts
+    assert counts.min() >= fair / 4, counts
+
+
+def test_multi_seat_plan_seats_track_capacity_marginal():
+    """The capacity marginal — not the cost — governs aggregate seat counts:
+    a node with 4x the capacity absorbs ~4x the standby seats. (Load enters
+    the fill-ratio COST, which steers row->column matching; column totals
+    are pinned by the Sinkhorn capacity marginal.)"""
+    from rio_tpu.object_placement.jax_placement import multi_seat_plan
+
+    rng = np.random.RandomState(5)
+    m, n = 6, 600
+    cap = np.ones(m, np.float32)
+    cap[0] = 4.0
+    primary = rng.randint(1, m, size=n).astype(np.int64)  # node 0 never primary
+    seats = multi_seat_plan(
+        primary, 1, np.zeros(m, np.float32), cap, np.ones(m, np.float32)
+    )
+    counts = np.bincount(seats[:, 0], minlength=m)
+    expect0 = n * 4.0 / 9.0
+    assert abs(counts[0] - expect0) <= 0.15 * expect0, counts
+    small = counts[1:]
+    assert abs(small.max() - small.min()) <= 0.3 * small.mean(), counts
