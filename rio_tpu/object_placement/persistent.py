@@ -252,11 +252,19 @@ class PersistentJaxObjectPlacement(JaxObjectPlacement):
         if new_epoch is None:
             return None
         key = str(object_id)
+        # Cold-restart mirror miss: rebuilding the row from ([], 0) would
+        # flush an EMPTY set over the surviving standbys' durable row with
+        # k>=2, silently dropping seats until anti-entropy re-places them.
+        # The post-CAS backing row is authoritative (it already excludes
+        # the promoted address).
+        survivors: list[str] | None = None
+        if key not in self._standby_rows:
+            survivors, _ = await self._backing.standbys(object_id)
         async with self._lock:
-            held, _ = self._standby_rows.get(key, ([], 0))
-            self._set_standby_row(
-                key, [a for a in held if a != address], new_epoch
-            )
+            row = self._standby_rows.get(key)
+            if row is not None:
+                survivors = [a for a in row[0] if a != address]
+            self._set_standby_row(key, survivors or [], new_epoch)
             self._set_placement(key, self._node_index(address))
             self._epoch += 1
         return new_epoch

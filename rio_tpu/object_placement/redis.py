@@ -8,9 +8,16 @@ dead node without scanning the keyspace.
 
 from __future__ import annotations
 
+from typing import Callable
+
 from ..registry import ObjectId
-from ..utils.resp import RedisClient
+from ..utils.resp import RedisClient, RespError
 from . import ObjectPlacement, ObjectPlacementItem
+
+# Optimistic-lock retries before a standby CAS gives up. Contention on one
+# object's replica row is a handful of promoters post-death, not a hot path;
+# hitting the ceiling means the row is being rewritten pathologically fast.
+_CAS_ATTEMPTS = 64
 
 
 class RedisObjectPlacement(ObjectPlacement):
@@ -91,25 +98,63 @@ class RedisObjectPlacement(ObjectPlacement):
             cmds.insert(0, ("SREM", self._server_key(old.decode()), key))
         await self.client.execute_pipeline(cmds)
 
-    async def _standby_row(self, key: str) -> tuple[list[str], int]:
-        raw = await self.client.execute("GET", self._standby_key(key))
+    @staticmethod
+    def _parse_standby(raw: object) -> tuple[list[str], int]:
+        # Value is ``"{epoch}|{addr,...}"``.
         if not isinstance(raw, bytes):
             return [], 0
         epoch_s, _, held = raw.decode().partition("|")
         return [a for a in held.split(",") if a], int(epoch_s)
 
+    async def _standby_row(self, key: str) -> tuple[list[str], int]:
+        return self._parse_standby(
+            await self.client.execute("GET", self._standby_key(key))
+        )
+
+    async def _standby_cas(
+        self,
+        key: str,
+        decide: Callable[[list[str], int], tuple[list[tuple] | None, int | None]],
+    ) -> int | None:
+        """Atomic read-modify-write on the standby row via WATCH/MULTI/EXEC.
+
+        ``decide(held, epoch)`` returns ``(write_cmds, result)``;
+        ``write_cmds is None`` aborts without touching the row. A concurrent
+        writer between WATCH and EXEC voids the transaction (null EXEC
+        reply) and the loop re-reads — the epoch fence can never be written
+        from a stale read, unlike the plain read-then-SET this replaces
+        (two racing promoters could both bump from the same epoch).
+        """
+        skey = self._standby_key(key)
+        for _ in range(_CAS_ATTEMPTS):
+            async with self.client.transaction() as txn:
+                await txn.execute("WATCH", skey)
+                held, epoch = self._parse_standby(await txn.execute("GET", skey))
+                cmds, result = decide(held, epoch)
+                if cmds is None:
+                    await txn.execute("UNWATCH")
+                    return result
+                await txn.execute("MULTI")
+                for c in cmds:
+                    await txn.execute(*c)
+                if await txn.execute("EXEC") is not None:
+                    return result
+        raise RespError(f"standby CAS on {key!r} lost {_CAS_ATTEMPTS} races")
+
     async def set_standbys(self, object_id: ObjectId, addresses: list[str]) -> int:
-        # Value is ``"{epoch}|{addr,...}"``; epoch only moves in
-        # promote_standby, so a plain SET preserving the read epoch is the
-        # same check-then-act exposure class clean_server documents.
         key = str(object_id)
-        _, epoch = await self._standby_row(key)
-        if addresses or epoch:
-            await self.client.execute(
-                "SET", self._standby_key(key), f"{epoch}|{','.join(addresses)}"
-            )
-        else:
-            await self.client.execute("DEL", self._standby_key(key))
+        skey = self._standby_key(key)
+
+        def decide(held: list[str], epoch: int) -> tuple[list[tuple], int]:
+            # Epoch only moves in promote_standby; writing under WATCH means
+            # a promotion racing this replacement can't have its bump rolled
+            # back to the pre-promotion value.
+            if addresses or epoch:
+                return [("SET", skey, f"{epoch}|{','.join(addresses)}")], epoch
+            return [("DEL", skey)], epoch
+
+        epoch = await self._standby_cas(key, decide)
+        assert epoch is not None
         return epoch
 
     async def standbys(self, object_id: ObjectId) -> tuple[list[str], int]:
@@ -119,15 +164,21 @@ class RedisObjectPlacement(ObjectPlacement):
         self, object_id: ObjectId, address: str, expected_epoch: int
     ) -> int | None:
         key = str(object_id)
-        held, epoch = await self._standby_row(key)
-        if epoch != expected_epoch or address not in held:
+        skey = self._standby_key(key)
+
+        def decide(
+            held: list[str], epoch: int
+        ) -> tuple[list[tuple] | None, int | None]:
+            if epoch != expected_epoch or address not in held:
+                return None, None
+            remaining = ",".join(a for a in held if a != address)
+            return [("SET", skey, f"{epoch + 1}|{remaining}")], epoch + 1
+
+        new_epoch = await self._standby_cas(key, decide)
+        if new_epoch is None:
             return None
-        remaining = ",".join(a for a in held if a != address)
-        await self.client.execute(
-            "SET", self._standby_key(key), f"{epoch + 1}|{remaining}"
-        )
         await self.update(ObjectPlacementItem(object_id, address))
-        return epoch + 1
+        return new_epoch
 
     async def lookup_batch(self, object_ids: list[ObjectId]) -> list[str | None]:
         raws = await self.client.execute_pipeline(

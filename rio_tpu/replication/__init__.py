@@ -38,7 +38,11 @@ actors that opt in (``__replicated__ = True`` on the class):
    slot as migration's volatile restore). Appends fenced with an older
    epoch — a deposed primary that has not yet noticed — are nacked by the
    standbys, and a node actively serving an object nacks appends for it
-   outright.
+   outright. The deposed side is fenced twice: its seat cache expires
+   within ``seat_ttl`` and the refresh (``_seats_for``) finds the
+   directory naming another node as primary, so it surrenders the key —
+   dropping its ship state — instead of re-adopting the post-promotion
+   epoch.
 
 Everything rides existing plumbing: the inbox actor, the ``Registry.peek``
 consistent snapshot, the ``InstallState``-style codec payloads, the
@@ -52,6 +56,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+import zlib
 from dataclasses import dataclass
 from typing import Any
 
@@ -93,6 +98,7 @@ class ReplicationStats:
     ship_failures: int = 0  # per-standby send failures / nacks
     unreplicated: int = 0  # ships with no live standby seat available
     stale_epoch_nacks: int = 0  # this primary's appends fenced off
+    deposed: int = 0  # ships aborted: the directory names another primary
     appends: int = 0  # deltas accepted while standing by
     append_nacks: int = 0  # deltas rejected (stale epoch / primary here)
     replica_restores: int = 0  # activations warmed from a shipped replica
@@ -183,7 +189,10 @@ class ReplicationManager:
     async def _ship(
         self, object_id: ObjectId, key: tuple[str, str], payload: bytes
     ) -> None:
-        held, epoch = await self._seats_for(object_id, key)
+        seats = await self._seats_for(object_id, key)
+        if seats is None:
+            return  # deposed: _seats_for dropped our primary-role state
+        held, epoch = seats
         if not held:
             self.stats.unreplicated += 1
             self._dirty.add(key)
@@ -254,19 +263,50 @@ class ReplicationManager:
 
     async def _seats_for(
         self, object_id: ObjectId, key: tuple[str, str]
-    ) -> tuple[list[str], int]:
+    ) -> tuple[list[str], int] | None:
+        """Standby seats for a key this node ships as primary, or ``None``
+        when the directory says this node is NOT the primary anymore.
+
+        The epoch nack fences a deposed primary only while its seat cache
+        holds the pre-promotion epoch; a re-read after ``seat_ttl`` would
+        otherwise adopt the CURRENT epoch and let stale ships through. So
+        every cache refresh first checks the primary row: another node's
+        address there means we were deposed (declared dead and failed over
+        while still running) — surrender the key instead of shipping.
+        """
         cached = self._seats.get(key)
         now = time.monotonic()
         if cached is not None and now - cached[2] <= self.config.seat_ttl:
             return cached[0], cached[1]
+        primary = await self.placement.lookup(object_id)
+        if primary is not None and primary != self.address:
+            self._drop_primary_role(key)
+            self.stats.deposed += 1
+            log.warning(
+                "deposed as primary for %s (directory names %s); ship aborted",
+                object_id, primary,
+            )
+            return None
         if self.config.ensure_seats:
-            held, epoch = await self.repair_seats(object_id)
+            held, epoch = await self.repair_seats(object_id, primary=primary)
         else:
             held, epoch = await self.placement.standbys(object_id)
         self._seats[key] = (held, epoch, now)
         return held, epoch
 
-    async def repair_seats(self, object_id: ObjectId) -> tuple[list[str], int]:
+    def _drop_primary_role(self, key: tuple[str, str]) -> None:
+        """Surrender primary-role state for a key the directory re-seated:
+        no more ships (the promoted node's are authoritative), no retry via
+        the dirty set, no dedup/seq state to confuse a later re-promotion
+        back to this node."""
+        self._last_shipped.pop(key, None)
+        self._seq.pop(key, None)
+        self._dirty.discard(key)
+        self._seats.pop(key, None)
+
+    async def repair_seats(
+        self, object_id: ObjectId, *, primary: str | None = None
+    ) -> tuple[list[str], int]:
         """Bring the object's standby set to ``k`` LIVE seats; ``(held, epoch)``.
 
         Dead standbys are dropped, missing seats topped up. Solver
@@ -281,7 +321,13 @@ class ReplicationManager:
         k = max(1, self.config.k)
         if len(live) >= k and len(live) == len(held):
             return held, epoch
-        primary = await self.placement.lookup(object_id)
+        if primary is None:
+            primary = await self.placement.lookup(object_id)
+        if primary is not None and primary != self.address:
+            # Seat repair is a PRIMARY-role action. A node the directory no
+            # longer names (falsely declared dead, then failed over) must
+            # not rewrite the standby set out from under the real primary.
+            return held, epoch
         exclude = {primary, *live} - {None}
         assign = getattr(self.placement, "assign_standbys", None)
         fresh: list[str] = []
@@ -297,7 +343,9 @@ class ReplicationManager:
                 if m.address not in exclude
             )
             if members:
-                start = hash(str(object_id)) % len(members)
+                # crc32, not hash(): per-process hash randomization would
+                # re-pick seats on every restart and churn the standby set.
+                start = zlib.crc32(str(object_id).encode()) % len(members)
                 fresh = [
                     members[(start + i) % len(members)]
                     for i in range(min(k - len(live), len(members)))
@@ -348,13 +396,16 @@ class ReplicationManager:
         volatile restore, and only when that found no stash (a coordinated
         handoff is newer than any replica)."""
         key = (type_id(type(obj)), obj.id)
-        entry = self._replica_store.pop(key, None)
-        if entry is None:
+        if key not in self._replica_store:
             return False
-        payload, _, seq = entry
         restore = getattr(obj, "__restore_state__", None)
         if restore is None:
+            # Leave the entry in place: popping before this check would
+            # discard the shipped payload permanently when the hook is
+            # missing (or a first activation races in before the class
+            # gains it) instead of keeping it for a later activation.
             return False
+        payload, _, seq = self._replica_store.pop(key)
         restore(codec.deserialize(payload, Any))
         # This node is primary for the key now: continue the sequence so
         # our own ships are never mistaken for replays downstream.

@@ -172,7 +172,12 @@ class Service:
                 # clean_server sweep below. Everything else falls through to
                 # the lazy self-assign, as before.
                 promoted = None
-                if self._replication is not None:
+                if self._replication is not None and self.registry.is_replicated(
+                    object_id.type_name
+                ):
+                    # Unreplicated types skip the promotion probe: after a
+                    # node death it costs a directory standbys() read per
+                    # first-touch lookup on everything the dead node held.
                     promoted = await self._replication.maybe_promote(object_id, addr)
                 # Bulk-unassign everything the dead node held
                 # (reference service.rs:227-238).

@@ -17,7 +17,7 @@ from __future__ import annotations
 import asyncio
 from typing import Any
 
-__all__ = ["RespError", "RedisClient", "encode_command"]
+__all__ = ["RespError", "RedisClient", "Transaction", "encode_command"]
 
 
 class RespError(Exception):
@@ -85,6 +85,47 @@ class _Conn:
             self.writer.close()
         except Exception:
             pass
+
+
+class Transaction:
+    """One pooled connection checked out for a WATCH/MULTI/EXEC sequence.
+
+    Redis transaction state (watched keys, the MULTI queue) lives on the
+    *connection*, so an optimistic-locking CAS must run its whole
+    WATCH → GET → MULTI → ... → EXEC conversation on a single socket —
+    the pool's per-call checkout would scatter it across connections.
+
+    Contract: the caller ends the sequence with ``EXEC`` or ``UNWATCH``
+    before leaving the ``async with`` block; exiting on an exception closes
+    the connection instead of pooling it, so server-side session state can
+    never leak into the next checkout.
+    """
+
+    def __init__(self, client: "RedisClient") -> None:
+        self._client = client
+        self._conn: _Conn | None = None
+        self._broken = False
+
+    async def __aenter__(self) -> "Transaction":
+        self._conn = await self._client._acquire()
+        return self
+
+    async def execute(self, *args: Any) -> Any:
+        assert self._conn is not None, "Transaction used outside 'async with'"
+        try:
+            return await self._conn.execute(*args)
+        except RespError:
+            raise  # protocol-level error; socket still healthy
+        except BaseException:
+            self._broken = True
+            raise
+
+    async def __aexit__(self, exc_type, exc, tb) -> None:
+        if self._conn is not None:
+            self._client._release(
+                self._conn, broken=self._broken or exc_type is not None
+            )
+            self._conn = None
 
 
 class RedisClient:
@@ -194,6 +235,12 @@ class RedisClient:
             raise
         self._release(conn)
         return replies
+
+    def transaction(self) -> Transaction:
+        """Check out one connection for a WATCH/MULTI/EXEC sequence."""
+        if self._closed:
+            raise ConnectionError("RedisClient is closed")
+        return Transaction(self)
 
     async def ping(self) -> bool:
         return await self.execute("PING") == "PONG"

@@ -9,7 +9,11 @@ Redis backends under test use their production code path end to end
 
 Supported commands: PING SELECT SET (incl. NX) GET DEL EXISTS INCR HSET
 HGET HGETALL HDEL RPUSH LTRIM LRANGE SADD SREM SMEMBERS ZADD ZREM ZCARD
-ZRANGEBYSCORE (incl. LIMIT) FLUSHDB KEYS.
+ZRANGEBYSCORE (incl. LIMIT) FLUSHDB KEYS, plus the optimistic-locking
+transaction surface WATCH UNWATCH MULTI EXEC DISCARD. Watch semantics are
+version-based: every write command bumps a per-key version regardless of
+whether it changed the value (slightly stricter than real Redis's
+modification check — over-invalidating only costs the CAS caller a retry).
 """
 
 from __future__ import annotations
@@ -41,9 +45,25 @@ def _enc(v: Any) -> bytes:
     raise TypeError(type(v))
 
 
+class _Session:
+    """Per-connection transaction state (lives and dies with the socket)."""
+
+    def __init__(self) -> None:
+        self.watch: dict[bytes, int] = {}  # key -> version at WATCH time
+        self.multi: list[list[bytes]] | None = None  # queued cmds, if in MULTI
+
+
+# Commands whose first argument is a written key; DEL/FLUSHDB handled apart.
+_WRITE_CMDS = {
+    "SET", "INCR", "HSET", "HDEL", "RPUSH", "LTRIM",
+    "SADD", "SREM", "ZADD", "ZREM",
+}
+
+
 class FakeRedisServer:
     def __init__(self) -> None:
         self.data: dict[bytes, Any] = {}
+        self._ver: dict[bytes, int] = {}  # key -> write version (for WATCH)
         self._server: asyncio.AbstractServer | None = None
         self._writers: set[asyncio.StreamWriter] = set()
         self.port = 0
@@ -64,6 +84,7 @@ class FakeRedisServer:
 
     async def _serve(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         self._writers.add(writer)
+        session = _Session()
         try:
             while True:
                 try:
@@ -73,7 +94,7 @@ class FakeRedisServer:
                 if not cmd:
                     break
                 try:
-                    reply = self._dispatch(cmd)
+                    reply = self._handle(session, cmd)
                 except Exception as e:  # noqa: BLE001 — surfaced as -ERR
                     writer.write(b"-ERR %s\r\n" % str(e).encode())
                 else:
@@ -83,7 +104,65 @@ class FakeRedisServer:
             self._writers.discard(writer)
             writer.close()
 
+    def _handle(self, session: _Session, cmd: list[bytes]) -> bytes:
+        """Wire entry point: transaction control + MULTI queueing, then
+        :meth:`_dispatch` for everything else. One call per command received
+        (queued commands execute inside their EXEC)."""
+        name = cmd[0].decode().upper()
+        if session.multi is not None and name not in ("EXEC", "DISCARD", "MULTI", "WATCH"):
+            session.multi.append(cmd)
+            return b"+QUEUED\r\n"
+        if name == "WATCH":
+            if session.multi is not None:
+                raise ValueError("WATCH inside MULTI is not allowed")
+            for k in cmd[1:]:
+                session.watch[k] = self._ver.get(k, 0)
+            return _enc("OK")
+        if name == "UNWATCH":
+            session.watch.clear()
+            return _enc("OK")
+        if name == "MULTI":
+            if session.multi is not None:
+                raise ValueError("MULTI calls can not be nested")
+            session.multi = []
+            return _enc("OK")
+        if name == "DISCARD":
+            if session.multi is None:
+                raise ValueError("DISCARD without MULTI")
+            session.multi = None
+            session.watch.clear()
+            return _enc("OK")
+        if name == "EXEC":
+            if session.multi is None:
+                raise ValueError("EXEC without MULTI")
+            queued, session.multi = session.multi, None
+            watched, session.watch = session.watch, {}
+            if any(self._ver.get(k, 0) != v for k, v in watched.items()):
+                return b"*-1\r\n"  # a watched key moved: abort, null reply
+            parts = []
+            for q in queued:
+                try:
+                    parts.append(self._dispatch(q))
+                except Exception as e:  # noqa: BLE001 — -ERR in place
+                    parts.append(b"-ERR %s\r\n" % str(e).encode())
+            return b"*%d\r\n" % len(queued) + b"".join(parts)
+        return self._dispatch(cmd)
+
+    def _touch(self, *keys: bytes) -> None:
+        for k in keys:
+            self._ver[k] = self._ver.get(k, 0) + 1
+
     def _dispatch(self, cmd: list[bytes]) -> bytes:
+        name = cmd[0].decode().upper()
+        if name in _WRITE_CMDS:
+            self._touch(cmd[1])
+        elif name == "DEL":
+            self._touch(*cmd[1:])
+        elif name == "FLUSHDB":
+            self._touch(*self._ver)
+        return self._run_command(cmd)
+
+    def _run_command(self, cmd: list[bytes]) -> bytes:
         name = cmd[0].decode().upper()
         args = cmd[1:]
         d = self.data

@@ -2,6 +2,7 @@
 backend (reference ``tests/cluster_storage_backend.rs``,
 ``tests/object_placement_backend.rs``, ``tests/state.rs``)."""
 
+import asyncio
 import os
 
 import pytest
@@ -244,6 +245,89 @@ async def test_redis_backends():
         for _ in range(150):
             await mem.notify_failure("10.0.0.9", 9000)
         assert len(await mem.member_failures("10.0.0.9", 9000)) == 100
+
+        client.close()
+    finally:
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_redis_promote_standby_cas_is_atomic():
+    """The split-brain race the replica fence exists for: two promoters read
+    the same epoch; the one whose write lands second must have its EXEC
+    voided by the WATCH — not re-bump the epoch over the winner's row with a
+    different primary (the old read-then-SET allowed exactly that)."""
+    server = await FakeRedisServer().start()
+    try:
+        client = RedisClient("127.0.0.1", server.port)
+        p = RedisObjectPlacement(client, key_prefix="t_cas")
+        oid = ObjectId("Svc", "race")
+        await p.set_standbys(oid, ["s1:1", "s2:2"])
+
+        # Promoter A stalls between its read and its write; drive its
+        # transaction by hand with the same stream _standby_cas emits.
+        skey = p._standby_key(str(oid))
+        async with client.transaction() as txn:
+            await txn.execute("WATCH", skey)
+            held, epoch = p._parse_standby(await txn.execute("GET", skey))
+            assert (held, epoch) == (["s1:1", "s2:2"], 0)
+            # Promoter B completes the full CAS first.
+            assert await p.promote_standby(oid, "s2:2", 0) == 1
+            # A resumes from its stale read: EXEC must abort (null reply).
+            await txn.execute("MULTI")
+            await txn.execute("SET", skey, f"{epoch + 1}|s2:2")
+            assert await txn.execute("EXEC") is None
+
+        # B's row stands; A's retry loses the epoch check cleanly.
+        assert await p.standbys(oid) == (["s1:1"], 1)
+        assert await p.lookup(oid) == "s2:2"
+        assert await p.promote_standby(oid, "s1:1", 0) is None
+
+        # Concurrent promoters through the production path: exactly one
+        # epoch bump, never two primaries.
+        oid2 = ObjectId("Svc", "race2")
+        await p.set_standbys(oid2, ["a:1", "b:2"])
+        wins = await asyncio.gather(
+            p.promote_standby(oid2, "a:1", 0), p.promote_standby(oid2, "b:2", 0)
+        )
+        assert sorted(w is not None for w in wins) == [False, True]
+        _, epoch2 = await p.standbys(oid2)
+        assert epoch2 == 1
+
+        client.close()
+    finally:
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_redis_set_standbys_cannot_roll_back_promotion_epoch():
+    """A seat-repair write racing a promotion must not restore the
+    pre-promotion epoch — that would re-arm the deposed primary's stale
+    expected_epoch and let it win a CAS it already lost."""
+    server = await FakeRedisServer().start()
+    try:
+        client = RedisClient("127.0.0.1", server.port)
+        p = RedisObjectPlacement(client, key_prefix="t_rb")
+        oid = ObjectId("Svc", "rb")
+        await p.set_standbys(oid, ["s1:1"])
+        skey = p._standby_key(str(oid))
+
+        # A repairer reads epoch 0 and stalls...
+        async with client.transaction() as txn:
+            await txn.execute("WATCH", skey)
+            _, epoch = p._parse_standby(await txn.execute("GET", skey))
+            assert epoch == 0
+            # ...a promotion lands, moving the fence to 1...
+            assert await p.promote_standby(oid, "s1:1", 0) == 1
+            # ...and the stale epoch-0 write is voided, not applied.
+            await txn.execute("MULTI")
+            await txn.execute("SET", skey, "0|s9:9")
+            assert await txn.execute("EXEC") is None
+
+        assert await p.standbys(oid) == ([], 1)
+        # The production path retries its read and preserves the new fence.
+        assert await p.set_standbys(oid, ["s9:9"]) == 1
+        assert await p.standbys(oid) == (["s9:9"], 1)
 
         client.close()
     finally:

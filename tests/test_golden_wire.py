@@ -168,18 +168,22 @@ async def test_redis_wire_golden(monkeypatch):
 
     server = await FakeRedisServer().start()
     log: list[tuple[str, ...]] = []
-    orig_dispatch = FakeRedisServer._dispatch
+    # Spy at the wire entry point (_handle), not command execution
+    # (_dispatch): transaction control (WATCH/MULTI/EXEC) and queued
+    # commands are then captured once each, in the order they cross the
+    # socket — which is the stream this golden pins.
+    orig_handle = FakeRedisServer._handle
 
-    def spy(self, cmd):
+    def spy(self, session, cmd):
         name = cmd[0].decode().upper()
         if name not in HANDSHAKE:
             log.append(
                 ("cmd", " ".join(c.decode("utf-8", "backslashreplace")
                                  for c in cmd))
             )
-        return orig_dispatch(self, cmd)
+        return orig_handle(self, session, cmd)
 
-    monkeypatch.setattr(FakeRedisServer, "_dispatch", spy)
+    monkeypatch.setattr(FakeRedisServer, "_handle", spy)
     try:
         from rio_tpu.cluster.storage.redis import RedisMembershipStorage
         from rio_tpu.object_placement.redis import RedisObjectPlacement

@@ -192,3 +192,29 @@ async def test_background_flusher_runs_without_manual_flush(tmp_path):
         await asyncio.sleep(0.02)
     assert len(await backing.items()) == 10
     await p.aclose()
+
+
+async def test_promotion_after_cold_restart_keeps_surviving_standbys(tmp_path):
+    """Mirror-miss promotion must rebuild the standby row from the BACKING's
+    post-CAS row, not from an empty host mirror — with k>=2 the old rebuild
+    flushed [] over the surviving seats, silently dropping durable standbys
+    until anti-entropy re-placed them."""
+    backing = SqliteObjectPlacement(str(tmp_path / "dir.db"))
+    p1 = _provider(backing)
+    await p1.prepare()
+    oid = ObjectId("Game", "g0")
+    await p1.update(ObjectPlacementItem(oid, "10.9.0.0:5000"))
+    await p1.set_standbys(oid, ["10.9.0.1:5000", "10.9.0.2:5000"])
+    await _settled_flush(p1)
+    await p1.aclose()
+
+    # Restart: standby rows restore lazily, so the mirror is cold when the
+    # failover CAS arrives.
+    p2 = _provider(SqliteObjectPlacement(str(tmp_path / "dir.db")))
+    await p2.prepare()
+    assert await p2.promote_standby(oid, "10.9.0.1:5000", 0) == 1
+    assert await p2.standbys(oid) == (["10.9.0.2:5000"], 1)
+    # The write-behind flush persists the SURVIVING seat, not an empty set.
+    await _settled_flush(p2)
+    assert await p2._backing.standbys(oid) == (["10.9.0.2:5000"], 1)
+    await p2.aclose()

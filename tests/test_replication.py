@@ -21,9 +21,11 @@ from rio_tpu import (
     handler,
     message,
 )
+from rio_tpu import codec
 from rio_tpu.commands import ServerInfo
 from rio_tpu.migration import ReplicaAppend
-from rio_tpu.registry import ObjectId
+from rio_tpu.object_placement import ObjectPlacementItem
+from rio_tpu.registry import ObjectId, type_id
 from rio_tpu.replication import ReplicationConfig, ReplicationManager
 from rio_tpu.state import LocalState, StateProvider, managed_state
 
@@ -273,6 +275,84 @@ def test_apply_append_fences_stale_epochs_and_local_primaries():
         assert not here.ok and "primary" in here.detail
 
     asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# Deposed-primary fence: the directory re-read side of the fence
+# ---------------------------------------------------------------------------
+
+
+def test_deposed_primary_surrenders_key_instead_of_shipping():
+    """A primary that was falsely declared dead (and failed over while still
+    running) must notice on its next seat-cache refresh that the directory
+    names another node — and abort the ship AND the seat rewrite, rather
+    than re-adopting the post-promotion epoch and passing the fence."""
+
+    async def run():
+        placement = LocalObjectPlacement()
+        mgr = ReplicationManager(
+            address="10.0.0.1:1",
+            registry=build_registry(),
+            placement=placement,
+            members_storage=LocalStorage(),
+            app_data=AppData(),
+        )
+        oid = ObjectId("Ledger", "d1")
+        key = ("Ledger", "d1")
+        # Post-failover directory state: another node holds the primary row.
+        await placement.update(ObjectPlacementItem(oid, "10.0.0.2:2"))
+        await placement.set_standbys(oid, ["10.0.0.3:3"])
+        # Leftover primary-role state from before this node was deposed.
+        mgr._last_shipped[key] = b"stale"
+        mgr._seq[key] = 7
+        mgr._dirty.add(key)
+
+        await mgr._ship(oid, key, b"newer")
+
+        assert mgr.stats.deposed == 1
+        assert mgr.stats.shipped == 0 and mgr.stats.unreplicated == 0
+        # Primary-role state surrendered — no retry, no seq to confuse a
+        # later re-promotion back here.
+        assert key not in mgr._last_shipped and key not in mgr._seq
+        assert key not in mgr._dirty and key not in mgr._seats
+        # The real primary's standby row was not rewritten.
+        assert await placement.standbys(oid) == (["10.0.0.3:3"], 0)
+        # Direct seat repair refuses the rewrite too (set_standbys from a
+        # deposed node would clobber the promoted primary's seat choices).
+        assert await mgr.repair_seats(oid) == (["10.0.0.3:3"], 0)
+        assert await placement.standbys(oid) == (["10.0.0.3:3"], 0)
+
+    asyncio.run(run())
+
+
+def test_restore_replica_keeps_payload_when_hook_is_missing():
+    """The shipped payload must survive an activation that cannot consume it
+    (no ``__restore_state__`` yet) instead of being popped and discarded."""
+    mgr = ReplicationManager(
+        address="a:1",
+        registry=build_registry(),
+        placement=LocalObjectPlacement(),
+        members_storage=LocalStorage(),
+        app_data=AppData(),
+    )
+
+    class Bare:
+        id = "b1"
+
+    key = (type_id(Bare), "b1")
+    payload = codec.serialize({"hot": 3})
+    mgr._replica_store[key] = (payload, 5, 2)
+
+    assert mgr.restore_replica(Bare()) is False
+    assert mgr._replica_store[key] == (payload, 5, 2)  # still claimable
+
+    # Once the hook exists, the SAME stored entry restores and is consumed.
+    captured = []
+    Bare.__restore_state__ = lambda self, value: captured.append(value)
+    assert mgr.restore_replica(Bare()) is True
+    assert captured == [{"hot": 3}]
+    assert key not in mgr._replica_store
+    assert mgr._seq[key] == 2  # sequence continues past the shipped delta
 
 
 # ---------------------------------------------------------------------------
