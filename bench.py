@@ -1380,6 +1380,32 @@ def migration_drain() -> dict:
     return out
 
 
+def hotkey_scaleout() -> dict:
+    """Hot-key read p99, replica reads vs read-through-primary, under the
+    SAME seeded zipf open-loop stream (one celebrity key = 30% of traffic)
+    in the SAME session — the hot_p99_ratio is the stable artifact;
+    absolute latencies drift with the box like every host-stage number."""
+    import asyncio
+
+    from rio_tpu.utils.hotkey_live import measure_hotkey
+
+    out = asyncio.run(measure_hotkey())
+    base, rep = out["baseline"], out["replica_reads"]
+    print(
+        f"# hot-key read scale-out ({out['n_requests']} reqs @ "
+        f"{out['rate_per_sec']:,.0f}/s open loop, hot key "
+        f"{out['hot_fraction']:.0%} of stream, {out['work_ms']:.0f} ms/read, "
+        f"3 servers): replica reads hot p99 {rep['hot_p99_ms']:,.1f} ms "
+        f"({rep.get('standby_reads', 0)} standby reads, "
+        f"{rep.get('read_sheds', 0)} sheds, "
+        f"{rep.get('stale_refusals', 0)} stale refusals) vs "
+        f"read-through-primary {base['hot_p99_ms']:,.1f} ms = "
+        f"{out.get('hot_p99_ratio', 0):.3f}x",
+        file=sys.stderr,
+    )
+    return out
+
+
 _TPU_PLATFORMS = os.environ.get("JAX_PLATFORMS")  # as the driver launched us
 
 
@@ -1713,6 +1739,10 @@ def main() -> None:
     except Exception as e:
         print(f"# migration drain failed: {e!r}", file=sys.stderr)
     try:
+        detail["hotkey"] = hotkey_scaleout()
+    except Exception as e:
+        print(f"# hot-key scale-out failed: {e!r}", file=sys.stderr)
+    try:
         detail["scaled_routing"] = scaled_route_hops()
     except Exception as e:
         print(f"# scaled routing failed: {e!r}", file=sys.stderr)
@@ -1849,10 +1879,16 @@ if __name__ == "__main__":
     # Rehearse the migration-drain host stage alone (CPU-safe: in-process
     # live cluster, never touches the relay).
     parser.add_argument("--migration", action="store_true")
+    # Rehearse the hot-key read scale-out host stage alone (same CPU-safe
+    # in-process-cluster shape as --migration).
+    parser.add_argument("--hotkey", action="store_true")
     args = parser.parse_args()
     if args.migration:
         _pin_orchestrator_to_cpu()
         print(json.dumps(migration_drain()))
+    elif args.hotkey:
+        _pin_orchestrator_to_cpu()
+        print(json.dumps(hotkey_scaleout()))
     elif args.tier is not None and args.hier:
         run_hier_tier(args.tier, args.deadline, args.platform)
     elif args.tier is not None and args.collapsed:

@@ -29,7 +29,17 @@ from .load import (
 from .message_router import MessageRouter
 from .migration import MigrationManager, MigrationStats
 from .object_placement import LocalObjectPlacement, ObjectPlacement, ObjectPlacementItem
-from .registry import ObjectId, Registry, handler, message, type_id, type_name, wire_error
+from .readscale import ReadScaleConfig, ReadScaleManager
+from .registry import (
+    ObjectId,
+    Registry,
+    handler,
+    message,
+    readonly,
+    type_id,
+    type_name,
+    wire_error,
+)
 from .registry.declarative import RegistryDeclaration, make_registry
 from .reminders import LocalReminderStorage, Reminder, ReminderStorage
 from .reminders.daemon import ReminderDaemonConfig
@@ -69,6 +79,8 @@ __all__ = [
     "ObjectId",
     "ObjectPlacement",
     "ObjectPlacementItem",
+    "ReadScaleConfig",
+    "ReadScaleManager",
     "Registry",
     "RegistryDeclaration",
     "Reminder",
@@ -84,6 +96,7 @@ __all__ = [
     "handler",
     "make_registry",
     "message",
+    "readonly",
     "type_id",
     "type_name",
     "wire_error",

@@ -35,8 +35,8 @@ from ..protocol import (
     encode_request_frame,
     encode_subscribe_frame,
 )
-from ..registry import MESSAGE_TYPES, decode_error, type_id
-from ..utils import ExponentialBackoff, LruCache
+from ..registry import MESSAGE_TYPES, decode_error, is_readonly_message, type_id
+from ..utils import DecorrelatedJitter, ExponentialBackoff, LruCache
 
 log = logging.getLogger("rio_tpu.client")
 
@@ -145,6 +145,7 @@ class ClientStats:
     redirects: int = 0
     dial_failures: int = 0  # attempts that died before a response (dead addr)
     busy_retries: int = 0  # SERVER_BUSY sheds answered with backoff + re-route
+    standby_routes: int = 0  # read attempts sent to a standby seat (readscale)
 
 
 class Client:
@@ -171,6 +172,8 @@ class Client:
         transport: str = "asyncio",
         placement_resolver: Callable[[str, str], Awaitable[str | None]] | None = None,
         membership_view_ttl: float = 1.0,
+        read_scale: Any | None = None,
+        standby_resolver: Callable[[str, str], Awaitable[list[str]]] | None = None,
     ) -> None:
         if transport not in ("asyncio", "native", "auto"):
             raise ValueError(f"unknown transport {transport!r}")
@@ -180,6 +183,19 @@ class Client:
         self._view_ttl = membership_view_ttl
         self._view_ts = float("-inf")
         self._placement: LruCache[tuple[str, str], str] = LruCache(placement_cache_size)
+        # Read scale-out (rio_tpu/readscale): a ReadScaleConfig enables
+        # routing @readonly requests to standby seats — reactively when a
+        # SERVER_BUSY shed names them (cached here with a TTL), proactively
+        # via ``standby_resolver`` when the primary's cluster-load entry is
+        # hot. ``None`` keeps every request on the primary, bit-for-bit the
+        # pre-readscale behavior.
+        self._read_scale = read_scale
+        self._standby_resolver = standby_resolver
+        self._read_seats: LruCache[tuple[str, str], tuple[list[str], float]] = (
+            LruCache(placement_cache_size)
+        )
+        self._load_view: Any | None = None
+        self._load_view_ts = float("-inf")
         self._conns: dict[str, _ServerConns] = {}
         self._active_servers: list[str] = []
         self._pool_per_server = pool_per_server
@@ -264,6 +280,65 @@ class Client:
         # receiving server self-assigns or redirects us to the owner.
         return random.choice(servers)
 
+    # -- read scale-out routing (rio_tpu/readscale) --------------------------
+
+    def _seat_hint(self, key: tuple[str, str]) -> list[str]:
+        """Fresh cached standby seats for a key, else ``[]``."""
+        hint = self._read_seats.get(key)
+        if hint is None:
+            return []
+        ttl = getattr(self._read_scale, "seat_hint_ttl", 2.0)
+        if asyncio.get_event_loop().time() - hint[1] > ttl:
+            return []
+        return list(hint[0])
+
+    def _cache_seats(self, key: tuple[str, str], seats: list[str]) -> None:
+        self._read_seats.put(key, (seats, asyncio.get_event_loop().time()))
+
+    async def _primary_hot(self, key: tuple[str, str]) -> bool:
+        """Does the cluster-load view call the cached primary hot?
+
+        Proactive half of read routing: before the primary has to shed, a
+        derate under ``hot_derate`` on its heartbeat vector diverts reads.
+        Built from the same ``members()`` read the servers use — no new
+        RPC kinds, and the view is TTL'd like the active-servers list.
+        """
+        addr = self._placement.get(key)
+        if addr is None:
+            return False
+        loop = asyncio.get_event_loop()
+        if (
+            self._load_view is None
+            or loop.time() - self._load_view_ts > self._view_ttl
+        ):
+            from ..load import ClusterLoadView
+
+            self._load_view = ClusterLoadView.from_members(
+                await self.members_storage.members()
+            )
+            self._load_view_ts = loop.time()
+        entry = self._load_view.get(addr)
+        if entry is None or entry.stale:
+            return False
+        return entry.derate < getattr(self._read_scale, "hot_derate", 0.7)
+
+    async def _read_route_seats(
+        self, handler_type: str, handler_id: str, key: tuple[str, str]
+    ) -> list[str]:
+        """Standby seats worth routing this readonly request to (maybe [])."""
+        seats = self._seat_hint(key)
+        if seats:
+            return seats
+        if self._standby_resolver is None or not await self._primary_hot(key):
+            return []
+        try:
+            seats = [s for s in await self._standby_resolver(handler_type, handler_id) if s]
+        except Exception:  # noqa: BLE001 — discovery is best-effort
+            return []
+        if seats:
+            self._cache_seats(key, seats)
+        return seats
+
     # -- request path (reference tower_services.rs:96-226) -------------------
 
     async def send_raw(
@@ -276,11 +351,28 @@ class Client:
         last: BaseException | None = None
         attempts = 0
         avoid: set[str] = set()  # addresses that failed for THIS request
+        # Read scale-out: a @readonly request with known standby seats fans
+        # out across them instead of queueing on the hot primary.
+        is_read = self._read_scale is not None and is_readonly_message(
+            handler_type, message_type
+        )
+        read_seats: list[str] = []
+        if is_read:
+            read_seats = await self._read_route_seats(handler_type, handler_id, key)
+        jitter: DecorrelatedJitter | None = None
         for delay in self._backoff.delays():
             attempts += 1
             address = None
+            via_seat = False
             try:
-                address = await self._pick_address(handler_type, handler_id, avoid)
+                if read_seats:
+                    cand = [s for s in read_seats if s not in avoid]
+                    if cand:
+                        address = random.choice(cand)
+                        via_seat = True
+                        self.stats.standby_routes += 1
+                if address is None:
+                    address = await self._pick_address(handler_type, handler_id, avoid)
                 pool = self._pool(address)
                 conn = await pool.acquire()
                 seen = conn.delivered
@@ -313,7 +405,11 @@ class Client:
                 continue
             resp = decode_response(raw)
             if resp.is_ok:
-                self._placement.put(key, address)
+                if not via_seat:
+                    # A standby-served read must NOT feed the placement
+                    # cache: the next WRITE would land on the standby and
+                    # bounce (or worse, self-assign a second primary row).
+                    self._placement.put(key, address)
                 return resp.body or b""
             err = resp.error
             assert err is not None
@@ -338,8 +434,33 @@ class Client:
                 self.stats.busy_retries += 1
                 if address is not None:
                     avoid.add(address)
+                seats = []
+                if err.payload:
+                    from ..readscale import decode_seat_hint
+
+                    seats = [s for s in decode_seat_hint(err.payload) if s not in avoid]
+                if seats:
+                    # The shed names read-capable standby seats: cache them
+                    # for later requests and — for a readonly request —
+                    # retry against one immediately (the redirect pattern:
+                    # the server told us where the capacity is, sleeping
+                    # first would only stretch the hot key's p99). The
+                    # primary row stays cached: it is still the correct
+                    # write target.
+                    self._cache_seats(key, seats)
+                    if is_read:
+                        read_seats = seats
+                        continue
                 self._placement.pop(key)
-                await asyncio.sleep(delay)
+                # Decorrelated jitter, one sequence per request: a shed
+                # synchronizes every rejected client on the same clock
+                # tick, and deterministic exponential delays would march
+                # them back in lockstep to collide again.
+                if jitter is None:
+                    jitter = DecorrelatedJitter(
+                        base=self._backoff.initial, cap=self._backoff.cap
+                    )
+                await asyncio.sleep(jitter.next())
                 continue
             if err.kind in (ErrorKind.DEALLOCATE, ErrorKind.ALLOCATE):
                 last = ClientError(f"{err.kind.name}: {err.detail}")
@@ -510,6 +631,19 @@ class ClientBuilder:
         self._resolver = resolver
         return self
 
+    def read_scale(self, config: Any) -> "ClientBuilder":
+        """Enable standby read routing (a
+        :class:`~rio_tpu.readscale.ReadScaleConfig`; see :class:`Client`)."""
+        self._read_scale_config = config
+        return self
+
+    def standby_resolver(
+        self, resolver: Callable[[str, str], Awaitable[list[str]]]
+    ) -> "ClientBuilder":
+        """Directory standby-seat discovery for proactive read routing."""
+        self._standby_resolver_fn = resolver
+        return self
+
     def build(self) -> Client:
         if self._storage is None:
             raise ClientBuilderError("members_storage is required")
@@ -522,4 +656,6 @@ class ClientBuilder:
             transport=getattr(self, "_transport", "asyncio"),
             placement_resolver=getattr(self, "_resolver", None),
             membership_view_ttl=getattr(self, "_view_ttl_value", 1.0),
+            read_scale=getattr(self, "_read_scale_config", None),
+            standby_resolver=getattr(self, "_standby_resolver_fn", None),
         )

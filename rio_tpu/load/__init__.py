@@ -34,9 +34,12 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import logging
 import math
 import time
 from typing import Any, Callable
+
+log = logging.getLogger("rio_tpu.load")
 
 __all__ = [
     "LoadVector",
@@ -317,6 +320,10 @@ class LoadMonitor:
         self._rate_marker = 0  # requests_total at the previous sample
         self._last_sample: float | None = None
         self.cluster_view: ClusterLoadView | None = None
+        # Optional read-scale hook: an object exposing ``hotness_tick()``
+        # (rio_tpu.readscale.ReadScaleManager), ticked once per sample so
+        # dynamic replica counts ride the existing loop — no new task.
+        self.hotness_detector: Any = None
 
     # -- request-path hooks (sync, called per dispatch) ---------------------
 
@@ -420,6 +427,14 @@ class LoadMonitor:
             # loop starved by slow callbacks wakes us late by that much.
             lag_ms = max(0.0, (loop.time() - t0 - self.interval)) * 1e3
             self._sample(lag_ms)
+            detector = self.hotness_detector
+            if detector is not None:
+                try:
+                    await detector.hotness_tick()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 — sampling must not die
+                    log.exception("hotness detector tick failed")
             if loop.time() - last_view >= self.view_interval:
                 last_view = loop.time()
                 try:

@@ -237,6 +237,16 @@ class ReplicaAppend:
     epoch: int = 0
     seq: int = 0
     payload: bytes = b""
+    # Appended fields (wire-compatible: shorter legacy frames decode with the
+    # defaults, codec.py schema-evolution contract). Read-scale staleness
+    # metadata: ``head_seq`` is the primary's latest sequence for the key at
+    # ship time, ``ship_ts`` the primary's wall clock. ``refresh=True`` marks
+    # a payload-less freshness ping — the standby updates its lag/age
+    # bookkeeping and acks, or nacks if it holds no replica (forcing a full
+    # re-ship).
+    head_seq: int = 0
+    ship_ts: float = 0.0
+    refresh: bool = False
 
 
 @message(name="rio.ReplicaAck")

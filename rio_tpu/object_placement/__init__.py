@@ -16,7 +16,13 @@ import dataclasses
 
 from ..registry import ObjectId
 
-__all__ = ["ObjectId", "ObjectPlacementItem", "ObjectPlacement", "LocalObjectPlacement"]
+__all__ = [
+    "ObjectId",
+    "ObjectPlacementItem",
+    "ObjectPlacement",
+    "LocalObjectPlacement",
+    "sanitize_standby_row",
+]
 
 
 @dataclasses.dataclass
@@ -25,6 +31,39 @@ class ObjectPlacementItem:
 
     object_id: ObjectId
     server_address: str | None = None
+
+
+def sanitize_standby_row(held: object, epoch: object) -> tuple[list[str], int]:
+    """Defensive decode of a standby row read back from a backend.
+
+    Replica rows outlive code versions: a directory written by an older
+    deployment (or hand-edited, or corrupted) must degrade to "no standbys"
+    — a read-capacity loss — never to an exception on the request path. A
+    non-integer or negative epoch poisons the fence, so the whole row is
+    dropped; individually malformed addresses are filtered while the rest
+    of the set survives.
+    """
+    try:
+        ep = int(epoch)  # type: ignore[call-overload]
+    except (TypeError, ValueError):
+        return [], 0
+    if ep < 0:
+        return [], 0
+    if not isinstance(held, (list, tuple)):
+        return [], ep
+    addrs: list[str] = []
+    for a in held:
+        if isinstance(a, bytes):
+            try:
+                a = a.decode()
+            except UnicodeDecodeError:
+                continue
+        if not isinstance(a, str):
+            continue
+        host, sep, port = a.rpartition(":")
+        if sep and host and port.isdigit():
+            addrs.append(a)
+    return addrs, ep
 
 
 class ObjectPlacement(abc.ABC):
@@ -140,7 +179,7 @@ class LocalObjectPlacement(ObjectPlacement):
 
     async def standbys(self, object_id: ObjectId) -> tuple[list[str], int]:
         held, epoch = self._standbys.get(str(object_id), ([], 0))
-        return list(held), epoch
+        return sanitize_standby_row(held, epoch)
 
     async def promote_standby(
         self, object_id: ObjectId, address: str, expected_epoch: int

@@ -43,7 +43,7 @@ from ..ops import (
     scaling_sinkhorn,
     sinkhorn,
 )
-from . import ObjectPlacement, ObjectPlacementItem
+from . import ObjectPlacement, ObjectPlacementItem, sanitize_standby_row
 
 _FEAT_DIM = 16  # hashed-identity feature width for the hierarchical mode
 
@@ -184,6 +184,14 @@ class AffinityTracker:
 
     def total_rate(self) -> float:
         return float(sum(self._rates.values()))
+
+    def object_rates(self) -> dict[str, float]:
+        """Snapshot of the folded per-object req/sec EMAs.
+
+        Keys are observer keys (``"{type_name}.{id}"`` == ``str(ObjectId)``).
+        The read-scale hotness detector consumes this; a plain dict copy of
+        the atomically-swapped map, safe against the concurrent fold."""
+        return dict(self._rates)
 
     def note_state_bytes(self, key: str, nbytes: int) -> None:
         """Record the object's last migration-snapshot size (its state
@@ -859,7 +867,7 @@ class JaxObjectPlacement(ObjectPlacement):
         # Lock-free read, like lookup(): single-assignment snapshot of an
         # immutable (list, epoch) tuple.
         held, epoch = self._standby_rows.get(str(object_id), ([], 0))
-        return list(held), epoch
+        return sanitize_standby_row(held, epoch)
 
     async def promote_standby(
         self, object_id: ObjectId, address: str, expected_epoch: int

@@ -32,7 +32,7 @@ import asyncio
 import logging
 
 from ..registry import ObjectId
-from . import ObjectPlacement, ObjectPlacementItem
+from . import ObjectPlacement, ObjectPlacementItem, sanitize_standby_row
 from .jax_placement import JaxObjectPlacement
 
 log = logging.getLogger("rio_tpu.object_placement.persistent")
@@ -223,7 +223,7 @@ class PersistentJaxObjectPlacement(JaxObjectPlacement):
         row = self._standby_rows.get(key)
         if row is not None:
             held, epoch = row
-            return list(held), epoch
+            return sanitize_standby_row(held, epoch)
         # Mirror miss (cold restart): read through. Not cached — a row is
         # only mirrored once this node writes it, keeping restore lazy.
         return await self._backing.standbys(object_id)

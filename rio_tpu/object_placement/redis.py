@@ -12,7 +12,7 @@ from typing import Callable
 
 from ..registry import ObjectId
 from ..utils.resp import RedisClient, RespError
-from . import ObjectPlacement, ObjectPlacementItem
+from . import ObjectPlacement, ObjectPlacementItem, sanitize_standby_row
 
 # Optimistic-lock retries before a standby CAS gives up. Contention on one
 # object's replica row is a handful of promoters post-death, not a hot path;
@@ -100,11 +100,17 @@ class RedisObjectPlacement(ObjectPlacement):
 
     @staticmethod
     def _parse_standby(raw: object) -> tuple[list[str], int]:
-        # Value is ``"{epoch}|{addr,...}"``.
+        # Value is ``"{epoch}|{addr,...}"``; legacy/garbage values (wrong
+        # type, undecodable bytes, non-integer epoch) degrade to "no
+        # standbys" rather than raising on the read path.
         if not isinstance(raw, bytes):
             return [], 0
-        epoch_s, _, held = raw.decode().partition("|")
-        return [a for a in held.split(",") if a], int(epoch_s)
+        try:
+            text = raw.decode()
+        except UnicodeDecodeError:
+            return [], 0
+        epoch_s, _, held = text.partition("|")
+        return sanitize_standby_row([a for a in held.split(",") if a], epoch_s)
 
     async def _standby_row(self, key: str) -> tuple[list[str], int]:
         return self._parse_standby(

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from ..registry import ObjectId
 from ..utils.sqlite import SqliteDb
-from . import ObjectPlacement, ObjectPlacementItem
+from . import ObjectPlacement, ObjectPlacementItem, sanitize_standby_row
 
 MIGRATIONS = [
     """
@@ -91,7 +91,16 @@ class SqliteObjectPlacement(ObjectPlacement):
         if not rows:
             return [], 0
         held, epoch = rows[0]
-        return [a for a in (held or "").split(",") if a], int(epoch)
+        # TEXT-affinity columns round-trip whatever a legacy writer stored;
+        # degrade garbage to "no standbys" instead of crashing the read path.
+        if isinstance(held, bytes):
+            try:
+                held = held.decode()
+            except UnicodeDecodeError:
+                held = ""
+        if not isinstance(held, str):
+            held = ""
+        return sanitize_standby_row([a for a in held.split(",") if a], epoch)
 
     async def promote_standby(
         self, object_id: ObjectId, address: str, expected_epoch: int

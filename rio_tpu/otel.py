@@ -70,6 +70,7 @@ def server_gauges(server: Any) -> dict[str, float]:
     rdaemon = getattr(server, "reminder_daemon", None)
     migrator = getattr(server, "migration_manager", None)
     replicator = getattr(server, "replication_manager", None)
+    readscale = getattr(server, "read_scale_manager", None)
     placement = getattr(server, "object_placement", None)
     monitor = getattr(server, "load_monitor", None)
     gauges = stats_gauges(
@@ -77,6 +78,7 @@ def server_gauges(server: Any) -> dict[str, float]:
         reminder_daemon=getattr(rdaemon, "stats", None),
         migration=getattr(migrator, "stats", None),
         replication=getattr(replicator, "stats", None),
+        read_scale=getattr(readscale, "stats", None),
         placement_solve=getattr(placement, "stats", None),
         load=getattr(monitor, "stats", None),
     )
@@ -86,6 +88,8 @@ def server_gauges(server: Any) -> dict[str, float]:
     view = getattr(monitor, "cluster_view", None)
     if view is not None:
         gauges.update(view.gauges())
+    if readscale is not None:
+        gauges.update(readscale.gauges())
     return gauges
 
 

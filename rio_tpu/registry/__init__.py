@@ -26,11 +26,15 @@ from ..errors import (
 from .handler import (
     ERROR_TYPES,
     MESSAGE_TYPES,
+    READONLY_MESSAGES,
     HandlerSpec,
     decode_error,
     encode_error,
     handler,
+    is_readonly_message,
     message,
+    readonly,
+    register_readonly,
     resolve_handlers,
     wire_error,
 )
@@ -40,12 +44,16 @@ __all__ = [
     "Registry",
     "ObjectId",
     "handler",
+    "readonly",
     "message",
     "wire_error",
     "type_id",
     "type_name",
     "MESSAGE_TYPES",
     "ERROR_TYPES",
+    "READONLY_MESSAGES",
+    "register_readonly",
+    "is_readonly_message",
     "encode_error",
     "decode_error",
 ]
@@ -90,6 +98,7 @@ class Registry:
         self._objects: dict[tuple[str, str], _Entry] = {}
         self._node_scoped: set[str] = set()
         self._replicated: set[str] = set()
+        self._readonly: set[tuple[str, str]] = set()
 
     # -- type / handler registration (reference registry/mod.rs:82-182) ----
 
@@ -130,6 +139,9 @@ class Registry:
                 "rio.ReminderFired",
             ):
                 self._handlers[(tname, spec.message_type_name)] = spec
+                if spec.readonly:
+                    self._readonly.add((tname, spec.message_type_name))
+                    READONLY_MESSAGES.add((tname, spec.message_type_name))
         return self
 
     def add_handler(self, cls: type, msg_cls: type, fn: Callable, returns: Any = Any) -> "Registry":
@@ -159,8 +171,14 @@ class Registry:
     def is_replicated(self, type_name: str) -> bool:
         return type_name in self._replicated
 
+    def is_readonly(self, type_name: str, message_type: str) -> bool:
+        return (type_name, message_type) in self._readonly
+
     def has_handler(self, type_name: str, message_type: str) -> bool:
         return (type_name, message_type) in self._handlers
+
+    def handler_spec(self, type_name: str, message_type: str) -> HandlerSpec | None:
+        return self._handlers.get((type_name, message_type))
 
     def registered_types(self) -> list[str]:
         return list(self._constructors)

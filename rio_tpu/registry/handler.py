@@ -31,7 +31,14 @@ T = TypeVar("T")
 MESSAGE_TYPES: dict[str, type] = {}
 ERROR_TYPES: dict[str, type] = {}
 
+# ``(service type-name, message type-name)`` pairs whose handler is marked
+# ``@readonly`` — safe to serve from a bounded-staleness standby replica.
+# Global (like MESSAGE_TYPES) so clients can route reads without holding a
+# Registry; populated by ``Registry.add_type`` / ``register_readonly``.
+READONLY_MESSAGES: set[tuple[str, str]] = set()
+
 HANDLER_ATTR = "__rio_handler__"
+READONLY_ATTR = "__rio_readonly__"
 
 
 def message(cls: T | None = None, *, name: str | None = None):
@@ -78,6 +85,7 @@ class HandlerSpec:
     message_type_name: str
     returns: Any
     fn: Callable  # unbound async method (self, msg, ctx) -> returns
+    readonly: bool = False
 
 
 def handler(fn: Callable) -> Callable:
@@ -85,6 +93,18 @@ def handler(fn: Callable) -> Callable:
     if not inspect.iscoroutinefunction(fn):
         raise TypeError(f"handler {fn.__qualname__} must be 'async def'")
     setattr(fn, HANDLER_ATTR, True)
+    return fn
+
+
+def readonly(fn: Callable) -> Callable:
+    """Mark a ``@handler`` method as safe to serve from a standby replica.
+
+    A readonly handler must not mutate actor state: the read-scale layer may
+    dispatch it against a shadow instance restored from the replica log
+    (rio_tpu/readscale), where writes would be silently lost. Composes with
+    ``@handler`` in either order.
+    """
+    setattr(fn, READONLY_ATTR, True)
     return fn
 
 
@@ -111,9 +131,27 @@ def resolve_handlers(cls: type) -> list[HandlerSpec]:
                 message_type_name=type_id(msg_ty),
                 returns=hints.get("return", Any),
                 fn=fn,
+                readonly=getattr(fn, READONLY_ATTR, False),
             )
         )
     return specs
+
+
+def register_readonly(cls: type) -> None:
+    """Publish ``cls``'s ``@readonly`` handler pairs into READONLY_MESSAGES.
+
+    Client processes that never build a server Registry call this (or rely
+    on sharing the process with one) so read-marked requests route to
+    standby seats.
+    """
+    tname = type_id(cls)
+    for spec in resolve_handlers(cls):
+        if spec.readonly:
+            READONLY_MESSAGES.add((tname, spec.message_type_name))
+
+
+def is_readonly_message(handler_type: str, message_type: str) -> bool:
+    return (handler_type, message_type) in READONLY_MESSAGES
 
 
 # ---------------------------------------------------------------------------

@@ -71,6 +71,7 @@ from ..registry import ObjectId, Registry, type_id
 log = logging.getLogger("rio_tpu.replication")
 
 __all__ = [
+    "ReplicaFreshness",
     "ReplicationConfig",
     "ReplicationManager",
     "ReplicationStats",
@@ -86,6 +87,31 @@ class ReplicationConfig:
     anti_entropy_interval: float = 5.0  # periodic re-ship / seat repair
     seat_ttl: float = 2.0  # standby-row cache lifetime on the primary
     ensure_seats: bool = True  # seat standbys on first ship when missing
+
+
+@dataclass
+class ReplicaFreshness:
+    """Standby-side lag bookkeeping for one held replica.
+
+    Updated on every primary contact (append, idempotent replay, refresh
+    ping). Wall-clock age is measured from the LOCAL monotonic clock at
+    receive time — ``ship_ts`` (the primary's wall clock) is carried for
+    observability but never trusted across nodes.
+    """
+
+    epoch: int = 0
+    seq: int = 0  # last applied payload sequence
+    head_seq: int = 0  # primary's head sequence at last contact
+    ship_ts: float = 0.0  # primary wall clock at last contact
+    recv_mono: float = 0.0  # local monotonic at last contact
+
+    def age_s(self, now: float | None = None) -> float:
+        now = time.monotonic() if now is None else now
+        return max(0.0, now - self.recv_mono)
+
+    @property
+    def lag_seq(self) -> int:
+        return max(0, self.head_seq - self.seq)
 
 
 @dataclass
@@ -106,6 +132,8 @@ class ReplicationStats:
     promotions_lost: int = 0  # CAS races lost to a concurrent promoter
     seats_assigned: int = 0  # standby seats written to the directory
     anti_entropy_rounds: int = 0
+    refreshes: int = 0  # payload-less freshness pings shipped (read scale)
+    refresh_nacks: int = 0  # pings bounced (standby lost the replica / fenced)
     lag_ms_last: float = 0.0  # last full-set ship round-trip
     lag_ms_max: float = 0.0
 
@@ -142,6 +170,15 @@ class ReplicationManager:
         # primary shipped here; claimed by the first post-promotion
         # activation.
         self._replica_store: dict[tuple[str, str], tuple[bytes, int, int]] = {}
+        # Standby role: lag/age bookkeeping per held replica, consumed by the
+        # read-scale layer's staleness gate (rio_tpu/readscale).
+        self._replica_meta: dict[tuple[str, str], ReplicaFreshness] = {}
+        # Read-scale hooks: per-object replica-count overrides (the hotness
+        # detector's dynamic k) and the freshness-ping switch the
+        # ReadScaleManager flips on at attach time.
+        self._k_overrides: dict[tuple[str, str], int] = {}
+        self.read_refresh = False
+        self.refresh_interval: float | None = None
         # Primary role: dedup + retry state.
         self._last_shipped: dict[tuple[str, str], bytes] = {}
         self._seq: dict[tuple[str, str], int] = {}
@@ -220,6 +257,8 @@ class ReplicationManager:
             epoch=epoch,
             seq=seq,
             payload=payload,
+            head_seq=seq,
+            ship_ts=time.time(),
         )
         t0 = time.perf_counter()
         acks = await asyncio.gather(
@@ -318,8 +357,8 @@ class ReplicationManager:
         """
         held, epoch = await self.placement.standbys(object_id)
         live = [a for a in held if await self.members_storage.is_active(a)]
-        k = max(1, self.config.k)
-        if len(live) >= k and len(live) == len(held):
+        k = self.replica_k((object_id.type_name, object_id.id))
+        if len(live) == k and len(live) == len(held):
             return held, epoch
         if primary is None:
             primary = await self.placement.lookup(object_id)
@@ -329,27 +368,28 @@ class ReplicationManager:
             # not rewrite the standby set out from under the real primary.
             return held, epoch
         exclude = {primary, *live} - {None}
-        assign = getattr(self.placement, "assign_standbys", None)
         fresh: list[str] = []
-        if assign is not None:
-            try:
-                fresh = (await assign([object_id], k=k))[0]
-            except Exception:  # noqa: BLE001 — degrade to the hashed path
-                log.exception("solver standby assignment failed for %s", object_id)
-        if not fresh:
-            members = sorted(
-                m.address
-                for m in await self.members_storage.active_members()
-                if m.address not in exclude
-            )
-            if members:
-                # crc32, not hash(): per-process hash randomization would
-                # re-pick seats on every restart and churn the standby set.
-                start = zlib.crc32(str(object_id).encode()) % len(members)
-                fresh = [
-                    members[(start + i) % len(members)]
-                    for i in range(min(k - len(live), len(members)))
-                ]
+        if len(live) < k:
+            assign = getattr(self.placement, "assign_standbys", None)
+            if assign is not None:
+                try:
+                    fresh = (await assign([object_id], k=k))[0]
+                except Exception:  # noqa: BLE001 — degrade to the hashed path
+                    log.exception("solver standby assignment failed for %s", object_id)
+            if not fresh:
+                members = sorted(
+                    m.address
+                    for m in await self.members_storage.active_members()
+                    if m.address not in exclude
+                )
+                if members:
+                    # crc32, not hash(): per-process hash randomization would
+                    # re-pick seats on every restart and churn the standby set.
+                    start = zlib.crc32(str(object_id).encode()) % len(members)
+                    fresh = [
+                        members[(start + i) % len(members)]
+                        for i in range(min(k - len(live), len(members)))
+                    ]
         fresh = [a for a in dict.fromkeys(fresh) if a and a not in exclude]
         seats = (live + fresh)[:k]
         if seats == held:
@@ -359,6 +399,30 @@ class ReplicationManager:
         epoch = await self.placement.set_standbys(object_id, seats)
         self.stats.seats_assigned += len([a for a in seats if a not in held])
         return seats, epoch
+
+    # ------------------------------------------------------------------
+    # Dynamic replication factor (read-scale hotness detector)
+    # ------------------------------------------------------------------
+
+    def replica_k(self, key: tuple[str, str]) -> int:
+        """Effective standby count for a key: override, else ``config.k``."""
+        return self._k_overrides.get(key, max(1, self.config.k))
+
+    def set_replica_k(self, object_id: ObjectId, k: int | None) -> None:
+        """Override (or ``None`` to clear) one object's standby count.
+
+        Takes effect on the next :meth:`repair_seats` — the caller drives
+        that explicitly for an immediate re-seat. Grows AND shrinks: repair
+        truncates live seats above ``k`` through ``set_standbys`` (epoch
+        preserved — only ``promote_standby`` moves the fence).
+        """
+        key = (object_id.type_name, object_id.id)
+        if k is None:
+            self._k_overrides.pop(key, None)
+        else:
+            self._k_overrides[key] = max(1, int(k))
+        # Drop the seat cache so the next ship sees the resized set.
+        self._seats.pop(key, None)
 
     # ------------------------------------------------------------------
     # Standby role
@@ -379,16 +443,52 @@ class ReplicationManager:
             self.stats.append_nacks += 1
             return ReplicaAck(ok=False, detail="object is primary here")
         stored = self._replica_store.get(key)
+        if msg.refresh:
+            # Payload-less freshness ping: only bumps lag/age bookkeeping.
+            # Without a same-epoch replica here there is nothing whose
+            # freshness it could attest — nack so the primary re-ships the
+            # full payload (a newer-epoch ping means our copy predates the
+            # last promotion and may be behind the restored line).
+            if stored is None or msg.epoch != stored[1]:
+                self.stats.append_nacks += 1
+                return ReplicaAck(
+                    ok=False,
+                    epoch=stored[1] if stored is not None else 0,
+                    detail="no replica for refresh",
+                )
+            self._touch_meta(key, stored[1], stored[2], msg)
+            return ReplicaAck(ok=True, epoch=stored[1])
         if stored is not None:
             _, epoch, seq = stored
             if msg.epoch < epoch:
                 self.stats.append_nacks += 1
                 return ReplicaAck(ok=False, epoch=epoch, detail="stale epoch")
             if msg.epoch == epoch and msg.seq <= seq:
-                return ReplicaAck(ok=True, epoch=epoch)  # idempotent replay
+                # Idempotent replay — still primary contact: refresh age.
+                self._touch_meta(key, epoch, seq, msg)
+                return ReplicaAck(ok=True, epoch=epoch)
         self._replica_store[key] = (msg.payload, msg.epoch, msg.seq)
+        self._touch_meta(key, msg.epoch, msg.seq, msg)
         self.stats.appends += 1
         return ReplicaAck(ok=True, epoch=msg.epoch)
+
+    def _touch_meta(
+        self, key: tuple[str, str], epoch: int, seq: int, msg: ReplicaAppend
+    ) -> None:
+        self._replica_meta[key] = ReplicaFreshness(
+            epoch=epoch,
+            seq=seq,
+            head_seq=max(msg.head_seq, seq),  # legacy frames ship head_seq=0
+            ship_ts=msg.ship_ts,
+            recv_mono=time.monotonic(),
+        )
+
+    def replica_entry(self, key: tuple[str, str]) -> tuple[bytes, int, int] | None:
+        """Held replica ``(payload, epoch, seq)`` for a key, or None."""
+        return self._replica_store.get(key)
+
+    def replica_freshness(self, key: tuple[str, str]) -> ReplicaFreshness | None:
+        return self._replica_meta.get(key)
 
     def restore_replica(self, obj: Any) -> bool:
         """LOAD-lifecycle hook on a promoted node: warm the fresh activation
@@ -406,6 +506,7 @@ class ReplicationManager:
             # gains it) instead of keeping it for a later activation.
             return False
         payload, _, seq = self._replica_store.pop(key)
+        self._replica_meta.pop(key, None)  # this node stops standing by
         restore(codec.deserialize(payload, Any))
         # This node is primary for the key now: continue the sequence so
         # our own ships are never mistaken for replays downstream.
@@ -460,8 +561,12 @@ class ReplicationManager:
 
     async def run(self) -> None:
         """Background repair loop (one task per server, like the daemons)."""
-        interval = max(0.05, self.config.anti_entropy_interval)
         while True:
+            # Re-read per iteration: the ReadScaleManager tightens the
+            # cadence at attach time so freshness pings bound staleness.
+            interval = max(0.05, self.config.anti_entropy_interval)
+            if self.read_refresh and self.refresh_interval is not None:
+                interval = min(interval, max(0.05, self.refresh_interval))
             await asyncio.sleep(interval)
             try:
                 await self.anti_entropy_round()
@@ -497,10 +602,52 @@ class ReplicationManager:
                 self._dirty.discard((tname, oid))
                 continue
             if payload is None or self._last_shipped.get((tname, oid)) == payload:
+                if self.read_refresh and (tname, oid) in self._last_shipped:
+                    # Nothing to re-ship, but the standbys' wall-clock age
+                    # still advances — keep their replicas servably fresh.
+                    await self.refresh_standbys(ObjectId(tname, oid))
                 continue
             await self._ship(ObjectId(tname, oid), (tname, oid), payload)
             shipped += 1
         return shipped
+
+    async def refresh_standbys(self, object_id: ObjectId) -> None:
+        """Ship a payload-less freshness ping to the standby set.
+
+        A nack (standby restarted and lost the replica, or its epoch moved)
+        reopens the key for a full re-ship on the next round — the ping
+        never carries state, so it can never mask divergence.
+        """
+        key = (object_id.type_name, object_id.id)
+        seq = self._seq.get(key, 0)
+        if seq == 0:
+            return  # nothing ever shipped; nothing to attest
+        seats = await self._seats_for(object_id, key)
+        if seats is None:
+            return  # deposed
+        held, epoch = seats
+        live = [a for a in held if await self.members_storage.is_active(a)]
+        if not live:
+            return
+        msg = ReplicaAppend(
+            type_name=object_id.type_name,
+            object_id=object_id.id,
+            epoch=epoch,
+            seq=seq,
+            head_seq=seq,
+            ship_ts=time.time(),
+            refresh=True,
+        )
+        acks = await asyncio.gather(
+            *(self._append_to(addr, msg) for addr in live), return_exceptions=True
+        )
+        self.stats.refreshes += 1
+        for ack in acks:
+            if isinstance(ack, BaseException) or not ack.ok:
+                self.stats.refresh_nacks += 1
+                self._last_shipped.pop(key, None)
+                self._dirty.add(key)
+                break
 
     # ------------------------------------------------------------------
 

@@ -93,6 +93,7 @@ class Server:
         reminder_daemon_config=None,
         migration_config=None,
         replication_config=None,
+        read_scale_config=None,
         load_monitor: bool = True,
         load_thresholds=None,
     ) -> None:
@@ -132,6 +133,11 @@ class Server:
         # (a rio_tpu.replication.ReplicationConfig; None → disabled).
         self.replication_config = replication_config
         self.replication_manager = None  # created at bind() (needs the address)
+        # Bounded-staleness replica reads for ``@readonly`` handlers
+        # (a rio_tpu.readscale.ReadScaleConfig; None → disabled; requires
+        # replication_config — the replicas ARE the read capacity).
+        self.read_scale_config = read_scale_config
+        self.read_scale_manager = None  # created at bind() (needs the address)
         self._admin = AdminSender()
         self._internal = InternalClientSender()
         self._draining = ServerDraining()
@@ -286,6 +292,28 @@ class Server:
                 config=self.replication_config,
             )
             self.app_data.set(self.replication_manager)
+        if self.read_scale_manager is None and self.read_scale_config is not None:
+            if self.replication_manager is None:
+                raise ServerError(
+                    "read_scale_config requires replication_config — standby "
+                    "replicas are the read capacity"
+                )
+            from .readscale import ReadScaleManager
+
+            self.read_scale_manager = ReadScaleManager(
+                address=self._local_addr,
+                registry=self.registry,
+                replication=self.replication_manager,
+                placement=self.object_placement,
+                members_storage=self.members_storage,
+                app_data=self.app_data,
+                config=self.read_scale_config,
+            )
+            self.app_data.set(self.read_scale_manager)
+            if self.load_monitor is not None:
+                # The load loop ticks the hotness detector right after each
+                # sample — dynamic k rides the existing cadence, no new task.
+                self.load_monitor.hotness_detector = self.read_scale_manager
         return self._local_addr
 
     def _advertised(self, bound_host: str, bound_port: int) -> str:
@@ -553,6 +581,8 @@ class Server:
                 self.migration_manager.close()
             if self.replication_manager is not None:
                 self.replication_manager.close()
+            if self.read_scale_manager is not None:
+                self.read_scale_manager.close()
             # Leaving the cluster: mark self inactive so peers stop routing here.
             with contextlib.suppress(Exception):
                 host, _, port = self.local_address.rpartition(":")

@@ -74,6 +74,12 @@ class Service:
         # replication_config): ships replicated actors' state on ack and
         # drives epoch-fenced failover from the dead-owner branch.
         self._replication = app_data.try_get(ReplicationManager)
+        from .readscale import ReadScaleManager
+
+        # Bounded-staleness replica reads (None unless the server was built
+        # with a read_scale_config): standby-side serve/forward of @readonly
+        # requests, primary-side shed toward the standby seats under load.
+        self._readscale = app_data.try_get(ReadScaleManager)
         from .load import LoadMonitor
 
         # Admission control + telemetry (None when the server runs without
@@ -272,6 +278,14 @@ class Service:
             if routing is not None:
                 return ResponseEnvelope.err(routing)
         else:
+            if self._readscale is not None:
+                # Standby serve-or-forward runs BEFORE the overload shed: a
+                # replica read never activates anything here, so shedding it
+                # (or redirecting to the primary we exist to offload) would
+                # defeat the read scale-out exactly when it matters.
+                served = await self._readscale.try_serve_standby(req, object_id)
+                if served is not None:
+                    return served
             shed = await self._shed_if_overloaded(object_id)
             if shed is not None:
                 return ResponseEnvelope.err(shed)
@@ -284,6 +298,15 @@ class Service:
             mismatch = await self.check_address_mismatch(addr)
             if mismatch is not None:
                 return ResponseEnvelope.err(mismatch)
+            if self._readscale is not None:
+                # This node IS the primary. Under load, divert @readonly
+                # requests to the standby seats (named in the SERVER_BUSY
+                # payload) instead of queueing them on the object's dispatch
+                # lock — the activated-objects-always-served rule above only
+                # holds for writes once reads have somewhere else to go.
+                busy = self._readscale.shed_read(req, object_id, self._load)
+                if busy is not None:
+                    return ResponseEnvelope.err(busy)
 
         start_err = await self.start_service_object(object_id)
         if start_err is not None:

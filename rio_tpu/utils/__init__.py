@@ -1,6 +1,6 @@
 """Small shared utilities (LRU cache, backoff policy, async helpers)."""
 
 from .lru import LruCache
-from .backoff import ExponentialBackoff
+from .backoff import DecorrelatedJitter, ExponentialBackoff
 
-__all__ = ["LruCache", "ExponentialBackoff"]
+__all__ = ["LruCache", "ExponentialBackoff", "DecorrelatedJitter"]

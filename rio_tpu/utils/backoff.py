@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import random
 
 
 @dataclasses.dataclass
@@ -26,3 +27,23 @@ class ExponentialBackoff:
 
     async def sleep(self, attempt: int) -> None:
         await asyncio.sleep(min(self.initial * (self.factor**attempt), self.cap))
+
+
+class DecorrelatedJitter:
+    """Decorrelated-jitter delays (the AWS architecture-blog variant).
+
+    ``next() = min(cap, uniform(base, prev * 3))`` — successive delays are
+    randomized against the PREVIOUS draw, so a thundering herd that shed at
+    the same instant decorrelates after one round instead of re-colliding
+    on every doubling the way pure exponential backoff does. One instance
+    per request (the draw sequence is the per-request state).
+    """
+
+    def __init__(self, base: float = 1e-3, cap: float = 2.0) -> None:
+        self.base = max(1e-9, base)
+        self.cap = cap
+        self._prev = self.base
+
+    def next(self) -> float:
+        self._prev = min(self.cap, random.uniform(self.base, self._prev * 3))
+        return self._prev

@@ -1,0 +1,631 @@
+"""Read scale-out: @readonly marker, bounded-staleness standby serving,
+busy-shed seat hints + client diversion, dynamic replication factor, and
+the defensive decode surfaces the subsystem leans on.
+
+The staleness CONTRACT under test: a standby answers a readonly request
+only while its replica is inside the configured lag/age bounds; outside
+them it transparently proxies to the primary — never an error, never an
+answer beyond the bound.
+"""
+
+import asyncio
+import random
+import time
+
+import pytest
+
+from rio_tpu import (
+    AppData,
+    Client,
+    LocalObjectPlacement,
+    LocalStorage,
+    ReadScaleConfig,
+    ReadScaleManager,
+    Registry,
+    ServiceObject,
+    handler,
+    message,
+    readonly,
+)
+from rio_tpu import codec
+from rio_tpu.cluster.storage import Member
+from rio_tpu.commands import ServerInfo
+from rio_tpu.load import LoadThresholds
+from rio_tpu.migration import ReplicaAppend
+from rio_tpu.object_placement import ObjectPlacementItem, sanitize_standby_row
+from rio_tpu.protocol import RequestEnvelope, decode_response, encode_request_frame
+from rio_tpu.readscale import decode_seat_hint
+from rio_tpu.registry import (
+    READONLY_MESSAGES,
+    ObjectId,
+    is_readonly_message,
+    register_readonly,
+    type_id,
+)
+from rio_tpu.replication import (
+    ReplicaAck,
+    ReplicaFreshness,
+    ReplicationConfig,
+    ReplicationManager,
+)
+from rio_tpu.utils import DecorrelatedJitter
+
+from .server_utils import Cluster, run_integration_test
+
+
+@message
+class CBump:
+    amount: int = 1
+
+
+@message
+class CRead:
+    pass
+
+
+@message
+class CSnap:
+    version: int = 0
+    address: str = ""
+
+
+class Celebrity(ServiceObject):
+    """Replicated hot actor: write bumps a version, readonly read returns it."""
+
+    __replicated__ = True
+
+    def __init__(self):
+        self.version = 0
+
+    def __migrate_state__(self):
+        return {"version": self.version}
+
+    def __restore_state__(self, value):
+        self.version = int(value["version"])
+
+    @handler
+    async def bump(self, msg: CBump, ctx: AppData) -> CSnap:
+        self.version += msg.amount
+        return CSnap(version=self.version, address=ctx.get(ServerInfo).address)
+
+    @readonly
+    @handler
+    async def read(self, msg: CRead, ctx: AppData) -> CSnap:
+        return CSnap(version=self.version, address=ctx.get(ServerInfo).address)
+
+
+def build_registry() -> Registry:
+    return Registry().add_type(Celebrity)
+
+
+TNAME = type_id(Celebrity)
+
+
+# ---------------------------------------------------------------------------
+# @readonly marker
+# ---------------------------------------------------------------------------
+
+
+def test_readonly_marker_registers_through_add_type():
+    r = build_registry()
+    assert r.is_readonly(TNAME, type_id(CRead))
+    assert not r.is_readonly(TNAME, type_id(CBump))
+    spec = r.handler_spec(TNAME, type_id(CRead))
+    assert spec is not None and spec.readonly
+    # add_type published into the process-global set clients route from.
+    assert (TNAME, type_id(CRead)) in READONLY_MESSAGES
+    assert is_readonly_message(TNAME, type_id(CRead))
+    assert not is_readonly_message(TNAME, type_id(CBump))
+
+
+def test_readonly_composes_with_handler_in_either_order():
+    @message(name="readscale_test.Q")
+    class Q:
+        pass
+
+    class A(ServiceObject):
+        @handler
+        @readonly
+        async def under(self, msg: Q, ctx: AppData) -> int:
+            return 0
+
+    class B(ServiceObject):
+        @readonly
+        @handler
+        async def over(self, msg: Q, ctx: AppData) -> int:
+            return 0
+
+    for cls in (A, B):
+        register_readonly(cls)
+        assert is_readonly_message(type_id(cls), type_id(Q))
+
+
+# ---------------------------------------------------------------------------
+# Defensive decode: seat hints and standby rows
+# ---------------------------------------------------------------------------
+
+
+def test_decode_seat_hint_tolerates_garbage():
+    assert decode_seat_hint(b"") == []
+    assert decode_seat_hint(b"\xff\xfe not msgpack") == []
+    assert decode_seat_hint(codec.serialize(42)) == []
+    assert decode_seat_hint(codec.serialize({"not": "a list"})) == []
+    wire = codec.serialize(["ok:1", "noport", 7, None, "h:x", "b:22"])
+    assert decode_seat_hint(wire) == ["ok:1", "b:22"]
+
+
+def test_sanitize_standby_row_contract():
+    assert sanitize_standby_row(["a:1", "b:2"], 3) == (["a:1", "b:2"], 3)
+    # Garbage epoch poisons the fence: whole row degrades to "no standbys".
+    assert sanitize_standby_row(["a:1"], "zz") == ([], 0)
+    assert sanitize_standby_row(["a:1"], None) == ([], 0)
+    assert sanitize_standby_row(["a:1"], -4) == ([], 0)
+    # Malformed members are filtered; the rest of the set survives.
+    assert sanitize_standby_row(["a:1", "noport", 9, b"c:3"], "2") == (
+        ["a:1", "c:3"],
+        2,
+    )
+    assert sanitize_standby_row("a:1,b:2", 1) == ([], 1)  # wrong container
+
+
+@pytest.mark.asyncio
+async def test_garbage_standby_rows_decode_as_no_standbys_local_and_jax(tmp_path):
+    """Every directory backend must degrade a legacy/garbage standby row to
+    ([], 0)-style answers on the read path — never raise."""
+    from rio_tpu.object_placement import LocalObjectPlacement
+    from rio_tpu.object_placement.jax_placement import JaxObjectPlacement
+    from rio_tpu.object_placement.persistent import PersistentJaxObjectPlacement
+    from rio_tpu.object_placement.sqlite import SqliteObjectPlacement
+
+    oid = ObjectId("Svc", "g1")
+
+    local = LocalObjectPlacement()
+    local._standbys[str(oid)] = (["ok:1", "garbage", 7], "not-an-epoch")
+    assert await local.standbys(oid) == ([], 0)
+    local._standbys[str(oid)] = (["ok:1", "garbage"], 2)
+    assert await local.standbys(oid) == (["ok:1"], 2)
+
+    jx = JaxObjectPlacement()
+    await jx.prepare()
+    jx._standby_rows[str(oid)] = ([b"\xff\xfe", "ok:1"], True)
+    assert await jx.standbys(oid) == (["ok:1"], 1)
+
+    pj = PersistentJaxObjectPlacement(
+        SqliteObjectPlacement(str(tmp_path / "pj.db"))
+    )
+    await pj.prepare()
+    pj._standby_rows[str(oid)] = (object(), object())
+    assert await pj.standbys(oid) == ([], 0)
+
+    sq = SqliteObjectPlacement(str(tmp_path / "p.db"))
+    await sq.prepare()
+    # A legacy writer's raw row: epoch TEXT affinity, malformed addresses.
+    await sq.db.execute(
+        "INSERT INTO object_standby (struct_name, object_id, standbys, epoch) "
+        "VALUES (?,?,?,?)",
+        "Svc", "g1", "ok:1,,broken", "oops",
+    )
+    assert await sq.standbys(oid) == ([], 0)
+    await sq.db.execute(
+        "UPDATE object_standby SET epoch=3 WHERE struct_name=? AND object_id=?",
+        "Svc", "g1",
+    )
+    assert await sq.standbys(oid) == (["ok:1"], 3)
+
+
+@pytest.mark.asyncio
+async def test_garbage_standby_rows_decode_as_no_standbys_redis_and_postgres():
+    from rio_tpu.object_placement.postgres import PostgresObjectPlacement
+    from rio_tpu.object_placement.redis import RedisObjectPlacement
+    from rio_tpu.utils.resp import RedisClient
+
+    from tests import fake_pg
+    from tests.fake_redis import FakeRedisServer
+
+    oid = ObjectId("Svc", "g1")
+
+    server = await FakeRedisServer().start()
+    try:
+        client = RedisClient("127.0.0.1", server.port)
+        rp = RedisObjectPlacement(client, key_prefix="t_rs")
+        for raw in (b"garbage-no-bar", b"zz|ok:1", b"\xff\xfe\xfd", b"-3|ok:1"):
+            await client.execute("SET", rp._standby_key(str(oid)), raw)
+            assert await rp.standbys(oid) == ([], 0)
+        await client.execute("SET", rp._standby_key(str(oid)), b"2|ok:1,junk")
+        assert await rp.standbys(oid) == (["ok:1"], 2)
+        client.close()
+    finally:
+        await server.stop()
+
+    fake_pg.install()
+    fake_pg.reset()
+    pg = PostgresObjectPlacement("postgresql://fake-pg/readscale")
+    await pg.prepare()
+    await pg.db.execute(
+        "INSERT INTO object_standby (struct_name, object_id, standbys, epoch) "
+        "VALUES (?,?,?,?)",
+        "Svc", "g1", "junk,ok:1", "NaN-epoch",
+    )
+    assert await pg.standbys(oid) == ([], 0)
+
+
+# ---------------------------------------------------------------------------
+# DecorrelatedJitter
+# ---------------------------------------------------------------------------
+
+
+def test_decorrelated_jitter_bounds_and_decorrelation():
+    j = DecorrelatedJitter(base=1e-3, cap=0.5)
+    prev = 1e-3
+    for _ in range(200):
+        d = j.next()
+        assert 1e-3 <= d <= 0.5
+        assert d <= max(prev * 3, 0.5)
+        prev = d
+    # Two requests shedding at the same instant must not march in lockstep:
+    # independent instances draw different sequences.
+    random.seed(1234)
+    a = [DecorrelatedJitter(base=1e-3, cap=2.0).next() for _ in range(8)]
+    random.seed(1234)
+    j1, j2 = DecorrelatedJitter(base=1e-3, cap=2.0), DecorrelatedJitter(
+        base=1e-3, cap=2.0
+    )
+    seq1 = [j1.next() for _ in range(8)]
+    seq2 = [j2.next() for _ in range(8)]
+    assert seq1 != seq2
+    assert a  # seeded draw above exercised the module-level RNG path
+
+
+# ---------------------------------------------------------------------------
+# ReplicaFreshness + refresh pings
+# ---------------------------------------------------------------------------
+
+
+def test_replica_freshness_lag_and_age():
+    f = ReplicaFreshness(epoch=2, seq=5, head_seq=9, recv_mono=time.monotonic())
+    assert f.lag_seq == 4
+    assert f.age_s() < 0.5
+    assert f.age_s(f.recv_mono + 3.0) == pytest.approx(3.0)
+    # head_seq behind seq (legacy frames) never yields negative lag.
+    g = ReplicaFreshness(seq=5, head_seq=0)
+    assert g.lag_seq == 0
+
+
+def _mgr(address="10.0.0.1:1", placement=None, members=None) -> ReplicationManager:
+    return ReplicationManager(
+        address=address,
+        registry=build_registry(),
+        placement=placement or LocalObjectPlacement(),
+        members_storage=members or LocalStorage(),
+        app_data=AppData(),
+    )
+
+
+def test_apply_append_refresh_ping_updates_freshness_or_nacks():
+    mgr = _mgr()
+    key = (TNAME, "c1")
+
+    def append(**kw):
+        return mgr.apply_append(
+            ReplicaAppend(type_name=TNAME, object_id="c1", **kw)
+        )
+
+    # Ping with no replica held: nack (primary must full-re-ship).
+    nack = append(epoch=1, seq=3, head_seq=3, refresh=True)
+    assert not nack.ok and "refresh" in nack.detail
+    assert mgr.stats.append_nacks == 1
+
+    ok = append(epoch=1, seq=3, payload=b"v3", head_seq=3)
+    assert ok.ok
+    before = mgr.replica_freshness(key)
+    assert before is not None and before.lag_seq == 0
+
+    # Ping for a moved head: freshness (and lag) track it, store untouched.
+    ping = append(epoch=1, seq=5, head_seq=5, refresh=True)
+    assert ping.ok
+    after = mgr.replica_freshness(key)
+    assert after is not None and after.recv_mono >= before.recv_mono
+    assert mgr.replica_entry(key) == (b"v3", 1, 3)
+
+    # Ping from a different epoch (promotion happened): nack with ours.
+    cross = append(epoch=2, seq=5, head_seq=5, refresh=True)
+    assert not cross.ok and cross.epoch == 1
+
+
+@pytest.mark.asyncio
+async def test_refresh_nack_reopens_key_for_full_reship():
+    members = LocalStorage()
+    await members.push(Member(ip="10.0.0.1", port=1, active=True))
+    await members.push(Member(ip="10.0.0.2", port=2, active=True))
+    placement = LocalObjectPlacement()
+    mgr = _mgr(placement=placement, members=members)
+    oid = ObjectId(TNAME, "c1")
+    key = (TNAME, "c1")
+    await placement.update(ObjectPlacementItem(oid, "10.0.0.1:1"))
+    await placement.set_standbys(oid, ["10.0.0.2:2"])
+    mgr._last_shipped[key] = b"v3"
+    mgr._seq[key] = 3
+
+    sent: list[ReplicaAppend] = []
+    acks = [ReplicaAck(ok=True, epoch=0)]
+
+    async def fake_append(addr, msg):
+        sent.append(msg)
+        return acks[0]
+
+    mgr._append_to = fake_append
+
+    await mgr.refresh_standbys(oid)
+    assert mgr.stats.refreshes == 1 and mgr.stats.refresh_nacks == 0
+    assert sent[-1].refresh and sent[-1].payload == b""
+    assert sent[-1].seq == 3 and sent[-1].head_seq == 3
+    assert key in mgr._last_shipped
+
+    # Standby lost the replica (restart): nacked ping reopens the key so
+    # the next anti-entropy round re-ships the full payload.
+    acks[0] = ReplicaAck(ok=False, detail="no replica for refresh")
+    await mgr.refresh_standbys(oid)
+    assert mgr.stats.refresh_nacks == 1
+    assert key not in mgr._last_shipped and key in mgr._dirty
+
+
+# ---------------------------------------------------------------------------
+# Dynamic replication factor (deterministic, no cluster)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_dynamic_k_ramps_to_kmax_and_decays_to_kmin_epoch_fenced():
+    members = LocalStorage()
+    for i in range(1, 6):
+        await members.push(Member(ip="10.0.0.%d" % i, port=i, active=True))
+    placement = LocalObjectPlacement()
+    registry = build_registry()
+    self_addr = "10.0.0.1:1"
+    repl = ReplicationManager(
+        address=self_addr,
+        registry=registry,
+        placement=placement,
+        members_storage=members,
+        app_data=AppData(),
+        config=ReplicationConfig(k=1),
+    )
+    mgr = ReadScaleManager(
+        address=self_addr,
+        registry=registry,
+        replication=repl,
+        placement=placement,
+        members_storage=members,
+        app_data=AppData(),
+        config=ReadScaleConfig(hot_rate=100.0, k_min=1, k_max=3),
+    )
+    oid = ObjectId(TNAME, "hot")
+    key = (TNAME, "hot")
+    registry.insert(TNAME, "hot", registry.new_from_type(TNAME, "hot"))
+    await placement.update(ObjectPlacementItem(oid, self_addr))
+
+    async def seats():
+        held, epoch = await placement.standbys(oid)
+        assert self_addr not in held, "primary/standby co-location"
+        return held, epoch
+
+    # Cold key: baseline k, one transition to seat the initial standby set
+    # never fires (rate 0 -> target == current k).
+    assert await mgr.hotness_tick({str(oid): 0.0}) == 0
+    assert repl.replica_k(key) == 1
+
+    # Rate storm: ramp straight to k_max, seats topped up, epoch untouched.
+    assert await mgr.hotness_tick({str(oid): 250.0}) == 1
+    assert repl.replica_k(key) == 3 and mgr.stats.k_raises == 1
+    held, epoch = await seats()
+    assert len(held) == 3 and len(set(held)) == 3 and epoch == 0
+
+    # Same storm again: steady state, no churn.
+    assert await mgr.hotness_tick({str(oid): 260.0}) == 0
+
+    # Cooling: one seat per tick, only under the hysteresis margin.
+    assert await mgr.hotness_tick({str(oid): 40.0}) == 1
+    assert repl.replica_k(key) == 2 and mgr.stats.k_lowers == 1
+    held, epoch = await seats()
+    assert len(held) == 2 and epoch == 0
+
+    assert await mgr.hotness_tick({str(oid): 10.0}) == 1
+    assert repl.replica_k(key) == 1 and mgr.stats.k_lowers == 2
+    held, epoch = await seats()
+    assert len(held) == 1 and epoch == 0
+
+    # Floor: never below k_min, no transition churn at idle.
+    assert await mgr.hotness_tick({str(oid): 0.0}) == 0
+    assert repl.replica_k(key) == 1
+    assert mgr.gauges()[f"rio.read_scale.replica_k.{TNAME}.hot"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Live cluster: standby serves fresh, forwards stale, sheds with seats
+# ---------------------------------------------------------------------------
+
+
+async def _raw_read(address: str, object_id: str):
+    """One readonly request over a raw framed connection to ``address``."""
+    from rio_tpu.client import _ServerConns
+
+    pool = _ServerConns(address, 1, 2.0)
+    try:
+        req = RequestEnvelope(
+            TNAME, object_id, type_id(CRead), codec.serialize(CRead())
+        )
+        conn = await pool.acquire()
+        try:
+            raw = await conn.roundtrip(encode_request_frame(req))
+        finally:
+            pool.release(conn, reuse=True)
+        resp = decode_response(raw)
+        assert resp.is_ok, resp.error
+        return codec.deserialize(resp.body, CSnap)
+    finally:
+        pool.close()
+
+
+def test_standby_serves_fresh_read_and_forwards_stale():
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        try:
+            out = await client.send(Celebrity, "c1", CBump(amount=1), returns=CSnap)
+            primary_addr = out.address
+            held, _ = await cluster.placement.standbys(ObjectId(TNAME, "c1"))
+            assert held and primary_addr not in held
+            standby = next(
+                s for s in cluster.servers if s.local_address == held[0]
+            )
+            key = (TNAME, "c1")
+            assert standby.replication_manager.replica_entry(key) is not None
+
+            # Fresh replica: the standby answers locally, never touching the
+            # primary, and the answer reflects every acked write.
+            snap = await _raw_read(standby.local_address, "c1")
+            assert snap.version == 1
+            assert snap.address == standby.local_address
+            assert standby.read_scale_manager.stats.standby_reads == 1
+            assert standby.read_scale_manager.stats.standby_forwards == 0
+
+            # Age the replica past the bound: the SAME request now proxies
+            # to the primary — an up-to-date answer, not an error.
+            meta = standby.replication_manager._replica_meta[key]
+            meta.recv_mono -= 60.0
+            snap = await _raw_read(standby.local_address, "c1")
+            assert snap.version == 1
+            assert snap.address == primary_addr
+            assert standby.read_scale_manager.stats.stale_refusals == 1
+            assert standby.read_scale_manager.stats.standby_forwards == 1
+
+            # A new acked write re-freshens the replica (ship-on-ack):
+            # standby serving resumes at the new version.
+            await client.send(Celebrity, "c1", CBump(amount=1), returns=CSnap)
+            snap = await _raw_read(standby.local_address, "c1")
+            assert snap.version == 2
+            assert snap.address == standby.local_address
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_registry,
+            num_servers=3,
+            server_kwargs={
+                "replication_config": ReplicationConfig(
+                    k=1, anti_entropy_interval=0.2, seat_ttl=0.2
+                ),
+                "read_scale_config": ReadScaleConfig(max_staleness_s=5.0),
+            },
+        )
+    )
+
+
+def test_hot_primary_sheds_reads_to_seats_and_client_diverts():
+    async def body(cluster: Cluster):
+        client = cluster.client(read_scale=ReadScaleConfig())
+        try:
+            out = await client.send(Celebrity, "h1", CBump(amount=1), returns=CSnap)
+            primary_addr = out.address
+            primary = next(
+                s for s in cluster.servers if s.local_address == primary_addr
+            )
+            held, _ = await cluster.placement.standbys(ObjectId(TNAME, "h1"))
+            assert held and primary_addr not in held
+            key = (TNAME, "h1")
+
+            # Prime the primary's seat cache (shed_read is cache-only), then
+            # make it shed everything.
+            await client.send(Celebrity, "h1", CRead(), returns=CSnap)
+            assert key in primary.replication_manager._seats
+            primary.load_monitor.thresholds = LoadThresholds(max_inflight=-1)
+
+            snap = await client.send(Celebrity, "h1", CRead(), returns=CSnap)
+            # The shed named the standby seats; the client diverted there
+            # and the standby served from its replica.
+            assert snap.address in held
+            assert snap.version == 1
+            assert client.stats.busy_retries == 1
+            assert client.stats.standby_routes >= 1
+            assert primary.read_scale_manager.stats.read_sheds == 1
+            # The primary row stays cached — it is still the write target.
+            assert client._placement.get(key) == primary_addr
+
+            # Later reads ride the cached seat hint straight to the standby
+            # (no second busy bounce off the primary).
+            routes = client.stats.standby_routes
+            snap = await client.send(Celebrity, "h1", CRead(), returns=CSnap)
+            assert snap.address in held
+            assert client.stats.busy_retries == 1
+            assert client.stats.standby_routes > routes
+
+            # Writes are never diverted: they go to the primary and still
+            # succeed (the generic shed skips activated objects).
+            out = await client.send(Celebrity, "h1", CBump(amount=1), returns=CSnap)
+            assert out.address == primary_addr and out.version == 2
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_registry,
+            num_servers=3,
+            server_kwargs={
+                "replication_config": ReplicationConfig(
+                    k=2, anti_entropy_interval=0.2, seat_ttl=60.0
+                ),
+                "read_scale_config": ReadScaleConfig(max_staleness_s=5.0),
+            },
+        )
+    )
+
+
+def test_many_concurrent_busy_clients_all_complete_with_jitter():
+    """Regression for the decorrelated-jitter backoff: a whole fleet of
+    clients shed at the same instant must drain once capacity returns —
+    no lockstep retry storm starving a subset into RetryExhausted."""
+
+    async def body(cluster: Cluster):
+        from rio_tpu.utils.backoff import ExponentialBackoff
+
+        for s in cluster.servers:
+            s.load_monitor.thresholds = LoadThresholds(max_inflight=-1)
+
+        clients = [
+            cluster.client(backoff=ExponentialBackoff(initial=2e-3, cap=0.25))
+            for _ in range(8)
+        ]
+        try:
+            async def one(ci: int, ri: int):
+                c = clients[ci]
+                return await c.send(
+                    Celebrity, f"m{ci}.{ri}", CBump(amount=1), returns=CSnap
+                )
+
+            tasks = [
+                asyncio.create_task(one(ci, ri))
+                for ci in range(len(clients))
+                for ri in range(3)
+            ]
+            # Every request is busy-shed (nothing is activated while every
+            # node refuses admission) ... until capacity "returns".
+            await asyncio.sleep(0.1)
+            for s in cluster.servers:
+                s.load_monitor.thresholds = LoadThresholds()
+            outs = await asyncio.gather(*tasks)
+            assert all(o.version == 1 for o in outs)
+            assert sum(c.stats.busy_retries for c in clients) > 0
+        finally:
+            for c in clients:
+                c.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_registry,
+            num_servers=2,
+            server_kwargs={"replication_config": ReplicationConfig(k=1)},
+        )
+    )
