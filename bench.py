@@ -1406,6 +1406,31 @@ def hotkey_scaleout() -> dict:
     return out
 
 
+def tracing_overhead() -> dict:
+    """RPC-loop cost of the observability layer, A/B/C'd in the SAME
+    session: spans disabled (pre-observability hot path) vs the
+    shipping default (histogram record only, sampling 0) vs everything on
+    (sample rate 1.0 + live sink). The overhead percentages are the stable
+    artifact; absolute msgs/sec drift with the box like every host-stage
+    number."""
+    import asyncio
+
+    from rio_tpu.utils.tracing_live import measure_tracing_overhead
+
+    out = asyncio.run(measure_tracing_overhead())
+    m = out["msgs_per_sec"]
+    print(
+        f"# tracing overhead ({out['batches']} interleaved batches x "
+        f"{out['n_requests_per_batch']} reqs, 2 servers/mode, median "
+        f"paired ratio): disabled {m['disabled']:,.0f}/s, record-only "
+        f"{m['record']:,.0f}/s ({out['record_overhead_pct']:+}%), "
+        f"sampled@1.0+sink {m['sampled']:,.0f}/s "
+        f"({out['sampled_overhead_pct']:+}%)",
+        file=sys.stderr,
+    )
+    return out
+
+
 _TPU_PLATFORMS = os.environ.get("JAX_PLATFORMS")  # as the driver launched us
 
 
@@ -1743,6 +1768,10 @@ def main() -> None:
     except Exception as e:
         print(f"# hot-key scale-out failed: {e!r}", file=sys.stderr)
     try:
+        detail["tracing"] = tracing_overhead()
+    except Exception as e:
+        print(f"# tracing overhead failed: {e!r}", file=sys.stderr)
+    try:
         detail["scaled_routing"] = scaled_route_hops()
     except Exception as e:
         print(f"# scaled routing failed: {e!r}", file=sys.stderr)
@@ -1882,6 +1911,9 @@ if __name__ == "__main__":
     # Rehearse the hot-key read scale-out host stage alone (same CPU-safe
     # in-process-cluster shape as --migration).
     parser.add_argument("--hotkey", action="store_true")
+    # Rehearse the tracing/metrics overhead A/B alone (same CPU-safe
+    # in-process-cluster shape as --migration).
+    parser.add_argument("--tracing", action="store_true")
     args = parser.parse_args()
     if args.migration:
         _pin_orchestrator_to_cpu()
@@ -1889,6 +1921,9 @@ if __name__ == "__main__":
     elif args.hotkey:
         _pin_orchestrator_to_cpu()
         print(json.dumps(hotkey_scaleout()))
+    elif args.tracing:
+        _pin_orchestrator_to_cpu()
+        print(json.dumps(tracing_overhead()))
     elif args.tier is not None and args.hier:
         run_hier_tier(args.tier, args.deadline, args.platform)
     elif args.tier is not None and args.collapsed:

@@ -57,10 +57,48 @@ from rio_tpu import (
     message,
 )
 from rio_tpu import tracing
+from rio_tpu.admin import ADMIN_TYPE, DumpStats, StatsSnapshot
 from rio_tpu.cluster.membership_protocol import LocalClusterProvider
+from rio_tpu.metrics import merge_rows
 from rio_tpu.otel import server_gauges
 
 gauge_log = logging.getLogger("rio_tpu.examples.gauges")
+
+
+async def cluster_scrape(client: "Client", members) -> None:
+    """Scrape every node over the wire and merge the RED histograms.
+
+    The cluster-wide analogue of :func:`gauge_reader`: walk the membership
+    view, ask each node's ``rio.Admin`` actor for its
+    :class:`~rio_tpu.admin.StatsSnapshot` (one round trip per node), then
+    :func:`~rio_tpu.metrics.merge_rows` the histogram rows so the printed
+    p50/p99 are CLUSTER quantiles, not per-node ones. Exemplar trace ids
+    ride each top bucket — paste one into the span table/Jaeger to jump
+    from "p99 is slow" to the exact request that was.
+    """
+    snapshots: list[StatsSnapshot] = []
+    for member in await members.active_members():
+        snap = await client.send(
+            ADMIN_TYPE, member.address, DumpStats(), returns=StatsSnapshot
+        )
+        snapshots.append(snap)
+        print(
+            f"[scrape] {snap.address}: {len(snap.gauges)} gauges, "
+            f"{len(snap.histograms)} handler histograms"
+        )
+    merged = merge_rows([s.histograms for s in snapshots])
+    print(f"\n[scrape] cluster-wide RED quantiles ({len(snapshots)} nodes):")
+    print(f"{'handler.message':<34}{'count':>6}{'err':>5}{'p50 ms':>9}{'p99 ms':>9}")
+    for (ht, mt), h in sorted(merged.items()):
+        print(
+            f"{ht + '.' + mt:<34}{h.count:>6}{h.error_count:>5}"
+            f"{h.quantile(0.5) * 1e3:>9.3f}{h.quantile(0.99) * 1e3:>9.3f}"
+        )
+        if h.exemplar_trace:
+            print(
+                f"    exemplar: trace {h.exemplar_trace[:16]}… "
+                f"({h.exemplar_s * 1e3:.3f} ms)"
+            )
 
 
 async def gauge_reader(servers: list, interval: float = 0.5) -> None:
@@ -149,6 +187,9 @@ async def main() -> None:
     aggregator = SpanAggregator()
     tracing.add_sink(tracing.logging_sink)
     tracing.add_sink(aggregator)
+    # Head-based sampling: every client request roots a trace_ctx that the
+    # wire then propagates server-side (1.0 here so the demo traces all).
+    tracing.set_sample_rate(1.0)
 
     members = LocalStorage()
     placement = LocalObjectPlacement()
@@ -170,8 +211,12 @@ async def main() -> None:
     client = Client(members)
     for i in range(50):
         await client.send(Worker, f"w{i % 5}", Work(item=f"job-{i}"), returns=Ack)
-    client.close()
     await asyncio.sleep(0.1)  # let the gauge reader log the final deltas
+
+    # Wire scrape: DUMP_STATS every node via its rio.Admin actor and merge
+    # the per-handler histograms into cluster-wide quantiles + exemplars.
+    await cluster_scrape(client, members)
+    client.close()
 
     for t in tasks:
         t.cancel()
@@ -181,6 +226,7 @@ async def main() -> None:
     aggregator.report()
     aggregator.show_one_trace()
     tracing.clear_sinks()
+    tracing.set_sample_rate(0.0)
     print("[demo] done")
 
 

@@ -298,6 +298,32 @@ uint8_t* rn_encode_request_frame(const uint8_t* ht, uint32_t htl,
   return finish_frame(w, out_len);
 }
 
+// Traced variant: payload = 0x00 kind byte + msgpack [handler_type,
+// handler_id, message_type, payload, [trace_id, span_id, sampled]] — the
+// appended wire-safe trace_ctx field (protocol.py RequestEnvelope). The
+// untraced encoder above stays byte-identical to the legacy 4-element
+// layout; tests/test_native.py pins parity for both arities.
+uint8_t* rn_encode_request_frame_traced(const uint8_t* ht, uint32_t htl,
+                                        const uint8_t* hid, uint32_t hidl,
+                                        const uint8_t* mt, uint32_t mtl,
+                                        const uint8_t* pay, uint32_t pl,
+                                        const uint8_t* tid, uint32_t tidl,
+                                        const uint8_t* sid, uint32_t sidl,
+                                        int32_t sampled, uint32_t* out_len) {
+  Writer w;
+  w.u8(0x00);
+  w.fixarray(5);
+  w.str(ht, htl);
+  w.str(hid, hidl);
+  w.str(mt, mtl);
+  w.bin(pay, pl);
+  w.fixarray(3);
+  w.str(tid, tidl);
+  w.str(sid, sidl);
+  w.boolean(sampled != 0);
+  return finish_frame(w, out_len);
+}
+
 // Frame payload = 0x01 kind byte + msgpack [handler_type, handler_id].
 uint8_t* rn_encode_subscribe_frame(const uint8_t* ht, uint32_t htl,
                                    const uint8_t* hid, uint32_t hidl,
@@ -364,16 +390,29 @@ uint8_t* rn_encode_subresponse_err_frame(uint32_t kind, const uint8_t* detail,
 
 // Server-side decode of one frame payload (kind byte + body).
 // Returns 0 = request (offs/lens[0..3] = handler_type, handler_id,
-// message_type, payload), 1 = subscribe (offs/lens[0..1]), -1 = malformed.
+// message_type, payload; a 5-element frame additionally fills [4] =
+// trace_id, [5] = span_id and sets *sampled to 0/1 — *sampled stays -1 on
+// the legacy 4-element layout), 1 = subscribe (offs/lens[0..1]),
+// -1 = malformed. offs/lens must hold 6 slots.
 int rn_decode_inbound(const uint8_t* buf, uint32_t len, uint32_t* offs,
-                      uint32_t* lens) {
+                      uint32_t* lens, int32_t* sampled) {
   if (len == 0) return -1;
+  *sampled = -1;
   Parser pr(buf, len);
   uint8_t kind = *pr.p++;
   if (kind == 0x00) {
-    if (pr.array_header() != 4) return -1;
+    int n = pr.array_header();
+    if (n != 4 && n != 5) return -1;
     for (int i = 0; i < 4; ++i)
       if (!pr.str_or_bin(&offs[i], &lens[i])) return -1;
+    if (n == 5) {
+      if (pr.array_header() != 3) return -1;
+      if (!pr.str_or_bin(&offs[4], &lens[4])) return -1;
+      if (!pr.str_or_bin(&offs[5], &lens[5])) return -1;
+      bool s;
+      if (!pr.boolean(&s)) return -1;
+      *sampled = s ? 1 : 0;
+    }
     return 0;
   }
   if (kind == 0x01) {

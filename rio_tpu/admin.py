@@ -1,0 +1,125 @@
+"""Node-scoped admin/observability actor: the wire face of the ops plane.
+
+Two services behind one ``rio.Admin`` actor per node (``__node_scoped__``,
+id = the node's address, routed without the directory exactly like the
+migration control plane):
+
+* :class:`DumpStats` → :class:`StatsSnapshot` — the cluster scrape. One
+  round trip returns the node's full :func:`rio_tpu.otel.server_gauges`
+  snapshot plus its raw RED histogram rows
+  (:meth:`rio_tpu.metrics.MetricsRegistry.snapshot_rows`), which are
+  mergeable across nodes — a scraper walks the membership view, asks every
+  node, and :func:`rio_tpu.metrics.merge_rows` yields cluster-wide
+  p50/p99 (see ``examples/observability.py``).
+* :class:`AdminRequest` → :class:`AdminAck` — a remote bridge onto the
+  in-process :class:`~rio_tpu.commands.AdminSender` queue (drain this
+  node, migrate an object, shut an object down) so ops tooling needs only
+  a :class:`~rio_tpu.client.Client`.
+
+The gauge/histogram sources are injected at ``Server.bind()`` as a
+:class:`StatsSource` — the actor itself stays free of server imports.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from .app_data import AppData
+from .commands import AdminCommand, AdminCommandKind, AdminSender
+from .registry import handler, message, type_name
+from .service_object import ServiceObject
+
+#: Wire type-name of the node-scoped admin actor.
+ADMIN_TYPE = "rio.Admin"
+
+
+@message(name="rio.DumpStats")
+@dataclass
+class DumpStats:
+    """Ask a node for its gauge + RED-histogram snapshot."""
+
+    # Histograms dominate the payload on wide deployments; a pure-gauge
+    # scrape can skip them.
+    include_histograms: bool = True
+
+
+@message(name="rio.StatsSnapshot")
+@dataclass
+class StatsSnapshot:
+    """One node's observability snapshot (mergeable across nodes)."""
+
+    address: str = ""
+    gauges: dict[str, float] = field(default_factory=dict)
+    # rio_tpu.metrics wire rows: [handler_type, message_type, count,
+    # error_count, errors{kind:int}, buckets[], sum_s, max_s,
+    # exemplar_trace, exemplar_s] — merge with metrics.merge_rows.
+    histograms: list = field(default_factory=list)
+
+
+@message(name="rio.AdminRequest")
+@dataclass
+class AdminRequest:
+    """Enqueue one :class:`~rio_tpu.commands.AdminCommand` on the node."""
+
+    kind: str = ""  # an AdminCommandKind value, e.g. "drain_server"
+    type_name: str = ""
+    object_id: str = ""
+    target: str = ""
+
+
+@message(name="rio.AdminAck")
+@dataclass
+class AdminAck:
+    ok: bool = False
+    detail: str = ""
+
+
+@dataclass
+class StatsSource:
+    """AppData-injectable snapshot providers (wired at ``Server.bind()``).
+
+    ``gauges`` returns the :func:`~rio_tpu.otel.server_gauges` dict;
+    ``histogram_rows`` returns the mergeable RED rows (empty when metrics
+    are disabled). A dataclass wrapper — not bare callables — so AppData's
+    type-keyed map can hold it.
+    """
+
+    gauges: Callable[[], dict[str, float]]
+    histogram_rows: Callable[[], list[Any]]
+
+
+@type_name(ADMIN_TYPE)
+class AdminControl(ServiceObject):
+    """Node-scoped observability/ops endpoint (one per server; id = address)."""
+
+    __node_scoped__ = True
+
+    @handler
+    async def dump_stats(self, msg: DumpStats, ctx: AppData) -> StatsSnapshot:
+        from .commands import ServerInfo
+
+        info = ctx.try_get(ServerInfo)
+        source = ctx.try_get(StatsSource)
+        if source is None:
+            return StatsSnapshot(address=info.address if info else "")
+        rows = source.histogram_rows() if msg.include_histograms else []
+        return StatsSnapshot(
+            address=info.address if info else "",
+            gauges=source.gauges(),
+            histograms=rows,
+        )
+
+    @handler
+    async def admin(self, msg: AdminRequest, ctx: AppData) -> AdminAck:
+        sender = ctx.try_get(AdminSender)
+        if sender is None:
+            return AdminAck(ok=False, detail="no admin queue on this node")
+        try:
+            kind = AdminCommandKind(msg.kind)
+        except ValueError:
+            return AdminAck(ok=False, detail=f"unknown admin kind {msg.kind!r}")
+        sender.send(
+            AdminCommand(kind, msg.type_name, msg.object_id, msg.target)
+        )
+        return AdminAck(ok=True)

@@ -36,6 +36,13 @@ from ..protocol import (
     encode_subscribe_frame,
 )
 from ..registry import MESSAGE_TYPES, decode_error, is_readonly_message, type_id
+from ..tracing import (
+    head_sampled,
+    new_span_id,
+    new_trace_id,
+    outbound_ctx,
+    span,
+)
 from ..utils import DecorrelatedJitter, ExponentialBackoff, LruCache
 
 log = logging.getLogger("rio_tpu.client")
@@ -344,7 +351,53 @@ class Client:
     async def send_raw(
         self, handler_type: str, handler_id: str, message_type: str, payload: bytes
     ) -> bytes:
-        env = RequestEnvelope(handler_type, handler_id, message_type, payload)
+        # Trace-context resolution, cheapest case first: with no active
+        # trace and sampling off this is two function calls, then straight
+        # into the untraced (legacy-wire-identical) path.
+        ctx = outbound_ctx()
+        if ctx is not None:
+            # Already inside a trace (a server-side forward, or application
+            # code under a span): forward it — never re-sample.
+            return await self._send_raw(
+                handler_type, handler_id, message_type, payload, ctx
+            )
+        if not head_sampled():
+            return await self._send_raw(
+                handler_type, handler_id, message_type, payload, None
+            )
+        from .. import tracing
+
+        if tracing._ENABLED:
+            # A sink is registered: root a real client span so the trace
+            # has its client-side timing, and propagate its ids.
+            with span("client_request", object=handler_type, id=handler_id):
+                return await self._send_raw(
+                    handler_type, handler_id, message_type, payload, outbound_ctx()
+                )
+        # Sampled but unsinked locally (e.g. only servers export): ship
+        # fresh ids without allocating a Span.
+        return await self._send_raw(
+            handler_type,
+            handler_id,
+            message_type,
+            payload,
+            (new_trace_id(), new_span_id(), True),
+        )
+
+    async def _send_raw(
+        self,
+        handler_type: str,
+        handler_id: str,
+        message_type: str,
+        payload: bytes,
+        trace_ctx: tuple[str, str, bool] | None,
+    ) -> bytes:
+        env = RequestEnvelope(
+            handler_type, handler_id, message_type, payload, trace_ctx
+        )
+        # Encoded ONCE before the retry loop: redirect-follow and busy
+        # retries reuse the same frame, so one trace_ctx spans every hop
+        # this request takes.
         frame_bytes = encode_request_frame(env)
         key = (handler_type, handler_id)
         self.stats.requests += 1

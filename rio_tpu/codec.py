@@ -185,7 +185,10 @@ def _from_wire(wire: Any, ty: Any) -> Any:
         kwargs = {
             name: _from_wire(v, hint) for (name, hint), v in zip(schema, wire)
         }
-        return ty(**kwargs)
+        try:
+            return ty(**kwargs)
+        except TypeError as e:  # wire too short for the required fields
+            raise SerializationError(f"{ty.__name__}: {e}") from e
     if ty is float and isinstance(wire, int):
         return float(wire)
     if ty is bytes and isinstance(wire, str):
@@ -209,7 +212,8 @@ _DC_DECODERS: dict[type, Any] = {}  # type -> decoder fn, or None (ineligible)
 
 def _compile_dc_decoder(ty: type):
     """Build a positional decoder for ``ty``; None when ineligible."""
-    if any(not f.init or f.kw_only for f in dataclasses.fields(ty)):
+    flds = dataclasses.fields(ty)
+    if any(not f.init or f.kw_only for f in flds):
         return None  # generic path passes kwargs; keep it for exotic shapes
     try:
         schema = _dc_schema(ty)
@@ -221,41 +225,64 @@ def _compile_dc_decoder(ty: type):
         "_fw": _from_wire,
         "_isinstance": isinstance,
     }
-    lines = [
-        "def _dec(w):",
-        f"    if len(w) != {len(schema)}:",
-        "        return _fw(w, _ty)",  # schema evolution / arity errors
-    ]
-    args = []
-    for i, (name, hint) in enumerate(schema):
+
+    def field_lines(i: int, hint: Any) -> list[str]:
+        """Unindented decode statements assigning ``v{i}`` from ``w[{i}]``."""
         v = f"v{i}"
-        args.append(v)
         if hint is Any or hint is None or hint is _NONE_TYPE:
-            lines.append(f"    {v} = w[{i}]")
-        elif hint in (int, str, bool):
+            return [f"{v} = w[{i}]"]
+        if hint in (int, str, bool):
             ns[f"_h{i}"] = hint
-            lines.append(f"    {v} = w[{i}]")
-            lines.append(
-                f"    if not _isinstance({v}, _h{i}):"
-                f" raise _SE('expected {hint.__name__}, got %s' % type({v}).__name__)"
-            )
-        elif hint is float:
-            lines.append(f"    {v} = w[{i}]")
-            lines.append(f"    if _isinstance({v}, int): {v} = float({v})")
-            lines.append(
-                f"    elif not _isinstance({v}, float):"
-                f" raise _SE('expected float, got %s' % type({v}).__name__)"
-            )
-        elif hint is bytes:
-            lines.append(f"    {v} = w[{i}]")
-            lines.append(
-                f"    if not _isinstance({v}, bytes):\n"
-                f"        if _isinstance({v}, str): {v} = {v}.encode()\n"
-                f"        else: raise _SE('expected bytes, got %s' % type({v}).__name__)"
-            )
-        else:  # nested dataclass / container / union / enum → generic walker
-            ns[f"_h{i}"] = hint
-            lines.append(f"    {v} = _fw(w[{i}], _h{i})")
+            return [
+                f"{v} = w[{i}]",
+                f"if not _isinstance({v}, _h{i}):"
+                f" raise _SE('expected {hint.__name__}, got %s' % type({v}).__name__)",
+            ]
+        if hint is float:
+            return [
+                f"{v} = w[{i}]",
+                f"if _isinstance({v}, int): {v} = float({v})",
+                f"elif not _isinstance({v}, float):"
+                f" raise _SE('expected float, got %s' % type({v}).__name__)",
+            ]
+        if hint is bytes:
+            return [
+                f"{v} = w[{i}]",
+                f"if not _isinstance({v}, bytes):",
+                f"    if _isinstance({v}, str): {v} = {v}.encode()",
+                f"    else: raise _SE('expected bytes, got %s' % type({v}).__name__)",
+            ]
+        # nested dataclass / container / union / enum → generic walker
+        ns[f"_h{i}"] = hint
+        return [f"{v} = _fw(w[{i}], _h{i})"]
+
+    # Trailing fields with plain (non-factory) defaults may be absent on the
+    # wire — the appended-field evolution rule. Handling that HERE keeps a
+    # legacy short frame on the compiled fast path: falling back to the
+    # generic walker for every old-format message would tax exactly the
+    # mixed-version windows where decode throughput matters.
+    total = len(schema)
+    required = total
+    while required > 0 and flds[required - 1].default is not dataclasses.MISSING:
+        required -= 1
+    lines = ["def _dec(w):", "    n = len(w)"]
+    if required == total:
+        lines.append(f"    if n != {total}:")
+    else:
+        lines.append(f"    if n > {total} or n < {required}:")
+    lines.append("        return _fw(w, _ty)")  # arity errors
+    args = []
+    for i, (_name, hint) in enumerate(schema):
+        args.append(f"v{i}")
+        body = field_lines(i, hint)
+        if i < required:
+            lines.extend("    " + ln for ln in body)
+        else:
+            ns[f"_d{i}"] = flds[i].default
+            lines.append(f"    if n > {i}:")
+            lines.extend("        " + ln for ln in body)
+            lines.append("    else:")
+            lines.append(f"        v{i} = _d{i}")
     lines.append(f"    return _ty({', '.join(args)})")
     exec("\n".join(lines), ns)  # noqa: S102 — trusted, schema-derived source
     return ns["_dec"]

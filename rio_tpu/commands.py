@@ -19,6 +19,10 @@ class AdminCommandKind(Enum):
     SHUTDOWN_OBJECT = "shutdown_object"
     DRAIN_SERVER = "drain_server"
     MIGRATE_OBJECT = "migrate_object"
+    # Observability scrape: log (in-process queue) or return (over the wire
+    # via the node-scoped rio.Admin actor, rio_tpu/admin.py) this node's
+    # gauge + RED-histogram snapshot.
+    DUMP_STATS = "dump_stats"
 
 
 @dataclasses.dataclass
@@ -48,6 +52,12 @@ class AdminCommand:
         return cls(AdminCommandKind.SHUTDOWN_OBJECT, type_name, object_id)
 
     @classmethod
+    def dump_stats(cls) -> "AdminCommand":
+        """Log this node's gauge + histogram snapshot (the in-process twin
+        of the wire scrape served by ``rio.Admin``)."""
+        return cls(AdminCommandKind.DUMP_STATS)
+
+    @classmethod
     def migrate(cls, type_name: str, object_id: str, target: str) -> "AdminCommand":
         """Hand one locally-seated object to ``target`` through the full
         migration protocol (pin → deactivate → snapshot → flip → fence) —
@@ -74,6 +84,10 @@ class SendCommand:
     message_type: str
     payload: bytes
     response: asyncio.Future
+    # Captured at enqueue time: the consumer task replays the request from
+    # its OWN context, so the sender's trace would otherwise die at the
+    # queue boundary.
+    trace_ctx: tuple | None = None
 
 
 class InternalClientSender:
@@ -92,8 +106,15 @@ class InternalClientSender:
         self, handler_type: str, handler_id: str, message_type: str, payload: bytes
     ) -> bytes:
         """Enqueue a request and await the (serialized) response."""
+        from .tracing import outbound_ctx
+
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self.queue.put_nowait(SendCommand(handler_type, handler_id, message_type, payload, fut))
+        self.queue.put_nowait(
+            SendCommand(
+                handler_type, handler_id, message_type, payload, fut,
+                trace_ctx=outbound_ctx(),
+            )
+        )
         return await fut
 
 

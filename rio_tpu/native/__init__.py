@@ -103,7 +103,14 @@ class NativeLib:
             fn.argtypes = [_U32, ctypes.c_char_p, _U32, ctypes.c_char_p, _U32, _U32P]
             fn.restype = _U8P
 
-        dll.rn_decode_inbound.argtypes = [ctypes.c_char_p, _U32, _U32P, _U32P]
+        dll.rn_encode_request_frame_traced.argtypes = (
+            [ctypes.c_char_p, _U32] * 6 + [ctypes.c_int32, _U32P]
+        )
+        dll.rn_encode_request_frame_traced.restype = _U8P
+
+        dll.rn_decode_inbound.argtypes = [
+            ctypes.c_char_p, _U32, _U32P, _U32P, ctypes.POINTER(ctypes.c_int32),
+        ]
         dll.rn_decode_inbound.restype = ctypes.c_int
         for name in ("rn_decode_response", "rn_decode_subresponse"):
             fn = getattr(dll, name)
@@ -162,6 +169,20 @@ class NativeLib:
             raise SerializationError("rn_encode_request_frame: frame too large")
         return self._take(ptr, n.value)
 
+    def encode_request_frame_traced(
+        self, ht: bytes, hid: bytes, mt: bytes, payload: bytes,
+        trace_id: bytes, span_id: bytes, sampled: bool,
+    ) -> bytes:
+        n = _U32(0)
+        ptr = self._dll.rn_encode_request_frame_traced(
+            ht, len(ht), hid, len(hid), mt, len(mt), payload, len(payload),
+            trace_id, len(trace_id), span_id, len(span_id),
+            1 if sampled else 0, ctypes.byref(n),
+        )
+        if not ptr:
+            raise SerializationError("rn_encode_request_frame_traced: frame too large")
+        return self._take(ptr, n.value)
+
     def encode_subscribe_frame(self, ht: bytes, hid: bytes) -> bytes:
         n = _U32(0)
         ptr = self._dll.rn_encode_subscribe_frame(ht, len(ht), hid, len(hid), ctypes.byref(n))
@@ -204,14 +225,26 @@ class NativeLib:
         return self._take(ptr, n.value)
 
     def decode_inbound(self, payload: bytes):
-        """Returns ``(0, ht, hid, mt, body)`` | ``(1, ht, hid)`` | None."""
-        offs = (_U32 * 4)()
-        lens = (_U32 * 4)()
-        rc = self._dll.rn_decode_inbound(payload, len(payload), offs, lens)
+        """Returns ``(0, ht, hid, mt, body)`` (traced frames append
+        ``tid, sid, sampled``) | ``(1, ht, hid)`` | None."""
+        offs = (_U32 * 6)()
+        lens = (_U32 * 6)()
+        sampled = ctypes.c_int32(-1)
+        rc = self._dll.rn_decode_inbound(
+            payload, len(payload), offs, lens, ctypes.byref(sampled)
+        )
         if rc < 0:
             return None
         n_fields = 4 if rc == 0 else 2
         spans = [payload[offs[i] : offs[i] + lens[i]] for i in range(n_fields)]
+        if rc == 0 and sampled.value >= 0:
+            spans.extend(
+                (
+                    payload[offs[4] : offs[4] + lens[4]],
+                    payload[offs[5] : offs[5] + lens[5]],
+                    bool(sampled.value),
+                )
+            )
         return (rc, *spans)
 
     def decode_response(self, payload: bytes):

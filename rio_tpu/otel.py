@@ -90,6 +90,11 @@ def server_gauges(server: Any) -> dict[str, float]:
         gauges.update(view.gauges())
     if readscale is not None:
         gauges.update(readscale.gauges())
+    metrics_registry = getattr(server, "metrics_registry", None)
+    if metrics_registry is not None:
+        # Per-handler RED quantiles (rio.handler.<type>.<msg>.p50_ms/p99_ms
+        # etc.), derived from the log-bucketed histograms at scrape time.
+        gauges.update(metrics_registry.gauges())
     return gauges
 
 
@@ -132,25 +137,37 @@ def otlp_metrics_exporter(
     meter = provider.get_meter("rio_tpu")
     registered: set[str] = set()
 
+    # Observable gauges bind one callback per instrument name, but new
+    # gauge names appear as subsystems come online (first rebalance, first
+    # migration, first request of a handler type). Every callback therefore
+    # re-scans the snapshot it already read and registers any unseen names
+    # — they export from the next cycle on, with no one needing to call a
+    # private hook.
+
+    def _register_new(vals: dict[str, float]) -> None:
+        for name in vals:
+            if name not in registered:
+                registered.add(name)
+                meter.create_observable_gauge(name, callbacks=[_make_cb(name)])
+
+    def _make_cb(name: str):
+        def _cb(options):  # noqa: ARG001 - SDK signature
+            from opentelemetry.metrics import Observation
+
+            vals = read_gauges()
+            _register_new(vals)
+            value = vals.get(name)
+            return [] if value is None else [Observation(value)]
+
+        return _cb
+
     def _register_all() -> None:
-        # Observable gauges bind one callback per instrument name; new
-        # gauge names appear as subsystems come online (first rebalance,
-        # first migration), so re-scan on every export via the callbacks.
-        for name in read_gauges():
-            if name in registered:
-                continue
-            registered.add(name)
-
-            def _cb(options, _name=name):  # noqa: ARG001 - SDK signature
-                from opentelemetry.metrics import Observation
-
-                value = read_gauges().get(_name)
-                return [] if value is None else [Observation(value)]
-
-            meter.create_observable_gauge(name, callbacks=[_cb])
+        _register_new(read_gauges())
 
     _register_all()
-    provider._rio_register_new_gauges = _register_all  # scrape-loop hook
+    # Kept for older scrape loops that still call it; registration is
+    # automatic now.
+    provider._rio_register_new_gauges = _register_all
     return provider
 
 

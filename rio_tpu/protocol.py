@@ -33,9 +33,29 @@ class RequestEnvelope:
     handler_id: str
     message_type: str
     payload: bytes
+    # Appended wire-safe field (the PR-6 evolution pattern): the caller's
+    # trace context ``(trace_id, parent_span_id, sampled)``. ``None`` —
+    # the unsampled hot path — is OMITTED from the wire entirely, so an
+    # untraced frame is byte-identical to the legacy 4-element layout and
+    # old decoders (which reject extra fields) never see it. The C++ codec
+    # (native/rio_native.cc) mirrors both arities.
+    trace_ctx: tuple[str, str, bool] | None = None
 
     def to_bytes(self) -> bytes:
-        return codec.serialize(self)
+        tc = self.trace_ctx
+        if tc is None:
+            return codec.serialize(
+                [self.handler_type, self.handler_id, self.message_type, self.payload]
+            )
+        return codec.serialize(
+            [
+                self.handler_type,
+                self.handler_id,
+                self.message_type,
+                self.payload,
+                [tc[0], tc[1], tc[2]],
+            ]
+        )
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "RequestEnvelope":

@@ -96,6 +96,7 @@ class Server:
         read_scale_config=None,
         load_monitor: bool = True,
         load_thresholds=None,
+        metrics: bool = True,
     ) -> None:
         if transport not in ("asyncio", "native", "auto"):
             raise ValueError(f"unknown transport {transport!r}")
@@ -164,6 +165,15 @@ class Server:
         tracker = getattr(self.object_placement, "affinity_tracker", None)
         if tracker is not None and DispatchObserver not in self.app_data:
             self.app_data.set(DispatchObserver(tracker.observe))
+        # Per-handler RED histograms (rio_tpu/metrics): on by default — an
+        # O(1) unlocked record per dispatch; ``metrics=False`` removes even
+        # that (the service layer sees no registry and skips the timing).
+        self.metrics_registry = None
+        if metrics:
+            from .metrics import MetricsRegistry
+
+            self.metrics_registry = MetricsRegistry()
+            self.app_data.set(self.metrics_registry)
         # Load telemetry + admission control (rio_tpu/load): on by default
         # — with no thresholds configured it only samples and publishes the
         # node's load vector on the membership heartbeat; thresholds turn
@@ -279,6 +289,26 @@ class Server:
             self.app_data.set(self.migration_manager)
             self.registry.add_type(MigrationControl)
             self.registry.add_type(MigrationInbox)
+        from .admin import AdminControl, StatsSource
+
+        if StatsSource not in self.app_data:
+            # The wire ops/observability endpoint every node answers for
+            # (rio.Admin, node-scoped like the migration control plane).
+            # The gauge source closes over self: subsystems created later
+            # in bind()/run() appear in the snapshot automatically.
+            from .otel import server_gauges
+
+            self.app_data.set(
+                StatsSource(
+                    gauges=lambda: server_gauges(self),
+                    histogram_rows=lambda: (
+                        self.metrics_registry.snapshot_rows()
+                        if self.metrics_registry is not None
+                        else []
+                    ),
+                )
+            )
+            self.registry.add_type(AdminControl)
         if self.replication_manager is None and self.replication_config is not None:
             # Rides the MigrationInbox registered above — no extra actor.
             from .replication import ReplicationManager
@@ -354,7 +384,10 @@ class Server:
 
             async def dispatch(c: SendCommand) -> None:
                 try:
-                    env = RequestEnvelope(c.handler_type, c.handler_id, c.message_type, c.payload)
+                    env = RequestEnvelope(
+                        c.handler_type, c.handler_id, c.message_type, c.payload,
+                        c.trace_ctx,
+                    )
                     resp = await self._service().call(env)
                     if not c.response.done():
                         c.response.set_result(resp.to_bytes())
@@ -382,6 +415,15 @@ class Server:
                 return
             if cmd.kind == AdminCommandKind.SHUTDOWN_OBJECT:
                 await self.shutdown_object(cmd.type_name, cmd.object_id)
+            if cmd.kind == AdminCommandKind.DUMP_STATS:
+                # In-process twin of the rio.Admin wire scrape: dump the
+                # node's gauge snapshot to the log for ops spelunking.
+                from .otel import server_gauges
+
+                log.info(
+                    "%s: AdminCommand::DumpStats %s", self._local_addr,
+                    server_gauges(self),
+                )
             if cmd.kind == AdminCommandKind.MIGRATE_OBJECT:
                 if self.migration_manager is not None:
                     await self.migration_manager.migrate_out(

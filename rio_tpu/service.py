@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 
 from .app_data import AppData
 from .cluster.storage import MembershipStorage
@@ -35,7 +36,8 @@ from .protocol import (
 )
 from .registry import ApplicationRaised, ObjectId, Registry
 from .service_object import LifecycleMessage
-from .tracing import span
+from .tracing import adopt, current_trace_id, release, span
+from .tracing import enabled as tracing_enabled
 
 log = logging.getLogger("rio_tpu.service")
 
@@ -86,6 +88,11 @@ class Service:
         # a monitor): every dispatch is counted, and over-threshold load
         # sheds with the retryable SERVER_BUSY wire error.
         self._load = app_data.try_get(LoadMonitor)
+        from .metrics import MetricsRegistry
+
+        # Per-handler RED histograms (None when metrics are disabled):
+        # every dispatch records (duration, error kind, exemplar trace id).
+        self._metrics = app_data.try_get(MetricsRegistry)
 
     # ------------------------------------------------------------------
     # Placement (reference service.rs:193-298)
@@ -254,16 +261,90 @@ class Service:
     # Request dispatch (reference service.rs:54-110)
     # ------------------------------------------------------------------
 
+    # Per-connection duration-sampling stride: counts and errors are exact
+    # on EVERY dispatch, but clock reads + bucket recording happen 1-in-8
+    # on the untraced path (-1 start so a fresh connection's first request
+    # is timed). Traced requests always take the timed path — exemplars
+    # must never miss the request that carried the trace.
+    _tick = -1
+    # Inline cache of the last (handler_type, message_type) histogram:
+    # connections are overwhelmingly monomorphic, so the exact-count bump
+    # is two string compares + an int add instead of a registry lookup.
+    _memo_ht: str | None = None
+    _memo_mt: str | None = None
+    _memo_h = None
+
     async def call(self, req: RequestEnvelope) -> ResponseEnvelope:
-        """One request end-to-end; roots the trace its child spans join."""
-        with span("request", object=req.handler_type, id=req.handler_id):
-            if self._load is None:
-                return await self._call(req)
-            self._load.request_started()
+        """One request end-to-end; adopts (or roots) the trace its child
+        spans join, and records the RED histogram sample."""
+        if req.trace_ctx is None and not tracing_enabled():
+            # Null path: nothing to adopt and no sink a span could reach —
+            # skip the contextvar/span ceremony entirely. This is the
+            # pre-observability hot path plus these two checks.
+            if self._load is not None:
+                self._load.request_started()
             try:
-                return await self._call(req)
+                m = self._metrics
+                if m is None:
+                    return await self._call(req)
+                tick = self._tick = (self._tick + 1) & 7
+                if tick:
+                    resp = await self._call(req)
+                    ht = req.handler_type
+                    mt = req.message_type
+                    if ht == self._memo_ht and mt == self._memo_mt:
+                        h = self._memo_h
+                    else:
+                        h = m.resolve(ht, mt)
+                        self._memo_ht = ht
+                        self._memo_mt = mt
+                        self._memo_h = h
+                    h.count += 1
+                    err = resp.error
+                    if err is not None:
+                        h.error_count += 1
+                        kind = int(err.kind)
+                        h.errors[kind] = h.errors.get(kind, 0) + 1
+                    return resp
+                return await self._call_timed(req, None)
             finally:
-                self._load.request_finished()
+                if self._load is not None:
+                    self._load.request_finished()
+        # Adopt the caller's wire trace context BEFORE opening any span:
+        # placement_lookup→object_activate→handler_dispatch then join the
+        # client's trace instead of rooting an orphan, and every nested
+        # outbound send (replication ship, readscale forward, internal
+        # client) inherits it through the contextvar. adopt(None) is free.
+        token = adopt(req.trace_ctx)
+        try:
+            with span("request", object=req.handler_type, id=req.handler_id):
+                if self._load is not None:
+                    self._load.request_started()
+                try:
+                    if self._metrics is None:
+                        return await self._call(req)
+                    return await self._call_timed(req, current_trace_id())
+                finally:
+                    if self._load is not None:
+                        self._load.request_finished()
+        finally:
+            release(token)
+
+    async def _call_timed(
+        self, req: RequestEnvelope, trace_id: str | None
+    ) -> ResponseEnvelope:
+        perf = time.perf_counter
+        start = perf()
+        resp = await self._call(req)
+        err = resp.error
+        self._metrics.record(
+            req.handler_type,
+            req.message_type,
+            perf() - start,
+            None if err is None else int(err.kind),
+            trace_id,
+        )
+        return resp
 
     async def _call(self, req: RequestEnvelope) -> ResponseEnvelope:
         object_id = ObjectId(req.handler_type, req.handler_id)

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import contextvars
 import logging
+import os
 import random
 import time
 from dataclasses import dataclass, field
@@ -31,11 +32,82 @@ _CTX: contextvars.ContextVar[tuple[str, str] | None] = contextvars.ContextVar(
 )
 _rand = random.Random()
 
+# Head-based probabilistic sampling for client-rooted traces: the client
+# flips this coin ONCE per request with no active context; everything
+# downstream (server adoption, forwarded hops) honors the decision carried
+# on the wire instead of re-sampling.
+_SAMPLE_RATE = 0.0
+
+
+def _reseed() -> None:
+    # An import-time-seeded Random is fork-hazardous: two workers forked
+    # after import share the generator state and emit colliding trace/span
+    # ids. Seed from the OS entropy pool, and re-seed in every forked child.
+    _rand.seed(os.urandom(16))
+
+
+_reseed()
+if hasattr(os, "register_at_fork"):  # absent on non-POSIX
+    os.register_at_fork(after_in_child=_reseed)
+
 
 def current_trace_id() -> str | None:
     """The active trace id (e.g. to stamp application log lines)."""
     ctx = _CTX.get()
     return ctx[0] if ctx else None
+
+
+def set_sample_rate(rate: float) -> None:
+    """Probability that a client request with no active trace roots one."""
+    global _SAMPLE_RATE
+    _SAMPLE_RATE = min(1.0, max(0.0, rate))
+
+
+def sample_rate() -> float:
+    return _SAMPLE_RATE
+
+
+def head_sampled() -> bool:
+    """One head-based sampling decision (rate 0 short-circuits the coin)."""
+    return _SAMPLE_RATE > 0.0 and _rand.random() < _SAMPLE_RATE
+
+
+def new_trace_id() -> str:
+    return f"{_rand.getrandbits(128):032x}"
+
+
+def new_span_id() -> str:
+    return f"{_rand.getrandbits(64):016x}"
+
+
+def outbound_ctx() -> tuple[str, str, bool] | None:
+    """The wire ``trace_ctx`` an outbound request should carry.
+
+    The active span's ids when a trace is live (so the receiving node's
+    spans join it), else ``None`` — the caller decides separately whether
+    to root a fresh sampled trace (:func:`head_sampled`).
+    """
+    ctx = _CTX.get()
+    if ctx is None:
+        return None
+    return (ctx[0], ctx[1], True)
+
+
+def adopt(ctx: tuple[str, str, bool] | None):
+    """Adopt an inbound wire ``trace_ctx`` for the current task.
+
+    Returns a token for :func:`release` (``None`` when there is nothing to
+    adopt — absent context or sampled=False). While adopted, spans opened
+    here join the caller's trace and nested outbound sends forward it.
+    """
+    if ctx is None or not ctx[2]:
+        return None
+    return _CTX.set((ctx[0], ctx[1]))
+
+
+def release(token) -> None:
+    if token is not None:
+        _CTX.reset(token)
 
 
 @dataclass
@@ -63,6 +135,11 @@ def clear_sinks() -> None:
     global _ENABLED
     _SINKS.clear()
     _ENABLED = False
+
+
+def enabled() -> bool:
+    """True when at least one sink is registered (spans are live)."""
+    return _ENABLED
 
 
 def logging_sink(span: Span) -> None:

@@ -77,12 +77,15 @@ async def boot_echo_cluster(
     *,
     transport: str = "asyncio",
     placement=None,
+    server_kwargs: dict | None = None,
 ):
     """Boot N echo servers on loopback.
 
     Returns ``(members, placement, tasks, servers)``. Shared helper for the
     measured benchmarks (route hops, RPC throughput). Callers cancel the
-    returned tasks to tear the cluster down.
+    returned tasks to tear the cluster down. ``server_kwargs`` are forwarded
+    to every :class:`Server` (the tracing A/B boots with ``metrics=False``
+    to reconstruct the pre-metrics hot path).
     """
     members = LocalStorage()
     placement = placement if placement is not None else LocalObjectPlacement()
@@ -96,6 +99,7 @@ async def boot_echo_cluster(
                 cluster_provider=LocalClusterProvider(members),
                 object_placement_provider=placement,
                 transport=transport,
+                **(server_kwargs or {}),
             )
             await s.prepare()
             await s.bind()

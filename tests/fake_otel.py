@@ -1,0 +1,263 @@
+"""In-memory fake of the OpenTelemetry SDK surfaces rio_tpu.otel imports.
+
+The dev env ships only the ``opentelemetry`` API package (which provides
+``Observation``) — not the SDK or the OTLP exporters — so without this
+fake, ``otlp_sink``/``otlp_metrics_exporter`` can only be tested for their
+ImportError message. :func:`install` injects ModuleType stand-ins for the
+exact modules ``rio_tpu/otel.py`` imports:
+
+* ``opentelemetry.sdk.metrics`` → :class:`FakeMeterProvider` (observable
+  gauges, a ``force_flush`` that runs one collect cycle through every
+  reader into its exporter)
+* ``opentelemetry.sdk.metrics.export`` → ``PeriodicExportingMetricReader``
+* ``opentelemetry.sdk.resources`` → ``Resource``
+* ``opentelemetry.sdk.trace`` / ``....trace.export`` →
+  :class:`FakeTracerProvider` + ``BatchSpanProcessor``
+* ``opentelemetry.exporter.otlp.proto.grpc.{metric,trace}_exporter`` →
+  in-memory exporters recording what would have gone over gRPC.
+
+Nothing here talks to a network; exporters accumulate in plain lists the
+tests assert on. Use as::
+
+    handle = fake_otel.install()
+    try:
+        provider = otlp_metrics_exporter(read_gauges)
+        provider.force_flush()
+        assert handle.metric_exporter.exported[-1][...]
+    finally:
+        fake_otel.uninstall(handle)
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+from typing import Any, Callable
+
+_FAKE_MODULES = (
+    "opentelemetry.sdk",
+    "opentelemetry.sdk.metrics",
+    "opentelemetry.sdk.metrics.export",
+    "opentelemetry.sdk.resources",
+    "opentelemetry.sdk.trace",
+    "opentelemetry.sdk.trace.export",
+    "opentelemetry.exporter",
+    "opentelemetry.exporter.otlp",
+    "opentelemetry.exporter.otlp.proto",
+    "opentelemetry.exporter.otlp.proto.grpc",
+    "opentelemetry.exporter.otlp.proto.grpc.metric_exporter",
+    "opentelemetry.exporter.otlp.proto.grpc.trace_exporter",
+)
+
+
+# ---------------------------------------------------------------------------
+# Metrics side
+# ---------------------------------------------------------------------------
+
+
+class FakeOTLPMetricExporter:
+    """Records each collect cycle as one ``{gauge_name: value}`` dict."""
+
+    def __init__(self, endpoint: str = "") -> None:
+        self.endpoint = endpoint
+        self.exported: list[dict[str, float]] = []
+
+    def export(self, snapshot: dict[str, float]) -> None:
+        self.exported.append(dict(snapshot))
+
+
+class PeriodicExportingMetricReader:
+    def __init__(self, exporter: Any, export_interval_millis: float = 0.0) -> None:
+        self.exporter = exporter
+        self.export_interval_millis = export_interval_millis
+
+
+class _FakeGauge:
+    def __init__(self, name: str, callbacks: list[Callable]) -> None:
+        self.name = name
+        self.callbacks = list(callbacks)
+
+
+class _FakeMeter:
+    def __init__(self) -> None:
+        self.gauges: dict[str, _FakeGauge] = {}
+
+    def create_observable_gauge(
+        self, name: str, callbacks: list[Callable] | None = None, **_: Any
+    ) -> _FakeGauge:
+        g = _FakeGauge(name, callbacks or [])
+        self.gauges[name] = g
+        return g
+
+
+class FakeMeterProvider:
+    """SDK MeterProvider stand-in with an explicit collect trigger.
+
+    The real ``PeriodicExportingMetricReader`` collects on a timer thread;
+    tests call :meth:`force_flush` (same name as the SDK method) to run one
+    synchronous collect cycle: every gauge's callbacks run, their
+    Observations flatten to ``{name: value}``, and each reader's exporter
+    receives the snapshot.
+    """
+
+    def __init__(self, resource: Any = None, metric_readers: list | None = None) -> None:
+        self.resource = resource
+        self.metric_readers = list(metric_readers or [])
+        self._meter = _FakeMeter()
+        self.shut_down = False
+
+    def get_meter(self, name: str, *a: Any, **k: Any) -> _FakeMeter:
+        return self._meter
+
+    def force_flush(self, timeout_millis: float = 0.0) -> bool:
+        # Snapshot the gauge dict first: callbacks may register NEW gauges
+        # mid-iteration (that is the auto-rescan behavior under test) and
+        # those export from the next cycle, like the real SDK.
+        snapshot: dict[str, float] = {}
+        for gauge in list(self._meter.gauges.values()):
+            for cb in gauge.callbacks:
+                for obs in cb(None) or []:
+                    snapshot[gauge.name] = obs.value
+        for reader in self.metric_readers:
+            reader.exporter.export(snapshot)
+        return True
+
+    def shutdown(self, timeout_millis: float = 0.0) -> None:
+        self.shut_down = True
+
+
+# ---------------------------------------------------------------------------
+# Trace side
+# ---------------------------------------------------------------------------
+
+
+class FakeSpan:
+    def __init__(self, name: str, start_time: int | None = None) -> None:
+        self.name = name
+        self.start_time = start_time
+        self.end_time: int | None = None
+        self.attributes: dict[str, Any] = {}
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def end(self, end_time: int | None = None) -> None:
+        self.end_time = end_time
+
+
+class _FakeTracer:
+    def __init__(self, finished: list[FakeSpan]) -> None:
+        self._finished = finished
+
+    def start_span(self, name: str, start_time: int | None = None, **_: Any) -> FakeSpan:
+        span = FakeSpan(name, start_time)
+        self._finished.append(span)
+        return span
+
+
+class FakeOTLPSpanExporter:
+    def __init__(self, endpoint: str = "") -> None:
+        self.endpoint = endpoint
+
+
+class BatchSpanProcessor:
+    def __init__(self, exporter: Any) -> None:
+        self.exporter = exporter
+
+
+class FakeTracerProvider:
+    def __init__(self, resource: Any = None) -> None:
+        self.resource = resource
+        self.processors: list[Any] = []
+        self.finished_spans: list[FakeSpan] = []
+
+    def add_span_processor(self, processor: Any) -> None:
+        self.processors.append(processor)
+
+    def get_tracer(self, name: str, *a: Any, **k: Any) -> _FakeTracer:
+        return _FakeTracer(self.finished_spans)
+
+
+class Resource:
+    def __init__(self, attributes: dict[str, Any]) -> None:
+        self.attributes = dict(attributes)
+
+    @classmethod
+    def create(cls, attributes: dict[str, Any] | None = None) -> "Resource":
+        return cls(attributes or {})
+
+
+# ---------------------------------------------------------------------------
+# sys.modules injection
+# ---------------------------------------------------------------------------
+
+
+class Handle:
+    """What :func:`install` returns: the classes tests assert against plus
+    the pre-existing sys.modules entries to restore on uninstall."""
+
+    def __init__(self, saved: dict[str, Any]) -> None:
+        self.saved = saved
+        # The most recent instances, captured by the instrumented ctors.
+        self.meter_providers: list[FakeMeterProvider] = []
+        self.tracer_providers: list[FakeTracerProvider] = []
+        self.metric_exporters: list[FakeOTLPMetricExporter] = []
+
+
+def install() -> Handle:
+    """Inject the fake SDK modules into ``sys.modules``; returns a Handle."""
+    saved = {name: sys.modules.get(name) for name in _FAKE_MODULES}
+    handle = Handle(saved)
+
+    def _tracked(cls: type, bucket: list) -> type:
+        class Tracked(cls):  # type: ignore[valid-type,misc]
+            def __init__(self, *a: Any, **k: Any) -> None:
+                super().__init__(*a, **k)
+                bucket.append(self)
+
+        Tracked.__name__ = cls.__name__
+        Tracked.__qualname__ = cls.__qualname__
+        return Tracked
+
+    meter_provider_cls = _tracked(FakeMeterProvider, handle.meter_providers)
+    tracer_provider_cls = _tracked(FakeTracerProvider, handle.tracer_providers)
+    metric_exporter_cls = _tracked(FakeOTLPMetricExporter, handle.metric_exporters)
+
+    def _mod(name: str, **attrs: Any) -> types.ModuleType:
+        mod = types.ModuleType(name)
+        for key, value in attrs.items():
+            setattr(mod, key, value)
+        sys.modules[name] = mod
+        return mod
+
+    _mod("opentelemetry.sdk")
+    _mod("opentelemetry.sdk.metrics", MeterProvider=meter_provider_cls)
+    _mod(
+        "opentelemetry.sdk.metrics.export",
+        PeriodicExportingMetricReader=PeriodicExportingMetricReader,
+    )
+    _mod("opentelemetry.sdk.resources", Resource=Resource)
+    _mod("opentelemetry.sdk.trace", TracerProvider=tracer_provider_cls)
+    _mod("opentelemetry.sdk.trace.export", BatchSpanProcessor=BatchSpanProcessor)
+    _mod("opentelemetry.exporter")
+    _mod("opentelemetry.exporter.otlp")
+    _mod("opentelemetry.exporter.otlp.proto")
+    _mod("opentelemetry.exporter.otlp.proto.grpc")
+    _mod(
+        "opentelemetry.exporter.otlp.proto.grpc.metric_exporter",
+        OTLPMetricExporter=metric_exporter_cls,
+    )
+    _mod(
+        "opentelemetry.exporter.otlp.proto.grpc.trace_exporter",
+        OTLPSpanExporter=FakeOTLPSpanExporter,
+    )
+    return handle
+
+
+def uninstall(handle: Handle) -> None:
+    """Restore ``sys.modules`` exactly as :func:`install` found it."""
+    for name, before in handle.saved.items():
+        if before is None:
+            sys.modules.pop(name, None)
+        else:
+            sys.modules[name] = before

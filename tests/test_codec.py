@@ -224,3 +224,51 @@ def test_json_missing_required_field_raises_serialization_error():
 
     with pytest.raises(SerializationError):
         deserialize_json('{"a": 1}', S)
+
+
+def test_compiled_decoder_fills_trailing_plain_defaults():
+    """The exec-compiled fast-path decoder — not just the generic walker —
+    must accept a short wire whose absent trailing fields have plain
+    defaults (the appended-field evolution rule, now on the hot path so a
+    legacy-format peer doesn't tax every decode)."""
+    import dataclasses
+
+    @dataclasses.dataclass
+    class Evolved:
+        a: str
+        b: int
+        c: tuple[str, str, bool] | None = None
+        d: float = 1.5
+
+    dec = codec._dc_decoder(Evolved)
+    assert dec is not None
+    # Short wire (legacy arity) straight into the compiled decoder.
+    assert dec(["x", 3]) == Evolved("x", 3, None, 1.5)
+    assert dec(["x", 3, ["t", "s", True]]) == Evolved("x", 3, ("t", "s", True), 1.5)
+    assert dec(["x", 3, None, 2.0]) == Evolved("x", 3, None, 2.0)
+    # Below the required floor / above total → the generic walker's errors.
+    with pytest.raises(SerializationError):
+        dec(["x"])
+    with pytest.raises(SerializationError):
+        dec(["x", 3, None, 2.0, "extra"])
+    # End-to-end through deserialize too.
+    data = codec.serialize(["x", 3])
+    assert codec.deserialize(data, Evolved) == Evolved("x", 3)
+
+
+def test_compiled_decoder_factory_defaults_use_generic_fallback():
+    """default_factory fields can't be inlined as shared constants; a short
+    wire there must still decode correctly (via the generic walker) with a
+    FRESH container per instance."""
+    import dataclasses
+
+    @dataclasses.dataclass
+    class WithFactory:
+        a: int
+        items: list[int] = dataclasses.field(default_factory=list)
+
+    out1 = codec.deserialize(codec.serialize([7]), WithFactory)
+    out2 = codec.deserialize(codec.serialize([8]), WithFactory)
+    assert out1 == WithFactory(7) and out2 == WithFactory(8)
+    out1.items.append(1)
+    assert out2.items == []  # no shared mutable default

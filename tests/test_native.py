@@ -41,6 +41,38 @@ def test_request_frame_parity():
         )
 
 
+def test_traced_request_frame_parity():
+    """The appended trace_ctx keeps byte parity in BOTH arities: untraced
+    envelopes must match the legacy 4-element encoder (wire-append
+    contract), traced ones the new 5-element entry point."""
+    tid, sid = "a1" * 16, "b2" * 8
+    for sampled in (True, False):
+        env = protocol.RequestEnvelope("Svc", "obj-1", "Msg", b"pp", (tid, sid, sampled))
+        assert protocol.encode_request_frame(env) == lib.encode_request_frame_traced(
+            b"Svc", b"obj-1", b"Msg", b"pp", tid.encode(), sid.encode(), sampled
+        )
+    # Untraced stays on the legacy entry point, byte-identical.
+    env = protocol.RequestEnvelope("Svc", "obj-1", "Msg", b"pp")
+    assert protocol.encode_request_frame(env) == lib.encode_request_frame(
+        b"Svc", b"obj-1", b"Msg", b"pp"
+    )
+
+
+def test_traced_decode_inbound_parity():
+    tid, sid = "c3" * 16, "d4" * 8
+    env = protocol.RequestEnvelope("Svc", "i", "M", b"xyz", (tid, sid, True))
+    framed = protocol.encode_request_frame(env)
+    assert lib.decode_inbound(framed[4:]) == (
+        0, b"Svc", b"i", b"M", b"xyz", tid.encode(), sid.encode(), True,
+    )
+    # Legacy (untraced) frames keep the historical 5-tuple shape.
+    legacy = protocol.encode_request_frame(protocol.RequestEnvelope("S", "i", "M", b"x"))
+    assert lib.decode_inbound(legacy[4:]) == (0, b"S", b"i", b"M", b"x")
+    # Python typed decode agrees.
+    back = protocol.decode_inbound(framed[4:])
+    assert back == env and back.trace_ctx == (tid, sid, True)
+
+
 def test_response_frame_parity():
     ok = protocol.ResponseEnvelope.ok(b"hello")
     assert codec.frame(ok.to_bytes()) == lib.encode_response_ok_frame(b"hello")

@@ -1,0 +1,473 @@
+"""End-to-end request observability: RED histograms, trace adoption,
+head sampling, the rio.Admin wire scrape, and the fake-SDK OTel bridge.
+
+The cross-PROCESS trace propagation test (one trace_id across a redirect
+between two OS-process servers) lives in tests/test_trace_propagation.py;
+this module covers the in-process layers.
+"""
+
+import asyncio
+
+import pytest
+
+from rio_tpu import (
+    AppData,
+    Client,
+    LocalObjectPlacement,
+    LocalStorage,
+    Registry,
+    ServiceObject,
+    handler,
+    message,
+    tracing,
+)
+from rio_tpu.metrics import (
+    MAX_KEYS,
+    N_BUCKETS,
+    OVERFLOW_KEY,
+    HandlerHistogram,
+    MetricsRegistry,
+    hist_from_row,
+    hist_to_row,
+    merge_rows,
+)
+
+from .server_utils import Cluster, run_integration_test
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    tracing.clear_sinks()
+    tracing.set_sample_rate(0.0)
+    yield
+    tracing.clear_sinks()
+    tracing.set_sample_rate(0.0)
+
+
+# ---------------------------------------------------------------------------
+# Histogram unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_buckets_and_quantiles():
+    h = HandlerHistogram()
+    for _ in range(90):
+        h.record(0.001)  # 1000 µs → bucket bit_length(1000)=10
+    for _ in range(10):
+        h.record(0.1)  # 100000 µs → bucket 17
+    assert h.count == 100
+    assert sum(h.buckets) == 100
+    # p50 sits in the 1 ms bucket (upper bound 2^10 µs ≈ 1.024 ms)...
+    assert h.quantile(0.5) == pytest.approx((1 << 10) / 1e6)
+    # ...p99 in the 100 ms bucket, clamped to the observed max.
+    assert h.quantile(0.99) == pytest.approx(0.1)
+    assert h.quantile(1.0) == pytest.approx(0.1)
+    # Durations beyond the top bucket saturate instead of overflowing.
+    h.record(1e6)
+    assert h.buckets[N_BUCKETS - 1] == 1
+
+
+def test_histogram_errors_by_kind_and_exemplar():
+    h = HandlerHistogram()
+    h.record(0.001, error_kind=None, trace_id=None)
+    h.record(0.002, error_kind=5, trace_id="t-slow")
+    h.record(0.0005, error_kind=5, trace_id="t-fast")
+    h.record(0.004, error_kind=0)
+    assert h.error_count == 3
+    assert h.errors == {5: 2, 0: 1}
+    # Exemplar = slowest TRACED sample (the untraced 4 ms one can't win).
+    assert h.exemplar_trace == "t-slow"
+    assert h.exemplar_s == pytest.approx(0.002)
+
+
+def test_histogram_wire_row_roundtrip_and_merge():
+    a = HandlerHistogram()
+    b = HandlerHistogram()
+    for i in range(10):
+        a.record(0.001 * (i + 1), trace_id=f"ta{i}")
+    b.record(0.5, error_kind=8, trace_id="tb")
+    key, back = hist_from_row(hist_to_row(("T", "M"), a))
+    assert key == ("T", "M")
+    assert back.buckets == a.buckets and back.count == a.count
+    assert back.exemplar_trace == a.exemplar_trace
+
+    merged = merge_rows([[hist_to_row(("T", "M"), a)], [hist_to_row(("T", "M"), b)]])
+    m = merged[("T", "M")]
+    assert m.count == 11 and m.error_count == 1
+    assert m.max_s == pytest.approx(0.5)
+    assert m.exemplar_trace == "tb"  # slowest across nodes wins
+    # Quantiles computed only after the merge: p99 reflects node b's tail.
+    assert m.quantile(0.99) == pytest.approx(0.5)
+
+
+def test_hist_from_row_tolerates_bucket_count_drift():
+    h = HandlerHistogram()
+    h.record(100.0)  # lands in the top bucket
+    row = hist_to_row(("T", "M"), h)
+    short = list(row)
+    short[5] = row[5][:10]  # old peer with fewer buckets
+    _, back = hist_from_row(short)
+    assert len(back.buckets) == N_BUCKETS
+    longer = list(row)
+    longer[5] = row[5] + [3, 4]  # newer peer with more buckets
+    _, back = hist_from_row(longer)
+    assert len(back.buckets) == N_BUCKETS
+    assert back.buckets[N_BUCKETS - 1] == row[5][N_BUCKETS - 1] + 7
+
+
+def test_registry_cardinality_cap():
+    reg = MetricsRegistry(max_keys=4)
+    for i in range(10):
+        reg.record(f"T{i}", "M", 0.001)
+    rows = reg.snapshot_rows()
+    keys = {(r[0], r[1]) for r in rows}
+    assert len(keys) == 5  # 4 real + 1 overflow
+    assert OVERFLOW_KEY in keys
+    assert reg.get(*OVERFLOW_KEY).count == 6
+    # An existing key keeps recording into its own row past the cap.
+    reg.record("T0", "M", 0.002)
+    assert reg.get("T0", "M").count == 2
+    assert MAX_KEYS >= 4  # default cap sanity
+
+
+def test_registry_gauges_shape():
+    reg = MetricsRegistry()
+    reg.record("Acc", "Deposit", 0.003, error_kind=None, trace_id="tr1")
+    g = reg.gauges()
+    p = "rio.handler.Acc.Deposit"
+    assert g[f"{p}.count"] == 1.0
+    assert g[f"{p}.errors"] == 0.0
+    assert g[f"{p}.p50_ms"] > 0 and g[f"{p}.p99_ms"] >= g[f"{p}.p50_ms"] >= 0
+    assert reg.exemplars() == {"Acc.Deposit": "tr1"}
+
+
+# ---------------------------------------------------------------------------
+# Sampling + fork reseed satellites
+# ---------------------------------------------------------------------------
+
+
+def test_sample_rate_clamped_and_head_sampling():
+    tracing.set_sample_rate(7.0)
+    assert tracing.sample_rate() == 1.0
+    assert tracing.head_sampled()  # rate 1.0 always samples
+    tracing.set_sample_rate(-1.0)
+    assert tracing.sample_rate() == 0.0
+    assert not tracing.head_sampled()  # rate 0 short-circuits the coin
+
+
+def test_fork_reseed_changes_id_stream():
+    """A forked child re-seeds from os.urandom: replaying the parent's
+    generator state must NOT reproduce the parent's ids."""
+    tracing._rand.seed(1234)
+    parent_ids = [tracing.new_trace_id(), tracing.new_span_id()]
+    tracing._rand.seed(1234)  # child inherits identical state post-fork...
+    tracing._reseed()  # ...but the at-fork hook re-seeds it
+    child_ids = [tracing.new_trace_id(), tracing.new_span_id()]
+    assert parent_ids != child_ids
+    assert len(child_ids[0]) == 32 and len(child_ids[1]) == 16
+
+
+def test_adopt_and_outbound_ctx():
+    assert tracing.outbound_ctx() is None
+    token = tracing.adopt(("t" * 32, "s" * 16, True))
+    try:
+        assert tracing.current_trace_id() == "t" * 32
+        # Nested outbound hops forward the adopted ids, sampled stays set.
+        assert tracing.outbound_ctx() == ("t" * 32, "s" * 16, True)
+    finally:
+        tracing.release(token)
+    assert tracing.current_trace_id() is None
+    # sampled=False and absent contexts adopt to nothing.
+    assert tracing.adopt(None) is None
+    assert tracing.adopt(("t" * 32, "s" * 16, False)) is None
+
+
+# ---------------------------------------------------------------------------
+# Service-layer adoption + RED recording
+# ---------------------------------------------------------------------------
+
+
+@message(name="obs.Hit")
+class Hit:
+    boom: bool = False
+
+
+@message(name="obs.Echo")
+class Echo:
+    trace_id: str = ""
+
+
+class Observed(ServiceObject):
+    @handler
+    async def hit(self, msg: Hit, ctx: AppData) -> Echo:
+        if msg.boom:
+            raise RuntimeError("boom")
+        return Echo(trace_id=tracing.current_trace_id() or "")
+
+
+def _service(app_data: AppData):
+    from rio_tpu.cluster.storage import Member
+    from rio_tpu.service import Service
+
+    async def build():
+        members = LocalStorage()
+        await members.push(Member.from_address("127.0.0.1:7009", active=True))
+        return Service(
+            address="127.0.0.1:7009",
+            registry=Registry().add_type(Observed),
+            object_placement=LocalObjectPlacement(),
+            members_storage=members,
+            app_data=app_data,
+        )
+
+    return build
+
+
+def test_service_adopts_wire_trace_and_records_exemplar():
+    from rio_tpu import codec
+    from rio_tpu.protocol import RequestEnvelope
+
+    app_data = AppData()
+    reg = MetricsRegistry()
+    app_data.set(reg)
+    tid = "ab" * 16
+
+    async def main():
+        svc = await _service(app_data)()
+        env = RequestEnvelope(
+            "Observed", "o1", "obs.Hit", codec.serialize(Hit()), (tid, "cd" * 8, True)
+        )
+        resp = await svc.call(env)
+        assert resp.is_ok
+        # The handler saw the caller's trace id (adoption works without
+        # any sink registered — metrics-only deployments still correlate).
+        assert codec.deserialize(resp.body, Echo).trace_id == tid
+        # And the histogram stashed it as the exemplar.
+        h = reg.get("Observed", "obs.Hit")
+        assert h is not None and h.count == 1
+        assert h.exemplar_trace == tid
+
+    asyncio.run(main())
+
+
+def test_service_records_error_kind():
+    from rio_tpu import codec
+    from rio_tpu.protocol import ErrorKind, RequestEnvelope
+
+    app_data = AppData()
+    reg = MetricsRegistry()
+    app_data.set(reg)
+
+    async def main():
+        svc = await _service(app_data)()
+        resp = await svc.call(
+            RequestEnvelope("Observed", "o1", "obs.Hit", codec.serialize(Hit(boom=True)))
+        )
+        assert not resp.is_ok
+        h = reg.get("Observed", "obs.Hit")
+        assert h.error_count == 1
+        assert h.errors == {int(ErrorKind.UNKNOWN): 1}
+
+    asyncio.run(main())
+
+
+def test_service_without_metrics_registry_still_serves():
+    from rio_tpu import codec
+    from rio_tpu.protocol import RequestEnvelope
+
+    async def main():
+        svc = await _service(AppData())()
+        resp = await svc.call(
+            RequestEnvelope("Observed", "o2", "obs.Hit", codec.serialize(Hit()))
+        )
+        assert resp.is_ok
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# Client head sampling → server adoption → DUMP_STATS scrape (in-process
+# cluster over real sockets)
+# ---------------------------------------------------------------------------
+
+
+def build_registry() -> Registry:
+    return Registry().add_type(Observed)
+
+
+def test_client_roots_trace_and_admin_scrape_returns_exemplar():
+    from rio_tpu.admin import ADMIN_TYPE, DumpStats, StatsSnapshot
+
+    async def body(cluster: Cluster):
+        tracing.set_sample_rate(1.0)
+        client = cluster.client()
+        echoed = set()
+        for i in range(6):
+            out = await client.send(Observed, f"o{i}", Hit(), returns=Echo)
+            assert out.trace_id, "handler must observe the client-rooted trace"
+            echoed.add(out.trace_id)
+        assert len(echoed) == 6  # one fresh trace per request
+
+        # Wire scrape: every node's rio.Admin returns gauges + histograms.
+        merged_rows = []
+        exemplars = set()
+        for server in cluster.servers:
+            snap = await client.send(
+                ADMIN_TYPE, server.local_address, DumpStats(), returns=StatsSnapshot
+            )
+            assert snap.address == server.local_address
+            merged_rows.append(snap.histograms)
+            for row in snap.histograms:
+                if row[0] == "Observed":
+                    exemplars.add(row[8])
+        merged = merge_rows(merged_rows)
+        h = merged.get(("Observed", "obs.Hit"))
+        assert h is not None and h.count == 6
+        # ≥1 top-bucket sample carries a trace id the client actually rooted.
+        assert exemplars & echoed
+        # Quantile gauges are exposed per node via server_gauges.
+        from rio_tpu.otel import server_gauges
+
+        all_gauges = {}
+        for server in cluster.servers:
+            all_gauges.update(server_gauges(server))
+        assert "rio.handler.Observed.obs.Hit.p50_ms" in all_gauges
+        assert "rio.handler.Observed.obs.Hit.p99_ms" in all_gauges
+        client.close()
+
+    asyncio.run(
+        run_integration_test(body, registry_builder=build_registry, num_servers=2)
+    )
+
+
+def test_untraced_requests_record_histograms_without_exemplars():
+    from rio_tpu.admin import ADMIN_TYPE, DumpStats, StatsSnapshot
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        for i in range(4):
+            out = await client.send(Observed, f"u{i}", Hit(), returns=Echo)
+            assert out.trace_id == ""  # rate 0: no trace on the wire
+        rows = []
+        for server in cluster.servers:
+            snap = await client.send(
+                ADMIN_TYPE, server.local_address, DumpStats(), returns=StatsSnapshot
+            )
+            rows.append(snap.histograms)
+        h = merge_rows(rows).get(("Observed", "obs.Hit"))
+        assert h is not None and h.count == 4
+        assert h.exemplar_trace == ""  # nothing traced, no exemplar
+        client.close()
+
+    asyncio.run(
+        run_integration_test(body, registry_builder=build_registry, num_servers=2)
+    )
+
+
+def test_admin_request_bridges_to_admin_queue():
+    from rio_tpu.admin import ADMIN_TYPE, AdminAck, AdminRequest
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        target = cluster.servers[0].local_address
+        ack = await client.send(
+            ADMIN_TYPE, target, AdminRequest(kind="dump_stats"), returns=AdminAck
+        )
+        assert ack.ok
+        ack = await client.send(
+            ADMIN_TYPE, target, AdminRequest(kind="no_such_kind"), returns=AdminAck
+        )
+        assert not ack.ok and "no_such_kind" in ack.detail
+        client.close()
+
+    asyncio.run(
+        run_integration_test(body, registry_builder=build_registry, num_servers=2)
+    )
+
+
+# ---------------------------------------------------------------------------
+# OTel bridge against the in-memory fake SDK
+# ---------------------------------------------------------------------------
+
+
+def test_otlp_metrics_exporter_auto_registers_new_gauges():
+    """Gauge names that appear AFTER init (first request of a handler type)
+    must start exporting with no one calling a private registration hook —
+    the observable-gauge callbacks re-scan the snapshot themselves."""
+    from . import fake_otel
+
+    handle = fake_otel.install()
+    try:
+        from rio_tpu.otel import otlp_metrics_exporter
+
+        gauges = {"rio.a": 1.0}
+        provider = otlp_metrics_exporter(lambda: dict(gauges), interval=9999.0)
+        assert provider in handle.meter_providers
+        exporter = handle.metric_exporters[-1]
+
+        provider.force_flush()
+        assert exporter.exported[-1] == {"rio.a": 1.0}
+
+        # A new gauge appears post-init; this cycle's callbacks discover it...
+        gauges["rio.b"] = 2.0
+        provider.force_flush()
+        assert "rio.b" not in exporter.exported[-1]
+        # ...and it exports from the NEXT cycle on, like the real SDK.
+        provider.force_flush()
+        assert exporter.exported[-1] == {"rio.a": 1.0, "rio.b": 2.0}
+
+        # Back-compat hook still present for older scrape loops.
+        provider._rio_register_new_gauges()
+    finally:
+        fake_otel.uninstall(handle)
+
+
+def test_otlp_sink_replays_spans_through_fake_sdk():
+    from . import fake_otel
+
+    handle = fake_otel.install()
+    try:
+        from rio_tpu.otel import otlp_sink
+
+        sink = otlp_sink("http://collector:4317", service_name="svc")
+        tracing.add_sink(sink)
+        with tracing.span("outer", object="Obj.9"):
+            with tracing.span("inner"):
+                pass
+        provider = handle.tracer_providers[-1]
+        spans = {s.name: s for s in provider.finished_spans}
+        assert set(spans) == {"outer", "inner"}
+        assert (
+            spans["inner"].attributes["rio.trace_id"]
+            == spans["outer"].attributes["rio.trace_id"]
+        )
+        assert (
+            spans["inner"].attributes["rio.parent_id"]
+            == spans["outer"].attributes["rio.span_id"]
+        )
+        assert spans["outer"].attributes["object"] == "Obj.9"
+        assert spans["outer"].end_time >= spans["outer"].start_time > 0
+        assert provider.processors[0].exporter.endpoint == "http://collector:4317"
+    finally:
+        fake_otel.uninstall(handle)
+
+
+def test_internal_client_send_carries_trace_ctx():
+    """A handler's actor→actor send crosses the internal queue into a
+    DIFFERENT task context; the trace must be captured at enqueue."""
+    from rio_tpu.commands import InternalClientSender
+
+    async def main():
+        sender = InternalClientSender()
+        token = tracing.adopt(("a" * 32, "b" * 16, True))
+        try:
+            task = asyncio.ensure_future(sender.send("T", "i", "M", b""))
+            await asyncio.sleep(0)  # let the enqueue run inside the ctx
+        finally:
+            tracing.release(token)
+        cmd = sender.queue.get_nowait()
+        assert cmd.trace_ctx == ("a" * 32, "b" * 16, True)
+        cmd.response.set_result(b"done")
+        assert await task == b"done"
+
+    asyncio.run(main())

@@ -63,6 +63,45 @@ def test_subscription_roundtrips():
     assert out.error is not None and out.error.kind == ErrorKind.REDIRECT
 
 
+def test_request_envelope_trace_ctx_roundtrip():
+    ctx = ("ab" * 16, "cd" * 8, True)
+    env = RequestEnvelope("Svc", "obj-1", "Ping", b"\x01\x02", ctx)
+    out = RequestEnvelope.from_bytes(env.to_bytes())
+    assert out == env
+    assert out.trace_ctx == ctx
+
+
+def test_untraced_frame_is_byte_identical_to_legacy():
+    """Appended-field contract, old-decoder direction: an untraced envelope
+    encodes EXACTLY the pre-trace 4-element wire, so a peer that predates
+    trace_ctx parses it unchanged. Pinned against hand-built legacy bytes,
+    not a round-trip (a symmetric bug would pass a round-trip)."""
+    from rio_tpu import codec
+
+    env = RequestEnvelope("Svc", "obj-1", "Ping", b"\x01\x02")
+    legacy = codec.serialize(["Svc", "obj-1", "Ping", b"\x01\x02"])
+    assert env.to_bytes() == legacy
+
+
+def test_new_decoder_accepts_legacy_frame():
+    """Old-encoder direction: a 4-element frame from a pre-trace peer
+    decodes with trace_ctx defaulting to None."""
+    from rio_tpu import codec
+
+    legacy = codec.serialize(["Svc", "obj-1", "Ping", b"\x01\x02"])
+    out = RequestEnvelope.from_bytes(legacy)
+    assert out == RequestEnvelope("Svc", "obj-1", "Ping", b"\x01\x02")
+    assert out.trace_ctx is None
+
+
+def test_traced_frame_kind_dispatch():
+    ctx = ("f" * 32, "0" * 16, True)
+    env = RequestEnvelope("S", "i", "M", b"pp", ctx)
+    decoded = protocol.decode_inbound(protocol.KIND_REQUEST + env.to_bytes())
+    assert isinstance(decoded, RequestEnvelope)
+    assert decoded.trace_ctx == ctx
+
+
 def test_frame_kind_dispatch():
     req = RequestEnvelope("S", "i", "M", b"")
     decoded = protocol.decode_inbound(protocol.KIND_REQUEST + req.to_bytes())

@@ -1,0 +1,234 @@
+"""Trace context across real process and proxy boundaries.
+
+The wire-propagation acceptance test: one client-rooted trace_id observed
+at the client, at the server that REDIRECTED the request, and at the
+server that finally dispatched it — with the two servers in separate OS
+processes joined only by sqlite membership/placement files. Plus the
+readscale standby→primary proxied read carrying the same context (real
+sockets, in-process harness).
+"""
+
+import asyncio
+import os
+from pathlib import Path
+
+import pytest
+
+from rio_tpu import ReadScaleConfig, tracing
+from rio_tpu.protocol import ErrorKind
+
+from .tracing_actor import Probe, Seen, TrEcho
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    tracing.clear_sinks()
+    tracing.set_sample_rate(0.0)
+    yield
+    tracing.clear_sinks()
+    tracing.set_sample_rate(0.0)
+
+
+def test_one_trace_id_across_processes_and_redirect(tmp_path):
+    """Client roots a sampled trace → request hits the WRONG process (its
+    placement cache is poisoned) → that process answers REDIRECT, recording
+    the trace on its histogram → the client follows with the SAME frame →
+    the owning process dispatches, and its handler + exemplar carry the
+    same trace_id. Three observation points, one id."""
+    import socket
+    import subprocess
+    import sys as _sys
+
+    ports = []
+    socks = []
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    repo = str(Path(__file__).resolve().parent.parent)
+    child = str(Path(__file__).resolve().parent / "tracing_server_child.py")
+    env = {
+        "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+        "HOME": os.environ.get("HOME", "/tmp"),
+        "PYTHONPATH": repo,
+    }
+    procs = [
+        subprocess.Popen(
+            [_sys.executable, child, str(port), str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        )
+        for port in ports
+    ]
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+
+    async def drive():
+        from rio_tpu import Client
+        from rio_tpu.admin import ADMIN_TYPE, DumpStats, StatsSnapshot
+        from rio_tpu.cluster.storage.sqlite import SqliteMembershipStorage
+        from rio_tpu.metrics import hist_from_row
+        from rio_tpu.registry import type_id
+
+        members = SqliteMembershipStorage(str(tmp_path / "members.db"))
+        try:
+            deadline = asyncio.get_event_loop().time() + 60.0
+            while asyncio.get_event_loop().time() < deadline:
+                if any(p.poll() is not None for p in procs):
+                    raise AssertionError("a server child exited early")
+                try:
+                    active = {m.address for m in await members.active_members()}
+                except Exception:
+                    active = set()
+                if set(addrs) <= active:
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                raise TimeoutError("children never became active members")
+
+            # The client roots one sampled trace per request; capture the
+            # rooted ids through a sink on the client_request span.
+            rooted: list[str] = []
+            tracing.set_sample_rate(1.0)
+            tracing.add_sink(lambda s: rooted.append(s.trace_id))
+
+            client = Client(members)
+            try:
+                # Seat the object somewhere; note the owner.
+                out = await client.send(TrEcho, "t1", Probe(), returns=Seen)
+                assert out.trace_id and out.address in addrs
+                owner = out.address
+                wrong = next(a for a in addrs if a != owner)
+
+                # Poison the placement cache so the next request provably
+                # lands on the non-owner first and gets redirected.
+                key = (type_id(TrEcho), "t1")
+                client._placement.put(key, wrong)
+                out = await client.send(TrEcho, "t1", Probe(), returns=Seen)
+                assert out.address == owner
+                traced = out.trace_id
+                # The handler saw the id the CLIENT rooted for this request.
+                assert traced == rooted[-1]
+
+                # Scrape both processes: the redirecting node recorded the
+                # trace on its REDIRECT row, the owner on its success row —
+                # the same id at every hop.
+                snaps = {}
+                for addr in addrs:
+                    snaps[addr] = await client.send(
+                        ADMIN_TYPE, addr, DumpStats(), returns=StatsSnapshot
+                    )
+                probe_mt = type_id(Probe)
+
+                def probe_hist(addr):
+                    for row in snaps[addr].histograms:
+                        if (row[0], row[1]) == (type_id(TrEcho), probe_mt):
+                            return hist_from_row(row)[1]
+                    return None
+
+                owner_h = probe_hist(owner)
+                wrong_h = probe_hist(wrong)
+                assert owner_h is not None and owner_h.exemplar_trace in set(rooted)
+                assert wrong_h is not None, "redirecting node must record the attempt"
+                assert wrong_h.errors.get(int(ErrorKind.REDIRECT), 0) >= 1
+                assert wrong_h.exemplar_trace == traced
+                # Quantile gauges came over the same scrape.
+                p = f"rio.handler.{type_id(TrEcho)}.{probe_mt}"
+                assert f"{p}.p50_ms" in snaps[owner].gauges
+                assert f"{p}.p99_ms" in snaps[owner].gauges
+            finally:
+                client.close()
+        finally:
+            members.close()
+
+    try:
+        asyncio.run(drive())
+    finally:
+        for p in procs:
+            p.kill()
+            p.communicate(timeout=30)
+
+
+def test_readscale_proxied_read_carries_trace(tmp_path):
+    """A stale standby transparently proxies a readonly request to the
+    primary; the forwarded frame must carry the caller's trace_ctx so the
+    primary's dispatch joins the same trace."""
+    from rio_tpu import codec
+    from rio_tpu.protocol import RequestEnvelope, decode_response, encode_request_frame
+    from rio_tpu.registry import ObjectId, type_id
+    from rio_tpu.replication import ReplicationConfig
+
+    from .server_utils import Cluster, run_integration_test
+    from .test_readscale import CBump, CRead, CSnap, Celebrity, build_registry
+
+    async def _traced_read(address: str, object_id: str, trace_ctx):
+        from rio_tpu.client import _ServerConns
+
+        pool = _ServerConns(address, 1, 2.0)
+        try:
+            req = RequestEnvelope(
+                type_id(Celebrity), object_id, type_id(CRead),
+                codec.serialize(CRead()), trace_ctx,
+            )
+            conn = await pool.acquire()
+            try:
+                raw = await conn.roundtrip(encode_request_frame(req))
+            finally:
+                pool.release(conn, reuse=True)
+            resp = decode_response(raw)
+            assert resp.is_ok, resp.error
+            return codec.deserialize(resp.body, CSnap)
+        finally:
+            pool.close()
+
+    async def body(cluster: Cluster):
+        tname = type_id(Celebrity)
+        client = cluster.client()
+        try:
+            out = await client.send(Celebrity, "c9", CBump(amount=1), returns=CSnap)
+            primary_addr = out.address
+            held, _ = await cluster.placement.standbys(ObjectId(tname, "c9"))
+            assert held
+            standby = next(
+                s for s in cluster.servers if s.local_address == next(iter(held))
+            )
+
+            # Age the replica past the staleness bound so the standby MUST
+            # proxy to the primary rather than answer locally.
+            meta = standby.replication_manager._replica_meta[(tname, "c9")]
+            meta.recv_mono -= 60.0
+
+            tid, sid = tracing.new_trace_id(), tracing.new_span_id()
+            snap = await _traced_read(
+                standby.local_address, "c9", (tid, sid, True)
+            )
+            assert snap.address == primary_addr  # really proxied
+            assert standby.read_scale_manager.stats.standby_forwards == 1
+
+            # The PRIMARY's histogram exemplar carries the caller's id —
+            # the forward re-encoded the envelope with trace_ctx intact.
+            primary = next(
+                s for s in cluster.servers if s.local_address == primary_addr
+            )
+            h = primary.metrics_registry.get(tname, type_id(CRead))
+            assert h is not None and h.exemplar_trace == tid
+            # The standby adopted it too while serving the proxied request.
+            hs = standby.metrics_registry.get(tname, type_id(CRead))
+            assert hs is not None and hs.exemplar_trace == tid
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_registry,
+            num_servers=3,
+            server_kwargs={
+                "replication_config": ReplicationConfig(
+                    k=1, anti_entropy_interval=0.2, seat_ttl=0.2
+                ),
+                "read_scale_config": ReadScaleConfig(max_staleness_s=5.0),
+            },
+        )
+    )

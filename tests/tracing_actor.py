@@ -1,0 +1,34 @@
+"""Shared actor for the cross-process trace-propagation test.
+
+Imported by BOTH sides of the real-socket run (the server child process
+registers it; the parent test imports it for the client's codec). The
+handler echoes the trace id the SERVER observed, so the parent can assert
+the wire carried the client-rooted context across processes and hops.
+"""
+
+from rio_tpu import AppData, Registry, ServerInfo, ServiceObject, handler, message
+from rio_tpu import tracing
+
+
+@message(name="tr.Probe")
+class Probe:
+    pass
+
+
+@message(name="tr.Seen")
+class Seen:
+    trace_id: str = ""
+    address: str = ""
+
+
+class TrEcho(ServiceObject):
+    @handler
+    async def probe(self, msg: Probe, ctx: AppData) -> Seen:
+        return Seen(
+            trace_id=tracing.current_trace_id() or "",
+            address=ctx.get(ServerInfo).address,
+        )
+
+
+def build_registry() -> Registry:
+    return Registry().add_type(TrEcho)

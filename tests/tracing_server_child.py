@@ -1,0 +1,39 @@
+"""Child server process for the cross-process trace-propagation test.
+
+Boots ONE real rio-tpu server on the given port, sqlite-joined to its
+sibling, with metrics on (the default) so the parent can DUMP_STATS each
+node's exemplar trace ids over the wire. Run with a clean env
+(PYTHONPATH=<repo> only) — the ambient axon sitecustomize must not leak in.
+"""
+
+import asyncio
+import os
+import sys
+
+port, dbdir = sys.argv[1], sys.argv[2]
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from rio_tpu import Server  # noqa: E402
+from rio_tpu.cluster.membership_protocol import LocalClusterProvider  # noqa: E402
+from rio_tpu.cluster.storage.sqlite import SqliteMembershipStorage  # noqa: E402
+from rio_tpu.object_placement.sqlite import SqliteObjectPlacement  # noqa: E402
+from tests.tracing_actor import build_registry  # noqa: E402
+
+
+async def main() -> None:
+    members = SqliteMembershipStorage(os.path.join(dbdir, "members.db"))
+    placement = SqliteObjectPlacement(os.path.join(dbdir, "placement.db"))
+    server = Server(
+        address=f"127.0.0.1:{port}",
+        registry=build_registry(),
+        cluster_provider=LocalClusterProvider(members),
+        object_placement_provider=placement,
+    )
+    await server.prepare()
+    await server.bind()
+    print("READY", flush=True)
+    await server.run()
+
+
+asyncio.run(main())
