@@ -61,7 +61,12 @@ HIER_PULL_MAX_MS = 700.0
 # scarce hardware evidence. Host-stage numbers (rpc, routing, live-cluster
 # rows) deliberately never carry — they are only meaningful next to the
 # SAME session's sqlite baseline (absolute throughput drifts ±30-40%).
-_CARRYABLE_TIERS = ("collapsed_tier", "solve_tier", "baseline_row5_hier")
+_CARRYABLE_TIERS = (
+    "collapsed_tier",
+    "solve_tier",
+    "baseline_row5_hier",
+    "delta_tier",
+)
 
 # Field names whose values include the axon relay's per-call dispatch+sync
 # overhead (~300 ms/cycle r4; the collapsed tier's "294 ms" was 0.6 ms of
@@ -1175,6 +1180,155 @@ def run_collapsed_tier(n_obj: int, platform: str, deadline: float) -> None:
             print(f"# incremental tier failed: {type(e).__name__}: {e}", file=sys.stderr)
 
 
+def _delta_churn_rate(n_obj: int, n_nodes: int = 64, mode: str = "sinkhorn") -> dict:
+    """A/B one churn event's full re-solve against the incremental delta
+    path on the same cluster shape (provider-level, through the public
+    ``rebalance`` API): seat ``n_obj`` objects on ``n_nodes`` nodes, run
+    an establishing full solve (pays every jit compile and commits the
+    PlanState), kill one node -> timed ``rebalance(delta=False)`` (the
+    full path), kill a second node -> timed ``rebalance()`` (the delta
+    path). The two events are symmetric — each displaces ~n/n_nodes
+    objects, and after a quota-exact full solve the second kill makes
+    every survivor's quota grow, so the delta's displaced set is EXACTLY
+    the dead node's population and undisplaced objects must not move.
+
+    Reports wall ms and moved counts for both sides, the delta's
+    ``undisplaced_moves`` (must be 0) and ``cost_ratio`` (achieved
+    quadratic congestion vs the integer-quota ideal; must be ~1.0).
+    """
+    import asyncio
+
+    import numpy as np
+
+    from rio_tpu.object_placement.jax_placement import JaxObjectPlacement
+    from rio_tpu.ops import integer_fair_quotas
+    from rio_tpu.registry import ObjectId
+
+    class _Member:
+        def __init__(self, address: str, active: bool = True) -> None:
+            self.address = address
+            self.active = active
+
+    members = [f"10.99.{i // 256}.{i % 256}:7000" for i in range(n_nodes)]
+
+    async def _run() -> dict:
+        dead_warm = n_nodes - 1
+        p = JaxObjectPlacement(mode=mode, node_axis_size=n_nodes)
+        p.sync_members([_Member(a) for a in members])
+        ids = [ObjectId("Bench", str(i)) for i in range(n_obj)]
+        await p.assign_batch(ids)
+        await p.rebalance(delta=False)  # compiles paid + plan established
+        # Warm-up churn event (untimed): the delta path's class-refresh
+        # executable compiles on its first event, exactly like the full
+        # path's compiles paid by the establishing solve above. Both timed
+        # events below then measure steady-state churn reaction.
+        p.sync_members(
+            [_Member(a, i != dead_warm) for i, a in enumerate(members)]
+        )
+        await p.rebalance()
+
+        # Event A: node 0 dies -> FULL re-solve, timed.
+        p.sync_members(
+            [_Member(a, i not in (dead_warm, 0)) for i, a in enumerate(members)]
+        )
+        t0 = time.perf_counter()
+        full_moved = await p.rebalance(delta=False)
+        full_ms = (time.perf_counter() - t0) * 1e3
+        full_mode = p.stats.mode
+
+        # Event B: node 1 dies -> DELTA re-solve, timed. Snapshot seats
+        # first (untimed) for the undisplaced-move audit.
+        pre_seats = dict(p._placements)
+        p.sync_members(
+            [
+                _Member(a, i not in (dead_warm, 0, 1))
+                for i, a in enumerate(members)
+            ]
+        )
+        t1 = time.perf_counter()
+        delta_moved = await p.rebalance()
+        delta_ms = (time.perf_counter() - t1) * 1e3
+        delta_mode = p.stats.mode
+        displaced = p.stats.displaced
+
+        dead_idx = p._nodes[members[1]].index
+        undisplaced_moves = sum(
+            1
+            for k, v in pre_seats.items()
+            if v != dead_idx and p._placements.get(k) != v
+        )
+        counts_after = np.asarray(
+            [len(p._by_node.get(i, ())) for i in range(p._node_axis)],
+            np.float64,
+        )
+        cap_alive = np.zeros((p._node_axis,), np.float64)
+        for i, a in enumerate(members):
+            cap_alive[p._nodes[a].index] = (
+                0.0 if i in (dead_warm, 0, 1) else 1.0
+            )
+        quota = integer_fair_quotas(cap_alive, n_obj).astype(np.float64)
+        safe = np.maximum(cap_alive, 1e-9)
+        cost_ratio = float(
+            np.sum(counts_after**2 / safe) / max(np.sum(quota**2 / safe), 1e-9)
+        )
+        return {
+            "n_obj": n_obj,
+            "n_nodes": n_nodes,
+            "full_mode": full_mode,
+            "delta_mode": delta_mode,
+            "full_ms": round(full_ms, 2),
+            "full_moved": int(full_moved),
+            "delta_ms": round(delta_ms, 2),
+            "delta_moved": int(delta_moved),
+            "displaced": int(displaced),
+            "undisplaced_moves": int(undisplaced_moves),
+            "speedup": round(full_ms / max(delta_ms, 1e-6), 2),
+            "cost_ratio": round(cost_ratio, 5),
+        }
+
+    return asyncio.run(_run())
+
+
+def run_delta_tier(n_obj: int, platform: str, deadline: float) -> None:
+    """Child entry for the churn-reaction A/B (full vs delta rebalance).
+
+    Same defensive shape as every other tier child: watchdog armed before
+    any jax touch, backend probed exactly once, result line printed and
+    flushed the moment it exists. CPU-rehearsable:
+    ``python bench.py --delta --platform cpu``.
+    """
+    start = time.monotonic()
+    init_watchdog = _arm_watchdog(deadline, EXIT_WATCHDOG)
+    probe_timer = _arm_watchdog(min(PROBE_DEADLINE_S, deadline), EXIT_INIT_FAIL)
+    import jax
+
+    try:
+        devices = jax.devices()
+    except Exception as e:
+        print(f"# backend init failed: {type(e).__name__}: {e}", file=sys.stderr)
+        sys.exit(EXIT_INIT_FAIL)
+    probe_timer.cancel()
+    print(f"# devices: {devices}", file=sys.stderr)
+    if platform == "tpu" and devices[0].platform != "tpu":
+        print(f"# expected tpu, got platform={devices[0].platform}", file=sys.stderr)
+        sys.exit(EXIT_INIT_FAIL)
+    init_watchdog.cancel()
+    _arm_watchdog(deadline - (time.monotonic() - start), EXIT_TIER_TIMEOUT)
+    try:
+        tier = _delta_churn_rate(n_obj)
+    except Exception as e:
+        print(f"# delta tier failed: {type(e).__name__}: {e}", file=sys.stderr)
+        sys.exit(EXIT_SOLVE_FAIL)
+    result = {
+        "ok": True,
+        "kind": "delta",
+        "platform": platform,
+        "device": str(devices[0]),
+        **tier,
+    }
+    print(json.dumps(result), flush=True)
+
+
 def run_tier(n_obj: int, platform: str, deadline: float) -> None:
     """Child entry: probe backend once, run one tier, print JSON result lines.
 
@@ -1270,7 +1424,7 @@ def run_tier(n_obj: int, platform: str, deadline: float) -> None:
 
 def _run_child(
     n_obj: int, platform: str, deadline: float, hier: bool = False,
-    collapsed: bool = False,
+    collapsed: bool = False, delta: bool = False,
 ):
     """Run one tier child; returns (rc, parsed_json_or_None)."""
     env = os.environ.copy()
@@ -1294,6 +1448,8 @@ def _run_child(
         cmd.append("--hier")
     if collapsed:
         cmd.append("--collapsed")
+    if delta:
+        cmd.append("--delta")
     try:
         proc = subprocess.run(
             cmd, stdout=subprocess.PIPE, env=env,
@@ -1713,6 +1869,18 @@ def main() -> None:
             # EXIT_SOLVE_FAIL (OOM) or EXIT_TIER_TIMEOUT (healthy probe, tier
             # too slow): a smaller tier may still fit the deadline.
             print(f"# tier {n_obj} rc={rc}; trying smaller tier", file=sys.stderr)
+    # Churn-reaction A/B (full vs delta rebalance at 1M x 64): TPU
+    # opportunistic — the acceptance numbers are CPU's, so a relay hiccup
+    # here costs nothing banked.
+    delta_tier = None
+    if not tpu_down:
+        rc, delta_tier = _run_child(1_048_576, "tpu", 480.0, delta=True)
+        if delta_tier:
+            detail["delta_tier"] = delta_tier
+            print(f"# delta churn tier: {delta_tier}", file=sys.stderr)
+        elif rc in (EXIT_INIT_FAIL, EXIT_WATCHDOG):
+            tpu_down = True
+            print("# TPU backend unavailable; falling back to CPU", file=sys.stderr)
     if result is not None and result.get("platform") == "tpu":
         # BASELINE row 5 (scale ceiling): hierarchical 2-level OT toward
         # 10M x 1k, in its OWN child so an overrun can't cost the banked
@@ -1801,6 +1969,11 @@ def main() -> None:
         if collapsed:
             detail["collapsed_tier"] = collapsed
             print(f"# collapsed rebalance tier (cpu): {collapsed}", file=sys.stderr)
+    if delta_tier is None:
+        rc, delta_tier = _run_child(1_048_576, "cpu", 600.0, delta=True)
+        if delta_tier:
+            detail["delta_tier"] = delta_tier
+            print(f"# delta churn tier (cpu): {delta_tier}", file=sys.stderr)
     detail["solve_tier"] = result
     _write_detail(detail)
 
@@ -1905,6 +2078,10 @@ if __name__ == "__main__":
     parser.add_argument("--deadline", type=float, default=300.0)
     parser.add_argument("--hier", action="store_true")
     parser.add_argument("--collapsed", action="store_true")
+    # Churn-reaction A/B (full vs delta rebalance). Works without --tier
+    # (defaults to the 1M x 64 acceptance shape); CPU rehearsal:
+    # `python bench.py --delta --platform cpu`.
+    parser.add_argument("--delta", action="store_true")
     # Rehearse the migration-drain host stage alone (CPU-safe: in-process
     # live cluster, never touches the relay).
     parser.add_argument("--migration", action="store_true")
@@ -1924,6 +2101,8 @@ if __name__ == "__main__":
     elif args.tracing:
         _pin_orchestrator_to_cpu()
         print(json.dumps(tracing_overhead()))
+    elif args.delta:
+        run_delta_tier(args.tier or 1_048_576, args.platform, args.deadline)
     elif args.tier is not None and args.hier:
         run_hier_tier(args.tier, args.deadline, args.platform)
     elif args.tier is not None and args.collapsed:

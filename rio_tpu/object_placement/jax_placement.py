@@ -39,7 +39,9 @@ from ..registry import ObjectId
 from ..ops import (
     build_cost_matrix,
     greedy_balanced_assign,
+    integer_fair_quotas,
     plan_rounded_assign,
+    residual_capacity_assign,
     scaling_sinkhorn,
     sinkhorn,
 )
@@ -335,6 +337,31 @@ def _guard_sentinel_spill(repaired, real, m_axis: int, cap_alive):
     return route_sentinel_spill(repaired, real, m_axis, cap_alive)
 
 
+@_functools.partial(
+    jax.jit, static_argnames=("mode", "move_cost", "eps", "n_iters")
+)
+def _class_refresh_device(base, counts, cap_alive, g_seed, *, mode, move_cost, eps, n_iters):
+    """Warm M x M class potential refresh, one jitted pipeline.
+
+    The solvers are eager ``lax.scan`` builders — each un-jitted call
+    re-traces the scan body (~160 ms of pure tracing at M=64, dwarfing
+    the microseconds of device math). The jit wrapper is cached per
+    (mode, shapes, config floats), so a delta event's refresh is
+    sub-millisecond after the first churn event pays the compile. The
+    config floats are STATIC on purpose: they change only with provider
+    construction, and keeping them out of the traced arguments lets XLA
+    fold the stay-put diagonal."""
+    m = base.shape[0]
+    ccost = jnp.broadcast_to(base[None, :], (m, m)) - (
+        move_cost * jnp.eye(m, dtype=jnp.float32)
+    )
+    solver = scaling_sinkhorn if mode == "scaling" else sinkhorn
+    _f, g, _err = solver(
+        ccost, counts, cap_alive, eps=eps, n_iters=n_iters, g_init=g_seed
+    )
+    return g
+
+
 def _apply_class_quotas(quotas: np.ndarray, cur_idx: np.ndarray) -> np.ndarray:
     """Expand (M x M) class quotas into a per-object assignment, O(N + M^2).
 
@@ -472,6 +499,41 @@ class _NodeSlot:
 
 
 @dataclass
+class PlanState:
+    """The previous committed solve, persisted as a first-class object.
+
+    This is what turns the solver architecture from "re-solve the world"
+    into "maintain a plan incrementally": a churn event no longer pays the
+    full-directory solve — ``rebalance`` re-solves ONLY the displaced +
+    new objects against the plan's residual capacity, warm-starting the
+    Sinkhorn potentials from here (see ``_delta_solve``). The full solve
+    remains the fallback when the displaced fraction exceeds
+    ``delta_threshold``, after ``max_delta_solves`` consecutive deltas
+    (staleness), or when the transport-cost audit trips (``stale``).
+
+    Snapshot discipline: a PlanState is immutable after construction and
+    atomically swapped on ``self._plan`` under the provider lock — the
+    solver thread reads the snapshot it was handed, never the live field.
+    """
+
+    # (node_axis,) node potentials of the committing solve (jax array;
+    # None for solves that produce none, e.g. greedy).
+    g: object | None
+    # (G,) coarse-stage group potentials from a hierarchical solve (numpy;
+    # None for flat solves) — warm seed for the next coarse stage.
+    coarse_g: object | None
+    # (node_axis,) PLANNED per-node seat counts at commit. With a
+    # move_sink the directory converges to this as handoffs land; delta
+    # displacement is always recomputed from the live directory snapshot,
+    # so this is diagnostic, not load-bearing.
+    seat_counts: np.ndarray
+    epoch: int  # directory epoch the plan was committed at
+    liveness_fp: frozenset  # schedulable node indices at commit
+    delta_solves: int = 0  # consecutive deltas since the last full solve
+    stale: bool = False  # quality audit tripped: next solve goes full
+
+
+@dataclass
 class SolveStats:
     """Diagnostics from the last full re-solve."""
 
@@ -480,6 +542,9 @@ class SolveStats:
     solve_ms: float = 0.0
     apply_ms: float = 0.0  # mover-only directory update (host, under lock)
     moved: int = 0
+    # Objects the solve actually re-solved: the displaced set for a
+    # "*+delta" solve, the whole directory for a full one.
+    displaced: int = 0
     epoch: int = 0
     mode: str = "none"
     discarded: bool = False
@@ -507,9 +572,22 @@ class JaxObjectPlacement(ObjectPlacement):
         node_features=None,
         affinity_tracker: "AffinityTracker | None" = None,
         object_costs=None,
+        delta_threshold: float = 0.25,
+        max_delta_solves: int = 8,
+        delta_audit_ratio: float = 1.05,
     ) -> None:
         self._eps = eps
         self._n_iters = n_iters
+        # Incremental (delta) rebalance knobs: a churn re-solve goes
+        # through the delta path while the displaced fraction stays at or
+        # below delta_threshold (0 disables deltas entirely), falls back
+        # to a full solve after max_delta_solves consecutive deltas
+        # (staleness bound on the warm potentials), and whenever the
+        # transport-cost audit finds the delta plan worse than
+        # delta_audit_ratio x the ideal quota cost.
+        self._delta_threshold = delta_threshold
+        self._max_delta_solves = max_delta_solves
+        self._delta_audit_ratio = delta_audit_ratio
         # "auto" resolves LAZILY at the first solve: jax.default_backend()
         # initializes the jax backend, and constructing a provider must
         # never block on that — against a wedged TPU relay a backend init
@@ -576,6 +654,18 @@ class JaxObjectPlacement(ObjectPlacement):
         self._node_axis = node_axis_size  # static node axis (padded)
         self._epoch = 0
         self._g: jax.Array | None = None  # cached node potentials (padded axis)
+        # Liveness fingerprint the cached potentials were solved over: the
+        # schedulable node indices at commit. Potentials stay valid while
+        # every one of those nodes REMAINS schedulable (churn on unrelated
+        # nodes — registrations, dead->alive flips — never touches them);
+        # a solved-over node leaving the set drops the cache (its finite g
+        # entry would keep attracting the warm assign_batch path).
+        self._g_fp: frozenset | None = None
+        # Previous committed solve (potentials + seat counts + epoch) —
+        # the incremental-rebalance state. See PlanState.
+        self._plan: PlanState | None = None
+        # Liveness-flip subscribers (the placement daemon's event kick).
+        self._churn_listeners: list = []
         self._lock = asyncio.Lock()
         self.stats = SolveStats()
 
@@ -618,6 +708,47 @@ class JaxObjectPlacement(ObjectPlacement):
             -SolveStats.HISTORY_LIMIT:
         ]
 
+    # -------------------------------------------- potentials / churn events
+    def _sched_fp(self) -> frozenset:
+        """Schedulable-node fingerprint: indices of nodes that can take
+        NEW seats right now (alive, not cordoned, capacity > 0)."""
+        return frozenset(
+            s.index
+            for s in self._nodes.values()
+            if s.alive and not s.cordoned and s.capacity > 0
+        )
+
+    def _invalidate_potentials(self) -> None:
+        """Version the cached potentials by liveness fingerprint instead
+        of nulling them on every membership event: ``_g`` survives churn
+        on UNRELATED nodes (new registrations, dead->alive recoveries,
+        uncordons — their g entries are -inf, so the warm ``assign_batch``
+        path never seats there until the next solve refreshes them, which
+        is merely conservative). Only a solved-over node LEAVING the
+        schedulable set (death, cordon, capacity loss) drops the cache:
+        its finite potential would keep pulling new placements onto a node
+        that must not take them."""
+        if self._g is None:
+            return
+        if self._g_fp is None or not (self._g_fp <= self._sched_fp()):
+            self._g = None
+            self._g_fp = None
+
+    def add_churn_listener(self, cb) -> None:
+        """Register a zero-arg callable fired after every liveness-affecting
+        change (``sync_members`` flips, ``cordon``/``uncordon``,
+        ``clean_server``). Fired on the event loop, synchronously with the
+        mutation — listeners must only flag/schedule (the placement
+        daemon's event kick sets an ``asyncio.Event``), never block."""
+        self._churn_listeners.append(cb)
+
+    def _notify_churn(self) -> None:
+        for cb in list(self._churn_listeners):
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 - listeners never break liveness
+                pass
+
     # ------------------------------------------------- directory internals
     def _set_placement(self, key: str, idx: int) -> bool:
         """Point ``key`` at node ``idx`` keeping the per-node index in sync.
@@ -653,9 +784,13 @@ class JaxObjectPlacement(ObjectPlacement):
         if slot is None:
             idx = len(self._node_order)
             if idx >= self._node_axis:
-                # Grow the static node axis (rare; forces one recompile tier).
+                # Grow the static node axis (rare; forces one recompile
+                # tier). Cached potentials AND the incremental plan carry
+                # old-axis shapes — both must go.
                 self._node_axis *= 2
                 self._g = None
+                self._g_fp = None
+                self._plan = None
             slot = _NodeSlot(address=address, index=idx)
             self._nodes[address] = slot
             self._node_order.append(address)
@@ -698,7 +833,11 @@ class JaxObjectPlacement(ObjectPlacement):
                 changed = True
         if changed:
             self._epoch += 1
-            self._g = None  # potentials are stale once liveness changes
+            # Fingerprint-versioned, NOT nulled: churn on unrelated nodes
+            # (new members, dead->alive recoveries) keeps the warm cache;
+            # only a solved-over node leaving the schedulable set drops it.
+            self._invalidate_potentials()
+            self._notify_churn()
 
     # Derates quantize to 1/8 steps: sync_load runs every monitor tick
     # (~seconds), and an un-quantized float would change on every call,
@@ -727,7 +866,12 @@ class JaxObjectPlacement(ObjectPlacement):
                 changed = True
         if changed:
             self._epoch += 1
-            self._g = None
+            # Derates floor at 0.1 and never zero a capacity column, so no
+            # node LEAVES the schedulable set here — the fingerprint check
+            # keeps the potentials (they merely under-react to the new
+            # derate until the next solve refreshes them). No churn
+            # notification: load drift is the daemon's normal poll work.
+            self._invalidate_potentials()
 
     # --------------------------------------------------------------- drain
     def cordon(self, address: str) -> None:
@@ -758,7 +902,8 @@ class JaxObjectPlacement(ObjectPlacement):
             )
         slot.cordoned = True
         self._epoch += 1
-        self._g = None
+        self._invalidate_potentials()
+        self._notify_churn()
 
     def uncordon(self, address: str) -> None:
         slot = self._nodes.get(address)
@@ -767,7 +912,8 @@ class JaxObjectPlacement(ObjectPlacement):
         if slot.cordoned:
             slot.cordoned = False
             self._epoch += 1
-            self._g = None
+            self._invalidate_potentials()
+            self._notify_churn()
 
     @property
     def cordoned(self) -> set[str]:
@@ -839,7 +985,8 @@ class JaxObjectPlacement(ObjectPlacement):
                 self._drop_placement(k)
             self._by_node.pop(slot.index, None)
             self._epoch += 1
-            self._g = None
+            self._invalidate_potentials()
+            self._notify_churn()
 
     async def remove(self, object_id: ObjectId) -> None:
         async with self._lock:
@@ -1056,6 +1203,7 @@ class JaxObjectPlacement(ObjectPlacement):
     def _hierarchical_solve(
         self, keys: list[str], node_order: list[str], cap, alive,
         cur_idx=None, move_cost: float = 0.0, move_w=None,
+        coarse_g_init=None,
     ):
         """Two-level OT re-solve over hashed identity features.
 
@@ -1074,6 +1222,13 @@ class JaxObjectPlacement(ObjectPlacement):
         reshuffling millions of actors. Native ``mode="hierarchical"``
         solves don't use it: there the tracker's learned features are the
         stickiness mechanism and double-counting would over-stick.
+
+        ``coarse_g_init`` warm-starts the coarse group solve from a prior
+        plan's potentials (delta path); used only when its length matches
+        this solve's group count. Returns ``(assignment, g, coarse_g)``:
+        the flat node potentials are always None here (the two-level solve
+        produces group potentials instead), ``coarse_g`` is the coarse
+        stage's (n_groups,) potentials — None on the sharded path.
         """
         from ..parallel.hierarchical import hierarchical_assign
 
@@ -1168,6 +1323,16 @@ class JaxObjectPlacement(ObjectPlacement):
             coarse_iters=self._n_iters,
             fine_iters=self._n_iters,
         )
+        # Warm coarse seed from the previous plan — only when the group
+        # axis still matches (axis growth / group-count drift means the
+        # cached potentials describe a different problem: cold-start).
+        # Cold start IS the zero seed (g0 = 0 in both solver forms), so
+        # always pass an array: a None-vs-array flip would otherwise mint
+        # a second jit trace for the exact same computation.
+        if coarse_g_init is None or (
+            np.asarray(coarse_g_init).shape != (n_groups,)
+        ):
+            coarse_g_init = np.zeros((n_groups,), np.float32)
         if self._mesh is not None:
             # Shard the object axis across the mesh (the tier this mode is
             # for); pad to a shard multiple with zero-feature rows and let
@@ -1190,17 +1355,389 @@ class JaxObjectPlacement(ObjectPlacement):
             res = _hier.chunked_hierarchical_assign(
                 obj_feat, jnp.asarray(node_feat),
                 jnp.asarray(cap_np), jnp.asarray(alive_np),
-                n_chunks=n_chunks, **kw,
+                n_chunks=n_chunks,
+                coarse_g_init=jnp.asarray(coarse_g_init),
+                **kw,
             )
         else:
             res = hierarchical_assign(
                 obj_feat, jnp.asarray(node_feat),
-                jnp.asarray(cap_np), jnp.asarray(alive_np), **kw,
+                jnp.asarray(cap_np), jnp.asarray(alive_np),
+                coarse_g_init=jnp.asarray(coarse_g_init),
+                **kw,
             )
-        return res.assignment[:n], None
+        coarse_g = (
+            None if res.coarse_g is None else np.asarray(res.coarse_g, np.float32)
+        )
+        return res.assignment[:n], None, coarse_g
 
-    async def rebalance(self, *, mode: str | None = None, move_sink=None) -> int:
-        """Full re-solve of every tracked object; returns number of moves.
+    # ---------------------------------------------------- incremental solve
+    def _delta_gates_ok(self, plan: PlanState | None, force: bool) -> bool:
+        """Delta-eligibility gates shared by both delta paths: a plan must
+        exist; ``force`` overrides everything else (threshold disabled,
+        plan marked stale by the transport-cost audit, staleness bound of
+        ``max_delta_solves`` consecutive deltas)."""
+        if plan is None:
+            return False
+        if force:
+            return True
+        if self._delta_threshold <= 0.0 or plan.stale:
+            return False
+        return plan.delta_solves < self._max_delta_solves
+
+    def _class_refresh(self, load, cap, alive, counts_np, cap_alive, mode, plan):
+        """Warm potential refresh at the STATIC class shape (M x M): the
+        same collapse the full path exploits, seeded with the plan's
+        potentials so a handful of iterations re-converges after one
+        liveness flip. No N dependence -> no per-event recompile, one
+        cached executable per node axis (see ``_class_refresh_device``).
+        Returns ``(g, score)`` — the new column potentials and the
+        per-node host fill score. A missing seed is passed as zeros, not
+        None: cold start IS the zero seed in both solver forms, and a
+        None-vs-array flip would mint a second trace."""
+        base = build_cost_matrix(jnp.zeros_like(load), cap, alive)[0]
+        g_seed = (
+            jnp.zeros((base.shape[0],), jnp.float32)
+            if plan.g is None
+            else jnp.asarray(plan.g)
+        )
+        g_r = _class_refresh_device(
+            base,
+            jnp.asarray(np.asarray(counts_np, np.float32)),
+            jnp.asarray(cap_alive.astype(np.float32)),
+            g_seed,
+            mode=mode,
+            move_cost=self._move_cost,
+            eps=min(
+                self._eps,
+                self._move_cost / 25.0 if self._move_cost > 0 else self._eps,
+            ),
+            n_iters=max(4, min(8, self._n_iters)),
+        )
+        g_np = np.asarray(g_r, np.float64)
+        score = np.asarray(base, np.float64) - np.where(
+            np.isfinite(g_np), g_np, -1e30
+        )
+        return g_r, score
+
+    def _delta_fast_snapshot(self, plan, n, cap, alive, force):
+        """O(displaced) delta snapshot, taken under the provider lock.
+
+        The dominant per-event host cost of a churn rebalance at directory
+        scale is not the solve — it is materializing the O(N) key/seat
+        array snapshot (~0.35 s per million objects). For the dominant
+        churn shape — nodes LEAVING the schedulable set with every
+        survivor at or under its integer fair quota — the displaced set is
+        exactly the departed nodes' seats, which ``_by_node`` already
+        holds. This helper detects that shape in O(M) and snapshots just
+        the displaced ``(key, old_index)`` pairs, so the whole event costs
+        O(displaced + M^2) instead of O(N).
+
+        Returns None whenever per-seat decisions could matter — a survivor
+        over its integer quota needs rank-based eviction (honoring
+        ``object_costs`` prices); the array-snapshot delta / full pipeline
+        handles those. Per-object prices are irrelevant HERE by
+        construction: with no survivor over quota there are no evictions,
+        so prices cannot change which objects move, and the flat cost
+        model prices every destination identically for all objects.
+        """
+        if not self._delta_gates_ok(plan, force):
+            return None
+        cap_np = np.asarray(cap, np.float64)
+        alive_np = np.asarray(alive, np.float64)
+        cap_alive = cap_np * (alive_np > 0)
+        m = cap_alive.shape[0]
+        sched = cap_alive > 0.0
+        counts = np.zeros(m, np.int64)
+        for j, seats in self._by_node.items():
+            if j < m:
+                counts[j] = len(seats)
+        quota = integer_fair_quotas(cap_alive, n)
+        if np.any(sched & (counts > quota)):
+            return None  # over-quota eviction: needs per-seat ranks
+        disp_nodes = np.nonzero(~sched & (counts > 0))[0]
+        d = int(counts[disp_nodes].sum())
+        if not force and d > self._delta_threshold * n:
+            return None
+        disp: list[tuple[str, int]] = []
+        for j in disp_nodes.tolist():
+            disp.extend((k, j) for k in self._by_node.get(j, ()))
+        retained = np.where(sched, counts, 0)
+        residual = quota - retained
+        return {
+            "disp": disp,
+            "counts": counts,
+            "cap_alive": cap_alive,
+            "quota": quota,
+            "retained": retained,
+            "residual": residual,
+            "d": d,
+        }
+
+    async def _delta_fast_rebalance(
+        self, fast, *, n, mode, move_sink, load, cap, alive,
+        node_order, plan, snapshot_epoch,
+    ) -> int:
+        """Solve + commit an O(displaced) fast delta (see
+        :meth:`_delta_fast_snapshot`). Same thread/epoch discipline as the
+        array pipeline: device work off the event loop, epoch re-checked
+        under the lock before apply, discarded attempts recorded."""
+        from ..tracing import span
+
+        solved_as = f"{mode}+delta"
+        disp = fast["disp"]
+        d = fast["d"]
+        residual = fast["residual"]
+        cap_alive = fast["cap_alive"]
+        quota = fast["quota"]
+        retained = fast["retained"]
+        m = cap_alive.shape[0]
+        sched = cap_alive > 0.0
+
+        def _solve():
+            t0 = time.perf_counter()
+            with span("placement_solve", mode=solved_as, n=n):
+                g_new = None
+                coarse_new = None
+                if d == 0:
+                    # Nothing displaced (pure load jitter): the plan stands.
+                    fill = np.zeros((0,), np.int32)
+                elif mode == "hierarchical":
+                    # Displaced keys through the two-level solve against
+                    # the residual columns (chunk-shape compile bound).
+                    res_cap = residual.astype(np.float32)
+                    res_alive = (residual > 0).astype(np.float32)
+                    fill, _, coarse_new = self._hierarchical_solve(
+                        [k for k, _ in disp], node_order, res_cap,
+                        res_alive, coarse_g_init=plan.coarse_g,
+                    )
+                    fill = _route_unseatable(
+                        np.asarray(fill, np.int32), len(node_order), load,
+                        res_alive, res_cap,
+                    )
+                else:
+                    if mode in ("sinkhorn", "scaling"):
+                        g_new, score = self._class_refresh(
+                            load, cap, alive, fast["counts"], cap_alive,
+                            mode, plan,
+                        )
+                    else:
+                        score = np.where(
+                            sched, retained / np.maximum(quota, 1), 1e18
+                        )
+                    fill = residual_capacity_assign(score, residual)
+                # Transport-cost audit (see _delta_solve): achieved
+                # seating vs the integer-quota ideal; a tripped audit
+                # marks the plan stale so the NEXT solve goes full.
+                counts_after = (
+                    retained + np.bincount(fill, minlength=m)
+                ).astype(np.float64)
+                safe_cap = np.maximum(cap_alive, 1e-9)
+                num = float(np.sum(counts_after**2 / safe_cap))
+                den = float(np.sum(quota.astype(np.float64) ** 2 / safe_cap))
+                stale = bool(
+                    den > 0.0 and num > self._delta_audit_ratio * den
+                )
+                return fill, g_new, coarse_new, (
+                    time.perf_counter() - t0
+                ) * 1e3, stale, counts_after
+
+        fill, g, coarse_g, solve_ms, stale, counts_after = (
+            await asyncio.to_thread(_solve)
+        )
+
+        async with self._lock:
+            if self._epoch != snapshot_epoch:
+                self.stats = SolveStats(
+                    n_objects=n,
+                    n_nodes=len(self._node_order),
+                    solve_ms=solve_ms,
+                    displaced=d,
+                    epoch=self._epoch,
+                    mode=solved_as,
+                    discarded=True,
+                    history=self._archived_history(),
+                )
+                return 0
+            hist = self._archived_history()
+            t_apply = time.perf_counter()
+            moved = 0
+            planned: list[tuple[str, str, str]] = []
+            for (key, old_idx), new_idx in zip(disp, fill.tolist()):
+                if move_sink is not None:
+                    planned.append(
+                        (key, node_order[old_idx], node_order[int(new_idx)])
+                    )
+                elif self._set_placement(key, int(new_idx)):
+                    moved += 1
+            if move_sink is not None:
+                moved = len(planned)
+            if g is not None:
+                self._g = g
+                self._g_fp = self._sched_fp()
+            self._recount_loads()
+            self._epoch += 1
+            self._plan = PlanState(
+                g=g if g is not None else plan.g,
+                coarse_g=coarse_g if coarse_g is not None else plan.coarse_g,
+                seat_counts=np.asarray(counts_after, np.int64),
+                epoch=self._epoch,
+                liveness_fp=self._sched_fp(),
+                delta_solves=plan.delta_solves + 1,
+                stale=stale,
+            )
+            self.stats = SolveStats(
+                n_objects=n,
+                n_nodes=len(self._node_order),
+                solve_ms=solve_ms,
+                apply_ms=(time.perf_counter() - t_apply) * 1e3,
+                moved=moved,
+                displaced=d,
+                epoch=self._epoch,
+                mode=solved_as,
+                discarded=False,
+                history=hist,
+            )
+        if planned:
+            planned.sort(key=lambda mv: (mv[1], mv[2]))
+            # Outside the lock on purpose: handoffs call back into
+            # update()/lookup(), which take it.
+            await move_sink(planned)
+        return moved
+
+    def _delta_solve(
+        self, keys, cur_idx, load, cap, alive, n_real, node_order,
+        plan: PlanState, mode: str, obj_w, force: bool,
+    ):
+        """Delta rebalance: re-solve ONLY the displaced objects against
+        residual capacity, warm-starting from the previous plan.
+
+        The displaced set is (a) every seat on a node that left the
+        schedulable set (dead / cordoned / capacity-zero) plus (b) the
+        over-quota overflow on surviving nodes (per-seat rank beyond the
+        node's integer fair quota). Undisplaced objects keep their seats
+        BY CONSTRUCTION — they are never re-solved — and the displaced
+        fill targets each node's residual quota (quota minus retained
+        seats), so the result lands on exactly the same integer per-node
+        counts a full quota-repaired solve would produce. One churn event
+        then costs O(N) host work + an O(M^2) warm potential refresh,
+        not an O(N x M) directory solve.
+
+        Runs in the solver thread over loop-side snapshots only (the
+        provider's standard discipline); reads nothing live but immutable
+        config. Returns ``(assignment, g, coarse_g, displaced, stale)``,
+        or None when a gate says this event needs the full solve:
+        no plan / plan marked stale / ``max_delta_solves`` consecutive
+        deltas exceeded / displaced fraction above ``delta_threshold``
+        (``force`` overrides every gate except a missing plan).
+        """
+        n = len(keys)
+        if n == 0 or not self._delta_gates_ok(plan, force):
+            return None
+        cap_np = np.asarray(cap, np.float64)
+        alive_np = np.asarray(alive, np.float64)
+        cap_alive = cap_np * (alive_np > 0)
+        m = cap_alive.shape[0]
+        sched = cap_alive > 0.0
+        quota = integer_fair_quotas(cap_alive, n)  # (m,), sums to n exactly
+        cur = np.asarray(cur_idx, np.int64)
+        # Rank each object within its current seat's population (one
+        # stable sort — the host analog of ops.assignment.rank_within_group).
+        # With per-object move prices the heavy/hot objects rank first and
+        # are kept, so quota pressure evicts cold objects — mirroring the
+        # dense path's scaled stay-put discount.
+        if obj_w is not None:
+            order = np.lexsort((-np.asarray(obj_w, np.float64), cur))
+        else:
+            order = np.argsort(cur, kind="stable")
+        sorted_seats = cur[order]
+        starts = np.searchsorted(sorted_seats, np.arange(m))
+        rank = np.empty(n, np.int64)
+        rank[order] = np.arange(n) - starts[sorted_seats]
+        keep = sched[cur] & (rank < quota[cur])
+        disp_pos = np.nonzero(~keep)[0]
+        d = int(disp_pos.shape[0])
+        if d == 0:
+            # Nothing displaced (e.g. a node RETURNED): the plan stands.
+            return cur.astype(np.int32), None, None, 0, False
+        if not force and d > self._delta_threshold * n:
+            return None
+        # retained[j] = min(counts[j], quota[j]) on schedulable nodes, 0
+        # elsewhere; residual >= 0 and sums to d exactly (quota sums to n,
+        # retained to n - d).
+        retained = np.bincount(cur[keep], minlength=m)
+        residual = quota - retained
+
+        g_new = None
+        coarse_new = None
+        if mode == "hierarchical":
+            # Route the displaced keys through the two-level solve against
+            # the residual capacity columns — the chunked dispatch inside
+            # keeps any displaced count compile-bounded at the chunk shape.
+            disp_keys = [keys[i] for i in disp_pos.tolist()]
+            res_cap = residual.astype(np.float32)
+            res_alive = (residual > 0).astype(np.float32)
+            fill, _, coarse_new = self._hierarchical_solve(
+                disp_keys, node_order, res_cap, res_alive,
+                coarse_g_init=plan.coarse_g,
+            )
+            fill = _route_unseatable(
+                np.asarray(fill, np.int32), n_real, load, res_alive, res_cap
+            )
+        else:
+            if mode in ("sinkhorn", "scaling"):
+                # Warm M x M potential refresh (see _class_refresh).
+                g_new, score = self._class_refresh(
+                    load, cap, alive, np.bincount(cur, minlength=m),
+                    cap_alive, mode, plan,
+                )
+            else:
+                # Greedy has no potentials: order nodes by how full their
+                # retained population already is. Every feasible fill hits
+                # the same per-node counts (residual is integer-exact), so
+                # the score only decides WHICH interchangeable seat runs
+                # land where.
+                score = np.where(
+                    sched, retained / np.maximum(quota, 1), 1e18
+                )
+            fill = residual_capacity_assign(score, residual)
+        out = cur.astype(np.int32).copy()
+        out[disp_pos] = fill
+
+        # Transport-cost audit (quadratic congestion proxy): compare the
+        # achieved per-node seating against the ideal integer quotas. The
+        # flat fills hit the quotas exactly (ratio 1.0 by construction);
+        # the hierarchical fill is capacity-proportional per group, and
+        # repeated deltas can drift — a tripped audit marks the plan stale
+        # so the NEXT solve goes full. Unschedulable nodes get a tiny
+        # capacity floor, so any stray seat there blows the ratio up and
+        # forces the full solve — exactly the right reaction.
+        counts_after = np.bincount(out, minlength=m).astype(np.float64)
+        safe_cap = np.maximum(cap_alive, 1e-9)
+        num = float(np.sum(counts_after**2 / safe_cap))
+        den = float(np.sum(quota.astype(np.float64) ** 2 / safe_cap))
+        stale = bool(den > 0.0 and num > self._delta_audit_ratio * den)
+        return out, g_new, coarse_new, d, stale
+
+    async def rebalance(
+        self,
+        *,
+        mode: str | None = None,
+        move_sink=None,
+        delta: bool | None = None,
+    ) -> int:
+        """Re-solve the directory; returns number of moves.
+
+        By default (``delta=None``) a churn event first attempts the
+        incremental **delta** path (:meth:`_delta_solve`): only displaced +
+        new objects are re-solved against residual capacity with
+        warm-started potentials, and the full-directory solve runs only
+        when a delta gate trips (no/stale plan, displaced fraction over
+        ``delta_threshold``, ``max_delta_solves`` staleness bound, or the
+        transport-cost audit). ``delta=False`` forces the full solve;
+        ``delta=True`` forces the delta path whenever a plan exists
+        (overriding threshold and staleness). ``stats.mode`` reports which
+        path ran (``"<mode>+delta"`` for an incremental solve).
 
         Snapshots the epoch before the (async-yielding) device solve and
         discards the result if the directory changed underneath — the
@@ -1220,21 +1757,41 @@ class JaxObjectPlacement(ObjectPlacement):
         # and silently run the greedy branch).
         mode = self._solver_mode() if mode in (None, "auto") else mode
         async with self._lock:
-            keys = list(self._placements.keys())
-            cur_idx = np.fromiter(
-                (self._placements[k] for k in keys), np.int32, count=len(keys)
-            )
+            n = len(self._placements)
             snapshot_epoch = self._epoch
             self._recount_loads()
             load, cap, alive = self._node_vectors()
             node_order = list(self._node_order)  # snapshot for off-lock use
             no_capacity = self._no_schedulable_capacity_host()
-        if not keys:
+            plan = self._plan  # immutable snapshot (atomic-swap field)
+            # O(displaced) fast path FIRST: for pure node-departure churn
+            # the displaced keys come straight from _by_node and the O(N)
+            # key/seat snapshot below — the dominant per-event host cost
+            # at directory scale — is skipped entirely.
+            fast = None
+            if delta is not False and n and not no_capacity:
+                fast = self._delta_fast_snapshot(
+                    plan, n, cap, alive, force=(delta is True)
+                )
+            if fast is None and n:
+                keys = list(self._placements.keys())
+                # values() iterates in keys() order (insertion order) and
+                # skips the per-key hash lookup a genexpr would pay — the
+                # snapshot was ~0.35 s/1M objects as a genexpr.
+                cur_idx = np.fromiter(
+                    self._placements.values(), np.int32, count=n
+                )
+        if not n:
             return 0
+        if fast is not None:
+            return await self._delta_fast_rebalance(
+                fast, n=n, mode=mode, move_sink=move_sink, load=load,
+                cap=cap, alive=alive, node_order=node_order, plan=plan,
+                snapshot_epoch=snapshot_epoch,
+            )
 
-        n = len(keys)
         bucket = _next_bucket(n)
-        def _solve() -> tuple[np.ndarray, jax.Array | None, float, str]:
+        def _solve() -> tuple:
             """Device solve off the event loop: np.asarray blocks until the
             TPU finishes, so running it in a thread keeps lookups/gossip/RPCs
             live — and makes the epoch-discard check below load-bearing.
@@ -1251,9 +1808,9 @@ class JaxObjectPlacement(ObjectPlacement):
                 # mode next to its SolveStats entry).
                 solved_as = f"{mode}+no_capacity"
                 with span("placement_solve", mode=solved_as, n=n):
-                    return cur_idx.copy(), None, (
+                    return cur_idx.copy(), None, None, (
                         time.perf_counter() - t0
-                    ) * 1e3, solved_as
+                    ) * 1e3, solved_as, 0, False
             # Per-object move prices (object_costs hook; tracker-measured
             # request rates + snapshot bytes by default). Evaluated in the
             # solver thread — hooks must read only atomically-swapped
@@ -1272,6 +1829,27 @@ class JaxObjectPlacement(ObjectPlacement):
                         obj_w = w
                     # Uniform weights are the scalar move_cost case:
                     # leave obj_w None and keep the collapsed fast path.
+            # Incremental attempt FIRST: with a prior plan and bounded
+            # displacement, the delta path replaces the whole directory
+            # solve below. Falls through to the full solve (returning
+            # None) when any gate trips.
+            if delta is not False and plan is not None:
+                with span("placement_solve", mode=f"{mode}+delta", n=n):
+                    d_res = self._delta_solve(
+                        keys, cur_idx, load, cap, alive,
+                        len(node_order), node_order, plan, mode, obj_w,
+                        force=(delta is True),
+                    )
+                    if d_res is not None:
+                        out_d, g_d, coarse_d, displaced, stale = d_res
+                        out_d = _route_unseatable(
+                            out_d, len(node_order), load, alive, cap
+                        )
+                        return (
+                            out_d, g_d, coarse_d,
+                            (time.perf_counter() - t0) * 1e3,
+                            f"{mode}+delta", displaced, stale,
+                        )
             # Decide the actual code path up front so traces, profiler
             # labels, and SolveStats.mode all agree on what ran.
             # Non-uniform per-object prices break the identical-cost-rows
@@ -1340,13 +1918,15 @@ class JaxObjectPlacement(ObjectPlacement):
                         repaired, real, m_axis, cap_alive
                     )
 
+                coarse_g = None
                 if mode == "hierarchical" or route_hier:
                     # Never materializes the flat (bucket x node_axis) cost.
-                    assignment, g = self._hierarchical_solve(
+                    assignment, g, coarse_g = self._hierarchical_solve(
                         keys, node_order, cap, alive,
                         cur_idx=cur_idx if route_hier else None,
                         move_cost=self._move_cost if route_hier else 0.0,
                         move_w=obj_w if route_hier else None,
+                        coarse_g_init=plan.coarse_g if plan is not None else None,
                     )
                 elif collapse:
                     # CLASS-COLLAPSED exact solve (ops/structured.py): the
@@ -1383,6 +1963,15 @@ class JaxObjectPlacement(ObjectPlacement):
                         move_cost=self._move_cost,
                         eps=class_eps,
                         n_iters=self._n_iters,
+                        # Warm-start even the FULL collapsed solve from the
+                        # previous plan's potentials: converged-from-warm
+                        # matches converged-from-cold within tolerance and
+                        # shaves iterations when liveness barely moved.
+                        g_init=(
+                            jnp.asarray(plan.g)
+                            if plan is not None and plan.g is not None
+                            else None
+                        ),
                     )
                     # Device expansion (exact parity with the host
                     # _apply_class_quotas, tested): the whole decision —
@@ -1456,6 +2045,11 @@ class JaxObjectPlacement(ObjectPlacement):
                             f, g, _err = dense(
                                 cost, mass, cap * alive,
                                 eps=self._eps, n_iters=self._n_iters,
+                                g_init=(
+                                    jnp.asarray(plan.g)
+                                    if plan is not None and plan.g is not None
+                                    else None
+                                ),
                             )
                         assignment = plan_rounded_assign(cost, f, g, self._eps)
                         # Exact-capacity repair (bucket-shaped for trace
@@ -1509,9 +2103,14 @@ class JaxObjectPlacement(ObjectPlacement):
             out = _route_unseatable(
                 np.asarray(assignment)[:n], len(node_order), load, alive, cap
             )
-            return out, g, (time.perf_counter() - t0) * 1e3, solved_as
+            return (
+                out, g, coarse_g,
+                (time.perf_counter() - t0) * 1e3, solved_as, n, False,
+            )
 
-        assignment, g, solve_ms, solved_as = await asyncio.to_thread(_solve)
+        (
+            assignment, g, coarse_g, solve_ms, solved_as, displaced, stale
+        ) = await asyncio.to_thread(_solve)
 
         async with self._lock:
             if self._epoch != snapshot_epoch:
@@ -1524,6 +2123,7 @@ class JaxObjectPlacement(ObjectPlacement):
                     n_objects=n,
                     n_nodes=len(self._node_order),
                     solve_ms=solve_ms,
+                    displaced=displaced,
                     epoch=self._epoch,
                     mode=solved_as,
                     discarded=True,
@@ -1559,14 +2159,49 @@ class JaxObjectPlacement(ObjectPlacement):
                 moved = len(planned)
             if g is not None:
                 self._g = g
+                self._g_fp = self._sched_fp()
             self._recount_loads()
             self._epoch += 1
+            if not solved_as.endswith("+no_capacity"):
+                # Commit the plan the NEXT churn event deltas against. A
+                # delta that produced no fresh potentials (greedy fill,
+                # hierarchical, empty displaced set) carries the previous
+                # seeds forward; a full solve resets the staleness counter.
+                delta_used = solved_as.endswith("+delta")
+                self._plan = PlanState(
+                    g=(
+                        g
+                        if g is not None
+                        else (plan.g if delta_used and plan is not None else None)
+                    ),
+                    coarse_g=(
+                        coarse_g
+                        if coarse_g is not None
+                        else (
+                            plan.coarse_g
+                            if delta_used and plan is not None
+                            else None
+                        )
+                    ),
+                    seat_counts=np.bincount(
+                        assignment, minlength=self._node_axis
+                    ),
+                    epoch=self._epoch,
+                    liveness_fp=self._sched_fp(),
+                    delta_solves=(
+                        plan.delta_solves + 1
+                        if delta_used and plan is not None
+                        else 0
+                    ),
+                    stale=stale,
+                )
             self.stats = SolveStats(
                 n_objects=n,
                 n_nodes=len(self._node_order),
                 solve_ms=solve_ms,
                 apply_ms=(time.perf_counter() - t_apply) * 1e3,
                 moved=moved,
+                displaced=displaced,
                 epoch=self._epoch,
                 mode=solved_as,
                 discarded=False,

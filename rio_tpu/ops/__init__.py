@@ -18,6 +18,8 @@ from .assignment import (
     assign_from_potentials,
     build_cost_matrix,
     greedy_balanced_assign,
+    integer_fair_quotas,
+    residual_capacity_assign,
 )
 from .pallas_sinkhorn import fused_iteration, pallas_sinkhorn
 from .scaling import (
@@ -52,6 +54,8 @@ __all__ = [
     "assign_from_potentials",
     "build_cost_matrix",
     "greedy_balanced_assign",
+    "integer_fair_quotas",
+    "residual_capacity_assign",
     "exact_quota_repair",
     "plan_rounded_assign",
     "plan_rounded_assign_from_scaling",

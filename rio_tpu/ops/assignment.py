@@ -19,9 +19,56 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 DEAD_NODE_COST = 1e6
+
+
+def integer_fair_quotas(cap_alive: np.ndarray, n: int) -> np.ndarray:
+    """Largest-remainder integer fair shares of ``n`` seats (host numpy).
+
+    The delta-rebalance counterpart of the device-side quota math inside
+    :func:`rio_tpu.ops.sinkhorn.exact_quota_repair`: per-node quotas
+    proportional to schedulable capacity, floors plus one bonus unit for
+    the ``n - sum(floors)`` largest remainders, summing to ``n`` EXACTLY.
+    Same invariant as the device repair: NO global rescale of the raw
+    shares (an fp rescale flips floor/remainder units on exact-integer
+    columns at large scale; see the r4 note there). Zero-capacity nodes
+    get zero share and zero quota.
+    """
+    cap = np.maximum(np.asarray(cap_alive, np.float64), 0.0)
+    total = cap.sum()
+    if n <= 0 or total <= 0.0:
+        return np.zeros(cap.shape[0], np.int64)
+    target = cap / total * n
+    quota = np.floor(target).astype(np.int64)
+    short = n - int(quota.sum())
+    if short > 0:
+        # Remainder ties prefer the higher-capacity column (deterministic,
+        # and a bonus unit belongs where it displaces least).
+        rem_order = np.lexsort((-cap, -(target - quota)))
+        quota[rem_order[:short]] += 1
+    return quota
+
+
+def residual_capacity_assign(
+    score: np.ndarray, residual: np.ndarray
+) -> np.ndarray:
+    """Seat D displaced objects into integer residual quotas (host numpy).
+
+    ``residual[j]`` is node j's remaining quota after undisplaced objects
+    kept their seats (``sum(residual)`` must equal the displaced count);
+    ``score[j]`` orders the fill (typically ``base_cost - g`` with the
+    warm-started node potentials, so the cheapest nodes absorb first).
+    Objects within the displaced set are interchangeable under the flat
+    cost model — every feasible fill has identical transport cost — so
+    laying them out as contiguous per-node runs is exact, O(D), and
+    deterministic. Returns (D,) int32 node indices.
+    """
+    residual = np.asarray(residual, np.int64)
+    order = np.argsort(np.asarray(score, np.float64), kind="stable")
+    return np.repeat(order, residual[order]).astype(np.int32)
 
 
 def build_cost_matrix(

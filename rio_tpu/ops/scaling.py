@@ -63,6 +63,27 @@ def _potentials(u, v, eps):
     return f, g
 
 
+def _warm_seed(g_init: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Effective warm seed and the global gauge ``s`` it is lowered by.
+
+    Non-finite entries (dead columns of the previous solve) cold-fill to 0
+    — EXACTLY what the log-domain solver does with its warm seed, so the
+    two stay comparable. ``v0 = exp(g0 / eps)`` would overflow for large
+    potentials, so the seed is gauged down by its max: ``v0 = exp((g0 - s)
+    / eps)`` with every exponent <= 0. Unlike the log-domain solver —
+    whose g-update recomputes g from scratch each iteration — the scaling
+    updates are homogeneous (``u = a/(Kv)``, ``v = b/(K^T u)``), so the
+    gauge PERSISTS through every iteration: the converged scalings come
+    out as ``(u * e^{s/eps}, v * e^{-s/eps})`` relative to the ungauged
+    warm solve, and the caller must correct the final potentials by
+    ``f - s`` / ``g + s`` to match the log-domain reference. This is a
+    GLOBAL scalar on the warm seed only — fully orthogonal to the
+    per-row min-shift on the cost (which must stay per-row, see
+    :func:`scaling_core`)."""
+    g0 = jnp.where(jnp.isfinite(g_init), g_init.astype(jnp.float32), 0.0)
+    return g0, jnp.max(g0)
+
+
 @functools.partial(jax.jit, static_argnames=("eps", "n_iters", "kernel_dtype"))
 def scaling_core(
     cost: jax.Array,
@@ -72,6 +93,7 @@ def scaling_core(
     eps: float = 0.05,
     n_iters: int = 50,
     kernel_dtype=jnp.bfloat16,
+    g_init: jax.Array | None = None,
 ):
     """The scaling iteration itself; returns ``(u, v, K, row_shift)``.
 
@@ -84,6 +106,16 @@ def scaling_core(
     plan is ``P = diag(u) K diag(v)`` — re-deriving it from the cost
     matrix would re-read the fp32 cost (2x the bytes of a bf16 K) and
     re-do a transcendental sweep.
+
+    ``g_init`` warm-starts ``v0`` from a previous solve's node potentials.
+    The seed is gauged by its max entry (:func:`_warm_seed`) so the
+    exponential never overflows; that gauge persists through the
+    homogeneous iterations, so the returned ``(u, v)`` are the warm solve's
+    scalings times ``(e^{s/eps}, e^{-s/eps})`` — callers that need
+    log-domain-parity potentials correct by ``s`` (as
+    :func:`scaling_sinkhorn` does). Exponents are clipped at -60 (below
+    which a live column's seed would denormal to zero and the column would
+    restart cold anyway).
     """
     cost = cost.astype(jnp.float32)
     a, b = normalize_marginals(row_mass, col_capacity)
@@ -114,7 +146,11 @@ def scaling_core(
         return (u, v), None
 
     u0 = jnp.zeros_like(a)
-    v0 = jnp.ones_like(b)
+    if g_init is None:
+        v0 = jnp.ones_like(b)
+    else:
+        g_seed, s = _warm_seed(g_init)
+        v0 = jnp.exp(jnp.clip((g_seed - s) / eps, -60.0, 0.0))
     (u, v), _ = lax.scan(body, (u0, v0), None, length=n_iters)
     return u, v, K, shift[:, 0]
 
@@ -128,19 +164,30 @@ def scaling_sinkhorn(
     eps: float = 0.05,
     n_iters: int = 50,
     kernel_dtype=jnp.bfloat16,
+    g_init: jax.Array | None = None,
 ) -> SinkhornResult:
     """Sinkhorn-Knopp in scaling form; returns log-domain potentials.
 
     Matches :func:`rio_tpu.ops.sinkhorn.sinkhorn` up to dtype tolerance
-    (use ``kernel_dtype=jnp.float32`` for tightest parity).
+    (use ``kernel_dtype=jnp.float32`` for tightest parity) — including
+    under ``g_init`` warm start: the warm seed's global gauge (see
+    :func:`_warm_seed`) persists through the homogeneous scaling
+    iterations and is undone here, so warm potentials agree with the
+    warm log-domain reference, not just up to gauge.
     """
     u, v, _, shift = scaling_core(
         cost, row_mass, col_capacity, eps=eps, n_iters=n_iters,
-        kernel_dtype=kernel_dtype,
+        kernel_dtype=kernel_dtype, g_init=g_init,
     )
     cost = cost.astype(jnp.float32) - shift[:, None]
     _, b = normalize_marginals(row_mass, col_capacity)
     f, g = _potentials(u, v, eps)
+    if g_init is not None:
+        # Undo the warm gauge (f/g shift by ∓s; f+g is invariant, so the
+        # marginal-err diagnostic below is unaffected either way).
+        _, s = _warm_seed(g_init)
+        f = jnp.where(jnp.isfinite(f), f - s, f)
+        g = jnp.where(jnp.isfinite(g), g + s, g)
     err = marginal_err(cost, f, g, b, eps)  # shifted-cost/shifted-f pair
     f = jnp.where(jnp.isfinite(f), f + shift, f)  # undo the gauge shift
     return SinkhornResult(f=f, g=g, err=err)

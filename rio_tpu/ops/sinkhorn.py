@@ -77,6 +77,7 @@ def sinkhorn(
     *,
     eps: float = 0.05,
     n_iters: int = 50,
+    g_init: jax.Array | None = None,
 ) -> SinkhornResult:
     """Run ``n_iters`` log-domain Sinkhorn iterations.
 
@@ -89,6 +90,14 @@ def sinkhorn(
       eps: entropic regularizer. Smaller = sharper assignment, slower
         convergence; 0.02-0.1 of the cost scale works well.
       n_iters: fixed iteration count (static for ``lax.scan``).
+      g_init: optional (n_nodes,) warm-start node potentials from a previous
+        solve (e.g. the cached ``g`` of an incremental rebalance). Only the
+        FIRST f-update consumes it — the g-update recomputes g fully each
+        iteration — so a good seed buys convergence in a handful of
+        iterations while a stale one costs nothing but those iterations.
+        Non-finite entries (dead columns from the previous solve) are
+        treated as cold (0); the column marginals of THIS solve decide
+        liveness, never the seed.
     """
     cost = cost.astype(jnp.float32)
     a, b = normalize_marginals(row_mass, col_capacity)
@@ -106,7 +115,12 @@ def sinkhorn(
         return (f, g), None
 
     f0 = jnp.zeros(cost.shape[0], jnp.float32)
-    g0 = jnp.zeros(cost.shape[1], jnp.float32)
+    if g_init is None:
+        g0 = jnp.zeros(cost.shape[1], jnp.float32)
+    else:
+        g0 = jnp.where(
+            jnp.isfinite(g_init), g_init.astype(jnp.float32), 0.0
+        )
     (f, g), _ = lax.scan(body, (f0, g0), None, length=n_iters)
     return SinkhornResult(f=f, g=g, err=marginal_err(cost, f, g, b, eps))
 

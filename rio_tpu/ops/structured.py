@@ -47,6 +47,7 @@ def class_quotas(
     move_cost: float = 0.5,
     eps: float = 0.05,
     n_iters: int = 30,
+    g_init: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Integer per-class quotas for the collapsed rebalance problem.
 
@@ -57,6 +58,9 @@ def class_quotas(
         int; class k = "objects whose current seat is node k").
       col_capacity: (M,) effective capacity (0 for dead nodes).
       move_cost: stay-put discount applied on the diagonal.
+      g_init: optional (M,) warm-start node potentials from the previous
+        solve (the delta-rebalance path feeds the cached plan potentials
+        back in, so a churn re-solve converges in a handful of iterations).
 
     Returns:
       (quotas, g): quotas is (M, M) int32 where ``quotas[k, j]`` objects of
@@ -68,7 +72,9 @@ def class_quotas(
     counts = counts.astype(jnp.float32)
     cost = jnp.broadcast_to(base_cost.astype(jnp.float32)[None, :], (m, m))
     cost = cost - move_cost * jnp.eye(m, dtype=jnp.float32)
-    res = sinkhorn(cost, counts, col_capacity, eps=eps, n_iters=n_iters)
+    res = sinkhorn(
+        cost, counts, col_capacity, eps=eps, n_iters=n_iters, g_init=g_init
+    )
 
     # Soft plan row-conditionals: P[k, :] / a_k (finite rows only).
     logit = (res.f[:, None] + res.g[None, :] - cost) / eps

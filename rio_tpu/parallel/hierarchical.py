@@ -58,6 +58,10 @@ class HierarchicalResult(NamedTuple):
     assignment: jax.Array  # (N,) int32 global node index
     group: jax.Array       # (N,) int32 coarse group index
     overflow: jax.Array    # scalar int32: objects that missed their bucket
+    # (G,) coarse-stage group potentials — the warm seed for the NEXT
+    # (delta) solve's coarse stage. None on the sharded path (each shard
+    # solves its own coarse problem; no single seed to return).
+    coarse_g: jax.Array | None = None
 
 
 @functools.partial(
@@ -75,6 +79,7 @@ def hierarchical_assign(
     eps: float = 0.05,
     coarse_iters: int = 30,
     fine_iters: int = 30,
+    coarse_g_init: jax.Array | None = None,
 ) -> HierarchicalResult:
     """Two-level OT assignment over factorized affinity.
 
@@ -89,6 +94,11 @@ def hierarchical_assign(
         roughly uniform group capacity. With skewed capacity (or mostly-dead
         groups) pass an explicit bucket ~ ``1.3 * N * max_group_cap_share``
         or quotas overflow into the fallback path.
+      coarse_g_init: optional (G,) warm-start potentials for the coarse
+        solve — the previous solve's ``coarse_g``, fed back by the delta
+        rebalance path so a churn re-solve's coarse stage converges in a
+        handful of iterations. The fine stages always start cold (their
+        populations change with the coarse outcome).
     """
     n, d = obj_feat.shape
     d2, m = node_feat.shape
@@ -134,7 +144,8 @@ def hierarchical_assign(
     )
     mass = jnp.ones((n,), jnp.float32)
     res_c = scaling_sinkhorn(
-        coarse_cost, mass, group_cap, eps=eps, n_iters=coarse_iters
+        coarse_cost, mass, group_cap, eps=eps, n_iters=coarse_iters,
+        g_init=coarse_g_init,
     )
     group = plan_rounded_assign(coarse_cost, res_c.f, res_c.g, eps)  # (N,)
     # Exact group quotas: CDF rounding matches group capacities only in
@@ -208,7 +219,9 @@ def hierarchical_assign(
     )[:, 0]  # (G,)
     missed = jnp.zeros((n,), bool).at[order].set(~in_bucket)
     assignment = jnp.where(missed, fallback[group], assignment)
-    return HierarchicalResult(assignment=assignment, group=group, overflow=overflow)
+    return HierarchicalResult(
+        assignment=assignment, group=group, overflow=overflow, coarse_g=res_c.g
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("n_groups", "n_chunks", "bucket", "eps", "coarse_iters", "fine_iters"))
@@ -220,6 +233,7 @@ def chunked_hierarchical_assign(
     *,
     n_groups: int,
     n_chunks: int,
+    coarse_g_init: jax.Array | None = None,
     **kw,
 ) -> HierarchicalResult:
     """Single-chip scale-out: the sharded solve's design, run temporally.
@@ -244,7 +258,7 @@ def chunked_hierarchical_assign(
     def one(of_c):
         return hierarchical_assign(
             of_c, node_feat, node_capacity / n_chunks, alive,
-            n_groups=n_groups, **kw,
+            n_groups=n_groups, coarse_g_init=coarse_g_init, **kw,
         )
 
     res = jax.lax.map(one, of)
@@ -252,6 +266,10 @@ def chunked_hierarchical_assign(
         assignment=res.assignment.reshape(-1),
         group=res.group.reshape(-1),
         overflow=jnp.sum(res.overflow),
+        # Every chunk solves the same capacity proportions (its slice vs
+        # 1/n_chunks of each node), so any chunk's coarse potentials are a
+        # valid warm seed for the next solve; keep the last.
+        coarse_g=res.coarse_g[-1],
     )
 
 

@@ -44,7 +44,9 @@ class PlacementDaemonStats:
     polls: int = 0
     load_syncs: int = 0  # ClusterLoadView pushes into the provider
     liveness_changes: int = 0
+    kicks: int = 0  # event-driven wakeups (provider churn listener)
     rebalances: int = 0
+    delta_rebalances: int = 0  # committed solves that took the delta path
     rebalances_skipped: int = 0  # sibling daemon on a shared provider won
     rebalances_discarded: int = 0  # lost an epoch race; retried next poll
     retries_abandoned: int = 0  # discard-retry budget exhausted; wait for churn
@@ -79,6 +81,14 @@ class PlacementDaemonConfig:
     max_discard_retries: int = 5
     retry_backoff_max: float = 30.0
     mode: str | None = None  # solver mode override for daemon rebalances
+    # Subscribe to the provider's churn listener (when it has one) so a
+    # liveness flip / cordon wakes the poll loop IMMEDIATELY instead of at
+    # the next poll_interval tick — with the provider's delta path this is
+    # what turns node death into millisecond reaction instead of
+    # poll_interval + full-solve latency. The poll loop itself remains the
+    # fallback for providers without the hook (and for membership-storage
+    # churn the local provider hasn't been told about yet).
+    event_kick: bool = True
 
 
 class PlacementDaemon:
@@ -101,6 +111,27 @@ class PlacementDaemon:
         self._retry_solve = False  # last solve was epoch-discarded
         self._consecutive_discards = 0
         self._retry_not_before = float("-inf")  # backoff gate (loop time)
+        self._kick_event = asyncio.Event()
+
+    def kick(self) -> None:
+        """Wake the poll loop now (idempotent, loop-thread only).
+
+        Wired to the provider's churn listener by :meth:`run` (see
+        ``PlacementDaemonConfig.event_kick``); callable directly by
+        anything else that knows churn happened. The daemon's own
+        ``sync_members`` call re-fires the listener — that self-kick costs
+        one extra no-change poll, which the debounce/min-interval gates
+        already absorb."""
+        self.stats.kicks += 1
+        self._kick_event.set()
+
+    async def _idle(self, timeout: float) -> None:
+        """Sleep until ``timeout`` or the next kick, whichever is first."""
+        try:
+            await asyncio.wait_for(self._kick_event.wait(), timeout)
+        except asyncio.TimeoutError:
+            return
+        self._kick_event.clear()
 
     async def _rebalance(self, mode: str | None):
         """Dispatch the re-solve, routing moves through the migration
@@ -185,6 +216,12 @@ class PlacementDaemon:
         cfg = self.config
         loop = asyncio.get_running_loop()
         last_rebalance = float("-inf")
+        if cfg.event_kick and hasattr(self.placement, "add_churn_listener"):
+            # Event-driven wakeups: the provider fires on every
+            # liveness-affecting change (sync_members flip, cordon,
+            # clean_server), so churn reaction is bounded by debounce +
+            # solve time, not poll_interval.
+            self.placement.add_churn_listener(self.kick)
         while True:
             try:
                 liveness, members = await self._liveness()
@@ -208,7 +245,7 @@ class PlacementDaemon:
                     if first_sync:
                         # Startup: learn the initial member set without
                         # solving — nothing is displaced yet.
-                        await asyncio.sleep(cfg.poll_interval)
+                        await self._idle(cfg.poll_interval)
                         continue
                     if changed:  # a pure retry serves an already-counted event
                         self.stats.liveness_changes += 1
@@ -229,7 +266,7 @@ class PlacementDaemon:
                         # device solve just to have it epoch-discarded.
                         self._retry_solve = False  # event served by sibling
                         self.stats.rebalances_skipped += 1
-                        await asyncio.sleep(cfg.poll_interval)
+                        await self._idle(cfg.poll_interval)
                         continue
                     stats_before = getattr(self.placement, "stats", None)
                     moved = await self._rebalance(cfg.mode)
@@ -280,6 +317,8 @@ class PlacementDaemon:
                         self._retry_solve = False
                         self._consecutive_discards = 0
                         self.stats.rebalances += 1
+                        if "+delta" in str(getattr(stats_now, "mode", "")):
+                            self.stats.delta_rebalances += 1
                         self.stats.moves += int(moved)
                         log.info(
                             "churn re-solve: %d objects moved "
@@ -294,4 +333,4 @@ class PlacementDaemon:
                 # liveness watching is the node's recovery path.
                 self.stats.errors += 1
                 log.exception("placement daemon poll failed")
-            await asyncio.sleep(cfg.poll_interval)
+            await self._idle(cfg.poll_interval)

@@ -121,6 +121,54 @@ async def test_incremental_after_rebalance_uses_potentials():
     assert all(a.startswith("10.0.0.") for a in addrs)
 
 
+async def test_potentials_survive_no_op_and_additive_churn():
+    """``_g`` is versioned by the schedulable-node fingerprint, not nulled
+    on every sync_members: a sync that changes nothing — and even a NEW
+    node joining — keeps the cached potentials (the newcomer's entry is
+    -inf, so the warm assign path conservatively never seats there until
+    the next solve learns it)."""
+
+    class M:
+        def __init__(self, address, active=True):
+            self.address = address
+            self.active = active
+
+    p = _provider(nodes=4)
+    await p.assign_batch([ObjectId("T", str(i)) for i in range(64)])
+    await p.rebalance(mode="sinkhorn")
+    g = p._g
+    assert g is not None
+    members = [M(f"10.0.0.{i}:5000") for i in range(4)]
+    p.sync_members(members)  # no liveness change
+    assert p._g is g
+    p.sync_members(members + [M("10.0.0.9:5000")])  # additive join
+    assert p._g is g
+
+
+async def test_dead_node_still_invalidates_potentials():
+    """Regression guard for the fingerprint versioning: a node LEAVING the
+    schedulable set (solved-over potentials now lie about live capacity)
+    must still drop ``_g`` — both via sync_members and via cordon."""
+
+    class M:
+        def __init__(self, address, active=True):
+            self.address = address
+            self.active = active
+
+    p = _provider(nodes=4)
+    await p.assign_batch([ObjectId("T", str(i)) for i in range(64)])
+    await p.rebalance(mode="sinkhorn")
+    assert p._g is not None
+    p.sync_members(
+        [M(f"10.0.0.{i}:5000", active=(i != 2)) for i in range(4)]
+    )
+    assert p._g is None
+    await p.rebalance(mode="sinkhorn")
+    assert p._g is not None
+    p.cordon("10.0.0.1:5000")
+    assert p._g is None
+
+
 async def test_node_axis_grows():
     p = JaxObjectPlacement(node_axis_size=2)
     for i in range(5):
@@ -661,13 +709,13 @@ async def test_hierarchical_rebalance_compiles_are_bucket_bounded():
         ids = [ObjectId("B", str(n + i)) for i in range(37)]  # 37: new n each step
         n += 37
         await p.assign_batch(ids)
-        await p.rebalance()
+        await p.rebalance(delta=False)  # pin the FULL path's compile bound
     # 6 different directory sizes, all inside the 256-bucket: one trace.
     assert hierarchical_assign._cache_size() == 1, hierarchical_assign._cache_size()
     # Crossing the bucket boundary adds exactly one more.
     ids = [ObjectId("B", str(n + i)) for i in range(120)]
     await p.assign_batch(ids)
-    await p.rebalance()
+    await p.rebalance(delta=False)
     assert hierarchical_assign._cache_size() == 2, hierarchical_assign._cache_size()
 
 
@@ -744,7 +792,7 @@ async def test_flat_rebalance_routes_to_hierarchical_at_scale(monkeypatch):
     assert max(loads.values()) <= 2.0 * (700 / 5)
     # Below the threshold the collapsed fast path still runs.
     monkeypatch.setattr(jp_mod, "_FLAT_REBALANCE_MAX_ROWS", 1 << 20)
-    await p.rebalance()
+    await p.rebalance(delta=False)
     assert p.stats.mode == "sinkhorn+collapsed"
 
 

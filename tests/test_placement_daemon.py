@@ -506,3 +506,89 @@ def test_daemon_abandons_retries_after_consecutive_discards():
         assert daemon.stats.rebalances_discarded >= 3
 
     asyncio.run(asyncio.wait_for(run(), 30))
+
+
+def test_event_kick_wakes_loop_before_poll_interval():
+    """A provider churn event (cordon here; gossip liveness flips and
+    clean_server fire the same listener) must wake the daemon NOW — with a
+    deliberately enormous poll_interval the re-solve can only have come
+    from the kick, and with a committed plan it lands as a delta."""
+
+    async def run():
+        from rio_tpu import ObjectId
+        from rio_tpu.cluster.storage import Member
+
+        addrs = [f"10.5.0.{i}:90" for i in range(4)]
+        storage = LocalStorage()
+        for a in addrs:
+            await storage.push(Member.from_address(a, active=True))
+        placement = JaxObjectPlacement(mode="greedy", node_axis_size=4)
+        placement.sync_members(await storage.members())
+        await placement.assign_batch([ObjectId("K", str(i)) for i in range(64)])
+        await placement.rebalance(delta=False)  # commit the PlanState
+        daemon = PlacementDaemon(
+            storage,
+            placement,
+            PlacementDaemonConfig(
+                poll_interval=60.0,  # the kick, not the poll, must wake us
+                debounce=0.01,
+                min_rebalance_interval=0.0,
+            ),
+        )
+        task = asyncio.create_task(daemon.run())
+        try:
+            for _ in range(200):
+                if daemon.stats.polls >= 1:
+                    break
+                await asyncio.sleep(0.01)
+            assert daemon.stats.polls >= 1
+            # Churn: storage learns the death; the provider-side cordon
+            # fires the churn listener that wakes the sleeping loop.
+            await storage.set_inactive("10.5.0.0", 90)
+            placement.cordon(addrs[0])
+            for _ in range(200):
+                if daemon.stats.rebalances >= 1:
+                    break
+                await asyncio.sleep(0.05)
+            assert daemon.stats.rebalances >= 1, "kick did not wake the loop"
+            assert daemon.stats.kicks >= 1
+            assert daemon.stats.delta_rebalances >= 1
+            assert placement.stats.mode == "greedy+delta"
+            # The displaced objects were re-seated off the dead node.
+            dead_idx = placement._nodes[addrs[0]].index
+            assert len(placement._by_node.get(dead_idx, ())) == 0
+        finally:
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+
+    asyncio.run(asyncio.wait_for(run(), 30))
+
+
+def test_event_kick_opt_out_leaves_listener_unregistered():
+    async def run():
+        storage = LocalStorage()
+        from rio_tpu.cluster.storage import Member
+
+        await storage.push(Member.from_address("10.6.0.1:90", active=True))
+        await storage.push(Member.from_address("10.6.0.2:90", active=True))
+        placement = JaxObjectPlacement(mode="greedy", node_axis_size=4)
+        daemon = PlacementDaemon(
+            storage,
+            placement,
+            PlacementDaemonConfig(poll_interval=60.0, event_kick=False),
+        )
+        task = asyncio.create_task(daemon.run())
+        try:
+            for _ in range(200):
+                if daemon.stats.polls >= 1:
+                    break
+                await asyncio.sleep(0.01)
+            assert placement._churn_listeners == []
+            placement.cordon("10.6.0.1:90")
+            await asyncio.sleep(0.05)
+            assert daemon.stats.kicks == 0
+        finally:
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+
+    asyncio.run(asyncio.wait_for(run(), 30))
