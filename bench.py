@@ -1587,6 +1587,29 @@ def tracing_overhead() -> dict:
     return out
 
 
+def journal_overhead() -> dict:
+    """RPC-loop cost of the control-plane flight recorder, A/B'd in the
+    SAME session: servers with journal=False vs the shipping default
+    (journal on, capacity 4096). Events record on control transitions
+    only, so the echo loop should price the journal at ~0; the ISSUE 9
+    acceptance bar is ≤ ~2%. Median paired ratio is the stable artifact."""
+    import asyncio
+
+    from rio_tpu.utils.journal_live import measure_journal_overhead
+
+    out = asyncio.run(measure_journal_overhead())
+    m = out["msgs_per_sec"]
+    print(
+        f"# journal overhead ({out['batches']} interleaved batches x "
+        f"{out['n_requests_per_batch']} reqs, 2 servers/mode, median "
+        f"paired ratio): off {m['off']:,.0f}/s, on {m['on']:,.0f}/s "
+        f"({out['journal_overhead_pct']:+}%, "
+        f"{out['events_recorded_on']} control events recorded)",
+        file=sys.stderr,
+    )
+    return out
+
+
 _TPU_PLATFORMS = os.environ.get("JAX_PLATFORMS")  # as the driver launched us
 
 
@@ -1940,6 +1963,10 @@ def main() -> None:
     except Exception as e:
         print(f"# tracing overhead failed: {e!r}", file=sys.stderr)
     try:
+        detail["journal"] = journal_overhead()
+    except Exception as e:
+        print(f"# journal overhead failed: {e!r}", file=sys.stderr)
+    try:
         detail["scaled_routing"] = scaled_route_hops()
     except Exception as e:
         print(f"# scaled routing failed: {e!r}", file=sys.stderr)
@@ -2091,6 +2118,9 @@ if __name__ == "__main__":
     # Rehearse the tracing/metrics overhead A/B alone (same CPU-safe
     # in-process-cluster shape as --migration).
     parser.add_argument("--tracing", action="store_true")
+    # Rehearse the control-plane journal overhead A/B alone (same CPU-safe
+    # in-process-cluster shape as --migration).
+    parser.add_argument("--journal", action="store_true")
     args = parser.parse_args()
     if args.migration:
         _pin_orchestrator_to_cpu()
@@ -2101,6 +2131,9 @@ if __name__ == "__main__":
     elif args.tracing:
         _pin_orchestrator_to_cpu()
         print(json.dumps(tracing_overhead()))
+    elif args.journal:
+        _pin_orchestrator_to_cpu()
+        print(json.dumps(journal_overhead()))
     elif args.delta:
         run_delta_tier(args.tier or 1_048_576, args.platform, args.deadline)
     elif args.tier is not None and args.hier:

@@ -32,6 +32,18 @@ installed, the real exporter is one line::
     from rio_tpu.otel import otlp_sink
     tracing.add_sink(otlp_sink("http://jaeger:4317"))
 
+The OTLP metrics push is ImportError-gated the same way; this demo TRIES
+it and, without the SDK, falls back to :class:`InMemoryMetricExporter` —
+the same collect-cycle shape as ``tests/fake_otel.py``'s exporter, fed
+from ``server_gauges`` directly — so the example runs end-to-end in a
+bare environment (and tier-1 smoke-tests it doing so).
+
+The third plane is the control-plane journal (``rio_tpu/journal.py``):
+the demo drives one real migration, then scrapes every node's
+``DumpEvents`` tail and prints the merged causal history plus
+``explain`` for the migrated actor — "why is w0 on node 2" answered from
+the cluster's own flight recorder.
+
 Run::
 
     python examples/observability.py
@@ -57,12 +69,60 @@ from rio_tpu import (
     message,
 )
 from rio_tpu import tracing
-from rio_tpu.admin import ADMIN_TYPE, DumpStats, StatsSnapshot
+from rio_tpu.admin import (
+    ADMIN_TYPE,
+    AdminAck,
+    AdminRequest,
+    DumpStats,
+    StatsSnapshot,
+    cluster_events,
+    explain,
+)
 from rio_tpu.cluster.membership_protocol import LocalClusterProvider
+from rio_tpu.journal import format_event
 from rio_tpu.metrics import merge_rows
 from rio_tpu.otel import server_gauges
 
 gauge_log = logging.getLogger("rio_tpu.examples.gauges")
+
+
+class InMemoryMetricExporter:
+    """No-SDK stand-in for the OTLP metrics push (``fake_otel`` style).
+
+    The real path (``otlp_metrics_exporter``) registers observable gauges
+    whose callbacks read ``server_gauges`` on the SDK's timer; this
+    fallback runs the same collect cycle explicitly — each
+    :meth:`collect` reads every node's gauge snapshot and appends one
+    ``{name: value}`` dict per node to ``exported``, exactly what the
+    fake exporter in ``tests/fake_otel.py`` would have received over
+    gRPC.
+    """
+
+    def __init__(self) -> None:
+        self.exported: list[dict[str, float]] = []
+
+    def collect(self, servers: list) -> None:
+        for server in servers:
+            self.exported.append(dict(server_gauges(server)))
+
+
+def start_metrics_export(servers: list):
+    """OTLP metrics push when the SDK is present, in-memory otherwise.
+
+    Returns ``(mode, exporter_or_provider)``: ``("otlp", provider)`` with
+    the real SDK (call ``provider.shutdown()``), or
+    ``("in-memory", InMemoryMetricExporter)`` without it — the gated path
+    the ROADMAP left open, now always runnable.
+    """
+    from rio_tpu.otel import otlp_metrics_exporter
+
+    try:
+        provider = otlp_metrics_exporter(
+            lambda: server_gauges(servers[0]), interval=0.5
+        )
+        return "otlp", provider
+    except ImportError:
+        return "in-memory", InMemoryMetricExporter()
 
 
 async def cluster_scrape(client: "Client", members) -> None:
@@ -142,8 +202,22 @@ class Ack:
 
 
 class Worker(ServiceObject):
+    def __init__(self) -> None:
+        super().__init__()
+        self.handled = 0
+
+    # Volatile state riding the migration/replication snapshot protocol —
+    # gives the demo's migration a real payload, so the journal shows the
+    # install phase on BOTH nodes instead of an empty snapshot.
+    def __migrate_state__(self) -> int:
+        return self.handled
+
+    def __restore_state__(self, state: int) -> None:
+        self.handled = int(state)
+
     @handler
     async def work(self, msg: Work, ctx: AppData) -> Ack:
+        self.handled += 1
         await asyncio.sleep(0.002)  # pretend to do something
         return Ack(item=msg.item)
 
@@ -182,7 +256,31 @@ class SpanAggregator:
             walk(root, 0)
 
 
-async def main() -> None:
+async def journal_scrape(client: "Client", members, subject: tuple) -> dict:
+    """Scrape every node's control-plane journal and explain one actor.
+
+    The journal-side twin of :func:`cluster_scrape`: one ``DumpEvents``
+    round trip per live node, merged into a causally ordered cluster tail
+    (``merge_events`` inside :func:`rio_tpu.admin.cluster_events`), then
+    :func:`rio_tpu.admin.explain` narrows to the migrated actor — its
+    activation seat, each migration phase on BOTH nodes, and the trace id
+    linking those rows to the request spans above.
+    """
+    tail = await cluster_events(client, members, limit=256)
+    print(f"\n[journal] merged cluster tail ({len(tail)} control events):")
+    for ev in tail[-12:]:
+        print(f"  {format_event(ev)}")
+    tname, oid = subject
+    history = await explain(client, members, tname, oid)
+    traces = {e.trace_id for e in history if e.trace_id}
+    print(f"\n[journal] explain {tname}/{oid} ({len(history)} events):")
+    for ev in history:
+        print(f"  {format_event(ev)}")
+    print(f"[journal] {len(traces)} linked trace(s)")
+    return {"tail": len(tail), "explain": len(history), "traces": len(traces)}
+
+
+async def main(n_requests: int = 50) -> dict:
     logging.basicConfig(level=logging.INFO)  # DEBUG to see per-span log lines
     aggregator = SpanAggregator()
     tracing.add_sink(tracing.logging_sink)
@@ -208,15 +306,53 @@ async def main() -> None:
     tasks.append(asyncio.create_task(gauge_reader(servers, interval=0.05)))
     await asyncio.sleep(0.1)
 
+    # Metrics push: real OTLP when the SDK is installed, the in-memory
+    # collect-cycle fallback otherwise — always runnable.
+    otlp_mode, exporter = start_metrics_export(servers)
+    print(f"[metrics] export path: {otlp_mode}")
+
     client = Client(members)
-    for i in range(50):
+    for i in range(n_requests):
         await client.send(Worker, f"w{i % 5}", Work(item=f"job-{i}"), returns=Ack)
+
+    # Drive one real migration so the journal has a full phase chain to
+    # show: pin → snapshot → install (both sides) → directory flip.
+    from rio_tpu.registry import ObjectId, type_id
+
+    tname = type_id(Worker)
+    owner = await placement.lookup(ObjectId(tname, "w0"))
+    target = next(s.local_address for s in servers if s.local_address != owner)
+    await client.send(
+        ADMIN_TYPE,
+        owner,
+        AdminRequest(
+            kind="migrate_object", type_name=tname, object_id="w0", target=target
+        ),
+        returns=AdminAck,
+    )
+    await asyncio.sleep(0.3)  # the admin queue runs the migration async
+    await client.send(Worker, "w0", Work(item="post-migration"), returns=Ack)
     await asyncio.sleep(0.1)  # let the gauge reader log the final deltas
 
     # Wire scrape: DUMP_STATS every node via its rio.Admin actor and merge
     # the per-handler histograms into cluster-wide quantiles + exemplars.
     await cluster_scrape(client, members)
+
+    # Flight-recorder scrape: DUMP_EVENTS every node, merge, and explain
+    # the actor the demo just migrated.
+    journal_summary = await journal_scrape(client, members, (tname, "w0"))
     client.close()
+
+    if otlp_mode == "in-memory":
+        exporter.collect(servers)  # one explicit collect cycle, per node
+        names = set().union(*(snap.keys() for snap in exporter.exported))
+        print(
+            f"[metrics] in-memory exporter: {len(exporter.exported)} node "
+            f"snapshots, {len(names)} distinct gauges "
+            f"({sum(1 for n in names if n.startswith('rio.journal.'))} journal)"
+        )
+    else:  # pragma: no cover - requires the optional SDK
+        exporter.shutdown()
 
     for t in tasks:
         t.cancel()
@@ -228,6 +364,12 @@ async def main() -> None:
     tracing.clear_sinks()
     tracing.set_sample_rate(0.0)
     print("[demo] done")
+    return {
+        "otlp_mode": otlp_mode,
+        "snapshots": len(exporter.exported) if otlp_mode == "in-memory" else 0,
+        "spans": sum(len(d) for d in aggregator.durations.values()),
+        **journal_summary,
+    }
 
 
 if __name__ == "__main__":

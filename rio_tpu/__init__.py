@@ -20,6 +20,7 @@ from .cluster.membership_protocol import ClusterProvider, LocalClusterProvider
 from .cluster.storage import LocalStorage, Member, MembershipStorage
 from .commands import AdminCommand, AdminSender, InternalClientSender, ServerInfo
 from .errors import RioError, ServerBusy
+from .journal import Journal, JournalEvent
 from .load import (
     ClusterLoadView,
     LoadMonitor,
@@ -63,6 +64,8 @@ __all__ = [
     "ClusterLoadView",
     "ClusterProvider",
     "InternalClientSender",
+    "Journal",
+    "JournalEvent",
     "LifecycleKind",
     "LifecycleMessage",
     "LocalClusterProvider",

@@ -15,18 +15,31 @@ migration control plane):
   in-process :class:`~rio_tpu.commands.AdminSender` queue (drain this
   node, migrate an object, shut an object down) so ops tooling needs only
   a :class:`~rio_tpu.client.Client`.
+* :class:`DumpEvents` → :class:`EventsSnapshot` — the control-plane
+  flight recorder scrape (``rio_tpu/journal.py``): a filtered tail of the
+  node's journal ring as wire rows, resumable by ``since_seq``.
+  :func:`explain` walks every live node and merges the per-node streams
+  into one causally ordered placement history for a single actor.
 
 The gauge/histogram sources are injected at ``Server.bind()`` as a
 :class:`StatsSource` — the actor itself stays free of server imports.
+
+Operator CLI (see ``_cli_main``)::
+
+    python -m rio_tpu.admin tail    --nodes host:p,host:p [--kind K] [--key K]
+    python -m rio_tpu.admin explain --nodes host:p,host:p TYPE ID
+    python -m rio_tpu.admin stats   --nodes host:p,host:p
+    python -m rio_tpu.admin --demo {tail|explain|stats}   # in-process 2-node demo
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Callable
+from typing import Any, Callable, Iterable, Sequence
 
 from .app_data import AppData
 from .commands import AdminCommand, AdminCommandKind, AdminSender
+from .journal import Journal, JournalEvent, format_event, merge_events, subject_key
 from .registry import handler, message, type_name
 from .service_object import ServiceObject
 
@@ -55,6 +68,38 @@ class StatsSnapshot:
     # error_count, errors{kind:int}, buckets[], sum_s, max_s,
     # exemplar_trace, exemplar_s] — merge with metrics.merge_rows.
     histograms: list = field(default_factory=list)
+
+
+@message(name="rio.DumpEvents")
+@dataclass
+class DumpEvents:
+    """Ask a node for a filtered tail of its control-plane journal.
+
+    Empty ``kinds``/``key`` mean "no filter"; ``since_seq`` resumes a tail
+    (only events with ``seq > since_seq`` return); ``limit`` bounds the
+    response to the NEWEST matches (0 = journal capacity).
+    """
+
+    kinds: list = field(default_factory=list)  # journal kind strings
+    key: str = ""  # exact subject match, e.g. "Worker/w3"
+    since_seq: int = 0
+    limit: int = 512
+
+
+@message(name="rio.EventsSnapshot")
+@dataclass
+class EventsSnapshot:
+    """One node's journal tail (mergeable across nodes: ``merge_events``)."""
+
+    address: str = ""
+    node_seq: int = 0  # the node's latest journal seq (tail resume point)
+    dropped: int = 0  # ring-overflow drop counter at scrape time
+    # JournalEvent wire rows: [seq, wall_ts, mono_ts, node, epoch, kind,
+    # key, attrs, trace_id] — decode with JournalEvent.from_row.
+    rows: list = field(default_factory=list)
+
+    def events(self) -> list[JournalEvent]:
+        return [JournalEvent.from_row(r) for r in self.rows]
 
 
 @message(name="rio.AdminRequest")
@@ -111,6 +156,28 @@ class AdminControl(ServiceObject):
         )
 
     @handler
+    async def dump_events(self, msg: DumpEvents, ctx: AppData) -> EventsSnapshot:
+        from .commands import ServerInfo
+
+        info = ctx.try_get(ServerInfo)
+        address = info.address if info else ""
+        journal = ctx.try_get(Journal)
+        if journal is None:
+            return EventsSnapshot(address=address)
+        events = journal.events(
+            kinds=msg.kinds or None,
+            key=msg.key or None,
+            since_seq=msg.since_seq,
+            limit=msg.limit if msg.limit > 0 else None,
+        )
+        return EventsSnapshot(
+            address=address,
+            node_seq=journal.recorded,
+            dropped=journal.dropped,
+            rows=[e.to_row() for e in events],
+        )
+
+    @handler
     async def admin(self, msg: AdminRequest, ctx: AppData) -> AdminAck:
         sender = ctx.try_get(AdminSender)
         if sender is None:
@@ -123,3 +190,242 @@ class AdminControl(ServiceObject):
             AdminCommand(kind, msg.type_name, msg.object_id, msg.target)
         )
         return AdminAck(ok=True)
+
+
+# -- cluster-wide journal queries (the explain plane) ------------------------
+
+
+async def _node_addresses(nodes: Any) -> list[str]:
+    """Accept a MembershipStorage (live view) or an explicit address list."""
+    if hasattr(nodes, "active_members"):
+        return [m.address for m in await nodes.active_members()]
+    return list(nodes)
+
+
+async def scrape_events(
+    client: Any,
+    nodes: Any,
+    *,
+    kinds: Iterable[str] | None = None,
+    key: str | None = None,
+    since_seq: int = 0,
+    limit: int = 512,
+) -> list[EventsSnapshot]:
+    """One :class:`DumpEvents` round trip per live node; dead nodes skipped.
+
+    ``nodes`` is either a membership storage (scrape whoever is active,
+    like ``cluster_scrape``) or an explicit iterable of addresses.
+    """
+    msg = DumpEvents(
+        kinds=list(kinds or []), key=key or "", since_seq=since_seq, limit=limit
+    )
+    snapshots: list[EventsSnapshot] = []
+    for address in await _node_addresses(nodes):
+        try:
+            snap = await client.send(ADMIN_TYPE, address, msg, returns=EventsSnapshot)
+        except Exception:
+            continue  # unreachable/draining node: explain over the survivors
+        snapshots.append(snap)
+    return snapshots
+
+
+async def cluster_events(
+    client: Any,
+    nodes: Any,
+    *,
+    kinds: Iterable[str] | None = None,
+    key: str | None = None,
+    since_seq: int = 0,
+    limit: int = 512,
+) -> list[JournalEvent]:
+    """The merged, causally ordered cluster journal tail."""
+    snapshots = await scrape_events(
+        client, nodes, kinds=kinds, key=key, since_seq=since_seq, limit=limit
+    )
+    return merge_events(s.events() for s in snapshots)
+
+
+async def explain(
+    client: Any,
+    nodes: Any,
+    type_name: str,
+    object_id: str,
+    *,
+    limit: int = 512,
+) -> list[JournalEvent]:
+    """One actor's causally ordered placement history, cluster-wide.
+
+    Merges every live node's journal rows for subject ``type/id`` into a
+    single timeline: activation seat, admission sheds, each migration
+    phase (source AND target side), promotion/depose, replica churn —
+    whatever the cluster recorded about this actor, in order, each row
+    carrying the trace id of the request that drove it.
+    """
+    return await cluster_events(
+        client, nodes, key=subject_key(type_name, object_id), limit=limit
+    )
+
+
+# -- operator CLI: python -m rio_tpu.admin {tail|explain|stats} --------------
+
+
+async def _cli_cluster(args: Any):
+    """Resolve (client, nodes, cleanup) for the CLI: --nodes or --demo."""
+    from .client import Client
+    from .cluster.storage import LocalStorage, Member
+
+    if args.demo:
+        import asyncio
+
+        from . import tracing
+        from .utils.routing_live import Echo, EchoActor, boot_echo_cluster
+        from .registry import type_id
+
+        tracing.set_sample_rate(1.0)  # demo journal rows carry trace ids
+        members, placement, tasks, servers = await boot_echo_cluster(2)
+        client = Client(members)
+        tname = type_id(EchoActor)
+        for i in range(20):
+            await client.send(EchoActor, f"w{i % 4}", Echo(value=i), returns=Echo)
+        # Drive one real migration so the tail shows the full phase chain.
+        from .registry import ObjectId
+
+        owner = await placement.lookup(ObjectId(tname, "w0"))
+        target = next(s.local_address for s in servers if s.local_address != owner)
+        if owner:
+            await client.send(
+                ADMIN_TYPE,
+                owner,
+                AdminRequest(
+                    kind="migrate_object",
+                    type_name=tname,
+                    object_id="w0",
+                    target=target,
+                ),
+                returns=AdminAck,
+            )
+            await asyncio.sleep(0.4)  # let the queued migration run
+            await client.send(EchoActor, "w0", Echo(value=99), returns=Echo)
+        if not getattr(args, "subject", None):
+            args.subject = (tname, "w0")
+
+        async def cleanup() -> None:
+            client.close()
+            tracing.set_sample_rate(0.0)
+            for t in tasks:
+                t.cancel()
+            import asyncio
+
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+        return client, members, cleanup
+
+    members = LocalStorage()
+    for address in (args.nodes or "").split(","):
+        if address.strip():
+            await members.push(Member.from_address(address.strip(), active=True))
+
+    client = Client(members)
+
+    async def cleanup() -> None:
+        client.close()
+
+    return client, members, cleanup
+
+
+async def _cli_main(argv: Sequence[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m rio_tpu.admin",
+        description="Operator view of the control-plane flight recorder.",
+    )
+    parser.add_argument(
+        "--nodes", default="", help="comma-separated node addresses (host:port,...)"
+    )
+    parser.add_argument(
+        "--demo",
+        action="store_true",
+        help="boot a 2-node in-process cluster, drive traffic + one migration, "
+        "then run the subcommand against it",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    tail = sub.add_parser("tail", help="merged cluster journal tail")
+    tail.add_argument("--kind", action="append", default=[], help="filter by kind")
+    tail.add_argument("--key", default="", help="filter by subject key (type/id)")
+    tail.add_argument("--since-seq", type=int, default=0)
+    tail.add_argument("--limit", type=int, default=64)
+
+    exp = sub.add_parser("explain", help="one actor's causal placement history")
+    exp.add_argument("type_name", nargs="?", default="")
+    exp.add_argument("object_id", nargs="?", default="")
+
+    sub.add_parser("stats", help="per-node gauge snapshot (journal counters incl.)")
+
+    args = parser.parse_args(argv)
+    args.subject = (
+        (args.type_name, args.object_id)
+        if args.cmd == "explain" and args.type_name and args.object_id
+        else None
+    )
+    if not args.demo and not args.nodes:
+        parser.error("--nodes is required without --demo")
+
+    client, nodes, cleanup = await _cli_cluster(args)
+    try:
+        if args.cmd == "tail":
+            events = await cluster_events(
+                client,
+                nodes,
+                kinds=args.kind or None,
+                key=args.key or None,
+                since_seq=args.since_seq,
+                limit=args.limit,
+            )
+            for ev in events:
+                print(format_event(ev))
+            print(f"[tail] {len(events)} events")
+        elif args.cmd == "explain":
+            if not args.subject:
+                print("explain: missing TYPE ID (demo picks its migrated actor)")
+                return 2
+            tname, oid = args.subject
+            events = await explain(client, nodes, tname, oid)
+            traces = {e.trace_id for e in events if e.trace_id}
+            for ev in events:
+                print(format_event(ev))
+            print(
+                f"[explain] {subject_key(tname, oid)}: {len(events)} events, "
+                f"{len(traces)} linked trace(s)"
+            )
+        else:  # stats
+            for address in await _node_addresses(nodes):
+                try:
+                    snap = await client.send(
+                        ADMIN_TYPE, address, DumpStats(), returns=StatsSnapshot
+                    )
+                except Exception as e:
+                    print(f"{address}: unreachable ({e.__class__.__name__})")
+                    continue
+                journal = {
+                    k: v for k, v in snap.gauges.items() if k.startswith("rio.journal.")
+                }
+                print(
+                    f"{snap.address}: {len(snap.gauges)} gauges, "
+                    f"{len(snap.histograms)} histograms, journal="
+                    + (
+                        " ".join(f"{k.split('.')[-1]}={v:g}" for k, v in sorted(journal.items()))
+                        or "off"
+                    )
+                )
+    finally:
+        await cleanup()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via CLI smoke test
+    import asyncio as _asyncio
+    import sys as _sys
+
+    _sys.exit(_asyncio.run(_cli_main()))

@@ -23,6 +23,10 @@ class AdminCommandKind(Enum):
     # via the node-scoped rio.Admin actor, rio_tpu/admin.py) this node's
     # gauge + RED-histogram snapshot.
     DUMP_STATS = "dump_stats"
+    # Control-plane flight recorder: log (in-process) or return (wire, via
+    # rio.Admin DumpEvents) this node's journal tail. Old servers answer the
+    # wire form with the clean unknown-kind AdminAck.
+    DUMP_EVENTS = "dump_events"
 
 
 @dataclasses.dataclass
@@ -56,6 +60,12 @@ class AdminCommand:
         """Log this node's gauge + histogram snapshot (the in-process twin
         of the wire scrape served by ``rio.Admin``)."""
         return cls(AdminCommandKind.DUMP_STATS)
+
+    @classmethod
+    def dump_events(cls) -> "AdminCommand":
+        """Log this node's control-plane journal tail (the in-process twin
+        of the wire ``DumpEvents`` scrape served by ``rio.Admin``)."""
+        return cls(AdminCommandKind.DUMP_EVENTS)
 
     @classmethod
     def migrate(cls, type_name: str, object_id: str, target: str) -> "AdminCommand":

@@ -81,6 +81,15 @@ from .. import codec
 from ..app_data import AppData
 from ..cluster.storage import MembershipStorage
 from ..errors import ObjectNotFound
+from ..journal import (
+    MIGRATE_ABORT,
+    MIGRATE_BURST,
+    MIGRATE_FLIP,
+    MIGRATE_INSTALL,
+    MIGRATE_PIN,
+    MIGRATE_SNAPSHOT,
+    Journal,
+)
 from ..message_router import MessageRouter
 from ..object_placement import ObjectPlacement, ObjectPlacementItem
 from ..protocol import ResponseError
@@ -297,6 +306,16 @@ class MigrationManager:
         self._node_sems: dict[str, asyncio.Semaphore] = {}
         self._global_sem = asyncio.Semaphore(max(1, self.config.global_inflight))
         self._client = client
+        # Control-plane flight recorder (None when journaling is off): each
+        # handoff phase — pin, snapshot, install, flip, abort — lands one
+        # event, carrying the driving request's trace id across nodes.
+        self._journal = app_data.try_get(Journal)
+
+    def _jrecord(self, kind: str, object_id: ObjectId, **attrs: Any) -> None:
+        if self._journal is not None:
+            self._journal.record(
+                kind, f"{object_id.type_name}/{object_id.id}", **attrs
+            )
 
     @property
     def active(self) -> bool:
@@ -399,6 +418,7 @@ class MigrationManager:
             return False
         self.stats.started += 1
         self._pinned[key] = target
+        self._jrecord(MIGRATE_PIN, object_id, target=target)
         pinned_at = time.perf_counter()
         fenced = False
         try:
@@ -424,6 +444,12 @@ class MigrationManager:
                     self.app_data,
                     before_remove=_snapshot,
                 )
+                if live:
+                    self._jrecord(
+                        MIGRATE_SNAPSHOT,
+                        object_id,
+                        bytes=len(volatile[0]) if volatile else 0,
+                    )
             if volatile:
                 payload = volatile[0]
                 served = self._served_prefetch.pop(key, None)
@@ -436,16 +462,26 @@ class MigrationManager:
                     # The target already stashed these exact bytes during
                     # the pre-pin prefetch: nothing to move in-window.
                     self.stats.prefetch_hits += 1
+                    self._jrecord(
+                        MIGRATE_INSTALL, object_id, target=target, prefetch_hit=True
+                    )
                 else:
                     if served is not None:
                         self.stats.prefetch_misses += 1
                     self.stats.state_bytes += len(payload)
                     self._note_state_bytes(str(object_id), len(payload))
                     await self._install_on(target, object_id, payload)
+                    self._jrecord(
+                        MIGRATE_INSTALL,
+                        object_id,
+                        target=target,
+                        bytes=len(payload),
+                    )
             if await self.placement.lookup(object_id) == self.address:
                 await self.placement.update(
                     ObjectPlacementItem(object_id=object_id, server_address=target)
                 )
+                self._jrecord(MIGRATE_FLIP, object_id, target=target)
             elif live:
                 # Someone re-seated the row mid-handoff; their row wins and
                 # our deactivation degrades to an ordinary cold stop.
@@ -466,6 +502,9 @@ class MigrationManager:
             return True
         except Exception as e:
             self.stats.aborted += 1
+            self._jrecord(
+                MIGRATE_ABORT, object_id, target=target, error=repr(e)[:120]
+            )
             log.warning("migration of %s -> %s aborted: %r", object_id, target, e)
             return False
         finally:
@@ -497,6 +536,8 @@ class MigrationManager:
             return 0, attempted
         self.stats.batches += 1
         self.stats.batch_keys += attempted
+        if self._journal is not None:
+            self._journal.record(MIGRATE_BURST, target=target, keys=attempted)
         sem = asyncio.Semaphore(max(1, self.config.handoff_concurrency))
 
         async def one(tname: str, oid: str) -> bool:
@@ -617,6 +658,16 @@ class MigrationManager:
                 self._stash.pop(key, None)
         self._stash[(tname, object_id)] = (payload, now)
         self.stats.installs += 1
+        if self._journal is not None:
+            # Target-side half of the transfer: the cross-node causal link —
+            # the source's MIGRATE_INSTALL and this event share the driving
+            # request's trace id when the handoff rode a traced request.
+            self._journal.record(
+                MIGRATE_INSTALL,
+                f"{tname}/{object_id}",
+                side="target",
+                bytes=len(payload),
+            )
 
     def restore_volatile(self, obj: Any) -> bool:
         """LOAD-lifecycle hook: hand a stashed snapshot to the fresh

@@ -555,6 +555,33 @@ class SolveStats:
 
     HISTORY_LIMIT = 32
 
+    def history_gauges(self) -> dict[str, float]:
+        """Rolling solve-history summary, scrape-ready.
+
+        ``stats_gauges`` flattens only the last solve's scalar fields (and
+        skips ``history`` — it's a list); this folds the retained window
+        into trend gauges so a dashboard sees churn cadence without
+        shipping the whole ring over the wire.
+        """
+        window = [*self.history, self] if self.mode != "none" else list(self.history)
+        out = {"rio.placement_solve.history.len": float(len(window))}
+        if not window:
+            return out
+        solves = [float(s.solve_ms) for s in window]
+        out["rio.placement_solve.history.solve_ms_last"] = solves[-1]
+        out["rio.placement_solve.history.solve_ms_mean"] = sum(solves) / len(solves)
+        out["rio.placement_solve.history.solve_ms_max"] = max(solves)
+        out["rio.placement_solve.history.moved_total"] = float(
+            sum(int(s.moved) for s in window)
+        )
+        out["rio.placement_solve.history.delta_fraction"] = sum(
+            1.0 for s in window if "delta" in str(s.mode)
+        ) / len(window)
+        out["rio.placement_solve.history.discarded_total"] = float(
+            sum(1 for s in window if s.discarded)
+        )
+        return out
+
 
 class JaxObjectPlacement(ObjectPlacement):
     """Batched, device-solved object directory (drop-in ObjectPlacement)."""

@@ -95,6 +95,16 @@ def server_gauges(server: Any) -> dict[str, float]:
         # Per-handler RED quantiles (rio.handler.<type>.<msg>.p50_ms/p99_ms
         # etc.), derived from the log-bucketed histograms at scrape time.
         gauges.update(metrics_registry.gauges())
+    journal = getattr(server, "journal", None)
+    if journal is not None:
+        # Control-plane flight recorder counters (rio.journal.*).
+        gauges.update(journal.gauges())
+    solve_stats = getattr(placement, "stats", None)
+    history_gauges = getattr(solve_stats, "history_gauges", None)
+    if history_gauges is not None:
+        # Rolling solve-history summary (rio.placement_solve.history.*) —
+        # stats_gauges above only sees the LAST solve's scalar fields.
+        gauges.update(history_gauges())
     return gauges
 
 

@@ -34,6 +34,7 @@ import random
 from dataclasses import dataclass
 
 from .cluster.storage import MembershipStorage
+from .journal import MEMBER_DOWN, MEMBER_UP, SOLVE
 from .object_placement import ObjectPlacement
 
 log = logging.getLogger("rio_tpu.placement_daemon")
@@ -101,12 +102,18 @@ class PlacementDaemon:
         config: PlacementDaemonConfig | None = None,
         *,
         migrator=None,
+        journal=None,
     ) -> None:
         self.members_storage = members_storage
         self.placement = placement
         self.config = config or PlacementDaemonConfig()
         self.stats = PlacementDaemonStats()
         self.migrator = migrator  # MigrationManager: moves become handoffs
+        # Control-plane flight recorder (rio_tpu.journal.Journal | None).
+        # The daemon — not the provider — emits liveness/solve events: one
+        # provider may be shared by several in-process servers, and only
+        # the daemon knows which NODE observed the transition.
+        self.journal = journal
         self._last_liveness: frozenset[tuple[str, bool]] | None = None
         self._retry_solve = False  # last solve was epoch-discarded
         self._consecutive_discards = 0
@@ -187,6 +194,42 @@ class PlacementDaemon:
         self.placement.sync_load(ClusterLoadView.from_members(members))
         self.stats.load_syncs += 1
 
+    def _journal_liveness(
+        self,
+        prev: frozenset[tuple[str, bool]] | None,
+        now: frozenset[tuple[str, bool]],
+    ) -> None:
+        """Emit MEMBER_UP/MEMBER_DOWN per address whose liveness flipped."""
+        if self.journal is None or prev is None:
+            return
+        before = dict(prev)
+        after = dict(now)
+        for address, active in sorted(after.items()):
+            if before.get(address) != active:
+                self.journal.record(
+                    MEMBER_UP if active else MEMBER_DOWN, address
+                )
+        for address in sorted(set(before) - set(after)):
+            self.journal.record(MEMBER_DOWN, address, removed=True)
+
+    def _journal_solve(self, stats_before, stats_now, moved) -> None:
+        """Emit one SOLVE event per dispatched rebalance, carrying the
+        provider's SolveStats detail when this call produced fresh stats."""
+        if self.journal is None:
+            return
+        attrs: dict = {"moved": int(moved or 0)}
+        epoch = 0
+        if stats_now is not None and stats_now is not stats_before:
+            epoch = int(getattr(stats_now, "epoch", 0) or 0)
+            attrs.update(
+                mode=str(getattr(stats_now, "mode", "")),
+                displaced=int(getattr(stats_now, "displaced", 0) or 0),
+                solve_ms=round(float(getattr(stats_now, "solve_ms", 0.0) or 0.0), 3),
+                apply_ms=round(float(getattr(stats_now, "apply_ms", 0.0) or 0.0), 3),
+                discarded=bool(getattr(stats_now, "discarded", False)),
+            )
+        self.journal.record(SOLVE, epoch=epoch, **attrs)
+
     def _solve_epoch(self):
         """The provider's last COMMITTED-solve epoch, when it exposes one.
 
@@ -240,6 +283,7 @@ class PlacementDaemon:
                     # exception mid-retry leaves the flag armed and the
                     # still-unserved churn event is retried next poll.
                     first_sync = self._last_liveness is None and not retry
+                    prev_liveness = self._last_liveness
                     self._last_liveness = liveness
                     self.placement.sync_members(members)
                     if first_sync:
@@ -249,6 +293,7 @@ class PlacementDaemon:
                         continue
                     if changed:  # a pure retry serves an already-counted event
                         self.stats.liveness_changes += 1
+                        self._journal_liveness(prev_liveness, liveness)
                     solve_epoch = self._solve_epoch()
                     # Debounce a churn burst into one solve; the random
                     # jitter staggers the daemons of co-located servers
@@ -281,6 +326,7 @@ class PlacementDaemon:
                         stats_now is not stats_before
                         and getattr(stats_now, "discarded", False)
                     )
+                    self._journal_solve(stats_before, stats_now, moved)
                     if ours_discarded:
                         # The solve lost an epoch race (concurrent churn or
                         # allocation landed mid-solve): the liveness change

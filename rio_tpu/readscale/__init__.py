@@ -50,6 +50,7 @@ from typing import Any
 from .. import codec
 from ..app_data import AppData
 from ..cluster.storage import MembershipStorage
+from ..journal import READ_PROXY, READ_SHED, REPLICA_K, Journal
 from ..object_placement import ObjectPlacement
 from ..protocol import (
     ErrorKind,
@@ -196,6 +197,10 @@ class ReadScaleManager:
         # rate level that earned it (the shrink hysteresis reference).
         self._k_view: dict[tuple[str, str], int] = {}
         self._k_rate: dict[tuple[str, str], float] = {}
+        # Control-plane flight recorder: routing DECISIONS (shed, proxy, k
+        # change) are journaled; locally served standby reads are not — they
+        # are the data path, and the ring must survive a hot key.
+        self._journal = app_data.try_get(Journal)
         # Attach to the replication engine: freshness pings keep servable
         # replicas inside the staleness bound while the primary is healthy.
         replication.read_refresh = True
@@ -251,6 +256,14 @@ class ReadScaleManager:
         # Too stale (or the shadow choked): the contract says forward to
         # the primary, never an error and never an answer past the bound.
         self.stats.standby_forwards += 1
+        if self._journal is not None:
+            fresh_age = round(fresh.age_s(), 4) if fresh is not None else -1.0
+            self._journal.record(
+                READ_PROXY,
+                f"{object_id.type_name}/{object_id.id}",
+                stale=not within_bound,
+                age_s=fresh_age,
+            )
         return await self._forward_to_primary(req, object_id)
 
     async def _serve_shadow(
@@ -373,6 +386,13 @@ class ReadScaleManager:
             return None
         self.stats.read_sheds += 1
         load.stats.sheds += 1
+        if self._journal is not None:
+            self._journal.record(
+                READ_SHED,
+                f"{object_id.type_name}/{object_id.id}",
+                reason=reason,
+                seats=list(cached[0]),
+            )
         return ResponseError(
             kind=ErrorKind.SERVER_BUSY,
             detail=f"read diverted: {reason}",
@@ -421,6 +441,14 @@ class ReadScaleManager:
                 continue
             self.replication.set_replica_k(oid, desired)
             self._k_view[key] = desired
+            if self._journal is not None:
+                self._journal.record(
+                    REPLICA_K,
+                    f"{oid.type_name}/{oid.id}",
+                    old_k=cur,
+                    new_k=desired,
+                    rate=round(rate, 3),
+                )
             try:
                 await self.replication.repair_seats(oid)
             except Exception:  # noqa: BLE001 — re-seat retries next tick

@@ -52,6 +52,7 @@ from dataclasses import dataclass, field
 
 from ..client import Client
 from ..cluster.storage import MembershipStorage
+from ..journal import REMINDER_HANDOFF, REMINDER_RELEASE, REMINDER_SEAT
 from ..object_placement import ObjectPlacement, ObjectPlacementItem
 from ..registry import ObjectId
 from ..service_object import ReminderFired
@@ -112,6 +113,7 @@ class ReminderDaemon:
         storage: ReminderStorage,
         config: ReminderDaemonConfig | None = None,
         client: Client | None = None,
+        journal=None,
     ) -> None:
         self.address = address
         self.members_storage = members_storage
@@ -120,9 +122,15 @@ class ReminderDaemon:
         self.config = config or ReminderDaemonConfig()
         self.stats = ReminderDaemonStats()
         self._client = client
+        # Control-plane flight recorder; seat transitions only, never ticks.
+        self.journal = journal
         self._held: dict[int, int] = {}  # shard -> lease epoch we hold
         self._handed_off: dict[int, float] = {}  # shard -> when we released it
         self._draining = False
+
+    def _jrecord(self, kind: str, shard: int, **attrs) -> None:
+        if self.journal is not None:
+            self.journal.record(kind, f"{SHARD_TYPE}/{shard}", **attrs)
 
     def _get_client(self) -> Client:
         if self._client is None:
@@ -167,6 +175,9 @@ class ReminderDaemon:
                         ObjectPlacementItem(object_id=oid, server_address=self.address)
                     )
                     self.stats.claims += 1
+                    self._jrecord(
+                        REMINDER_SEAT, shard, stolen_from=owner, epoch=lease.epoch
+                    )
                     return self.address
         if owner is None and not self._draining:
             if self._preferred(shard, sorted(active)) == self.address:
@@ -174,6 +185,7 @@ class ReminderDaemon:
                     ObjectPlacementItem(object_id=oid, server_address=self.address)
                 )
                 self.stats.claims += 1
+                self._jrecord(REMINDER_SEAT, shard, reason="preferred")
                 owner = self.address
         return owner
 
@@ -195,6 +207,7 @@ class ReminderDaemon:
         if epoch is not None:
             self.stats.releases += 1
             self._handed_off[shard] = time.time()
+            self._jrecord(REMINDER_RELEASE, shard, epoch=epoch)
             with contextlib.suppress(Exception):
                 await self.storage.release_lease(shard, self.address, epoch)
 
@@ -306,6 +319,7 @@ class ReminderDaemon:
         ``Server._drain_and_exit`` before the placement cordon."""
         self._draining = True
         for shard in list(self._held):
+            self._jrecord(REMINDER_HANDOFF, shard, reason="drain")
             await self._release_held(shard)
             oid = ObjectId(SHARD_TYPE, str(shard))
             with contextlib.suppress(Exception):

@@ -64,6 +64,13 @@ from .. import codec
 from ..app_data import AppData
 from ..cluster.storage import MembershipStorage
 from ..errors import ObjectNotFound
+from ..journal import (
+    REPLICA_DEPOSE,
+    REPLICA_PROMOTE,
+    REPLICA_RESHIP,
+    REPLICA_SEAT,
+    Journal,
+)
 from ..migration import INBOX_TYPE, MigrationManager, ReplicaAck, ReplicaAppend
 from ..object_placement import ObjectPlacement
 from ..registry import ObjectId, Registry, type_id
@@ -188,6 +195,15 @@ class ReplicationManager:
         # the solver provider exists to avoid.
         self._seats: dict[tuple[str, str], tuple[list[str], int, float]] = {}
         self._client = client
+        # Control-plane flight recorder (rio_tpu/journal). Role transitions
+        # only — the per-request ship path never records.
+        self._journal = app_data.try_get(Journal)
+
+    def _jrecord(self, kind: str, object_id: ObjectId, **attrs: Any) -> None:
+        if self._journal is not None:
+            self._journal.record(
+                kind, f"{object_id.type_name}/{object_id.id}", **attrs
+            )
 
     # ------------------------------------------------------------------
     # Primary role: ship-on-ack
@@ -321,6 +337,7 @@ class ReplicationManager:
         if primary is not None and primary != self.address:
             self._drop_primary_role(key)
             self.stats.deposed += 1
+            self._jrecord(REPLICA_DEPOSE, object_id, directory_primary=primary)
             log.warning(
                 "deposed as primary for %s (directory names %s); ship aborted",
                 object_id, primary,
@@ -398,6 +415,9 @@ class ReplicationManager:
             return live, epoch  # nothing placeable; keep whatever stands
         epoch = await self.placement.set_standbys(object_id, seats)
         self.stats.seats_assigned += len([a for a in seats if a not in held])
+        self._jrecord(
+            REPLICA_SEAT, object_id, seats=list(seats), epoch=int(epoch)
+        )
         return seats, epoch
 
     # ------------------------------------------------------------------
@@ -541,6 +561,14 @@ class ReplicationManager:
             if new_epoch is not None:
                 self.stats.promotions += 1
                 self._seats.pop((object_id.type_name, object_id.id), None)
+                self._jrecord(
+                    REPLICA_PROMOTE,
+                    object_id,
+                    new_primary=cand,
+                    dead=dead or "",
+                    epoch=int(epoch),
+                    new_epoch=int(new_epoch),
+                )
                 log.info(
                     "promoted %s standby %s (epoch %d -> %d)",
                     object_id, cand, epoch, new_epoch,
@@ -607,6 +635,9 @@ class ReplicationManager:
                     # still advances — keep their replicas servably fresh.
                     await self.refresh_standbys(ObjectId(tname, oid))
                 continue
+            self._jrecord(
+                REPLICA_RESHIP, ObjectId(tname, oid), bytes=len(payload)
+            )
             await self._ship(ObjectId(tname, oid), (tname, oid), payload)
             shipped += 1
         return shipped

@@ -27,6 +27,12 @@ from .commands import (
     ServerInfo,
 )
 from .errors import ServerError
+from .journal import (
+    MEMBER_CORDON,
+    PLACE_RELEASE,
+    Journal,
+    format_event,
+)
 from .message_router import MessageRouter
 from .object_placement import ObjectPlacement
 from .registry import ObjectId, Registry
@@ -97,6 +103,8 @@ class Server:
         load_monitor: bool = True,
         load_thresholds=None,
         metrics: bool = True,
+        journal: bool = True,
+        journal_capacity: int = 4096,
     ) -> None:
         if transport not in ("asyncio", "native", "auto"):
             raise ValueError(f"unknown transport {transport!r}")
@@ -165,6 +173,13 @@ class Server:
         tracker = getattr(self.object_placement, "affinity_tracker", None)
         if tracker is not None and DispatchObserver not in self.app_data:
             self.app_data.set(DispatchObserver(tracker.observe))
+        # Control-plane flight recorder (rio_tpu/journal): on by default —
+        # a bounded ring appended only on control transitions (placement,
+        # migration, promotion, sheds...), never per request. Subsystems
+        # resolve it from AppData; the node id is stamped at bind().
+        self.journal = Journal(capacity=journal_capacity) if journal else None
+        if self.journal is not None:
+            self.app_data.set(self.journal)
         # Per-handler RED histograms (rio_tpu/metrics): on by default — an
         # O(1) unlocked record per dispatch; ``metrics=False`` removes even
         # that (the service layer sees no registry and skips the timing).
@@ -271,6 +286,10 @@ class Server:
             bound_host, bound_port = sock.getsockname()[:2]
         self._local_addr = self._advertised(bound_host, bound_port)
         self.app_data.set(ServerInfo(self._local_addr))
+        if self.journal is not None:
+            # Events recorded before bind (none today) would carry "";
+            # everything from here on names this node in merged histories.
+            self.journal.node = self._local_addr
         if self.migration_manager is None:
             # Wire the migration control plane: the coordinator in AppData
             # (service layer refusals + lifecycle restore find it there) and
@@ -424,6 +443,20 @@ class Server:
                     "%s: AdminCommand::DumpStats %s", self._local_addr,
                     server_gauges(self),
                 )
+            if cmd.kind == AdminCommandKind.DUMP_EVENTS:
+                # In-process twin of the rio.Admin DumpEvents wire scrape:
+                # dump the journal tail to the log for ops spelunking.
+                if self.journal is None:
+                    log.info("%s: AdminCommand::DumpEvents (journal off)",
+                             self._local_addr)
+                else:
+                    tail = self.journal.events(limit=64)
+                    log.info(
+                        "%s: AdminCommand::DumpEvents (%d recorded, %d dropped)\n%s",
+                        self._local_addr, self.journal.recorded,
+                        self.journal.dropped,
+                        "\n".join(format_event(e) for e in tail),
+                    )
             if cmd.kind == AdminCommandKind.MIGRATE_OBJECT:
                 if self.migration_manager is not None:
                     await self.migration_manager.migrate_out(
@@ -473,6 +506,8 @@ class Server:
                         "%s: drain degraded to exit (%r)", self._local_addr, e
                     )
                 else:
+                    if self.journal is not None:
+                        self.journal.record(MEMBER_CORDON, reason="drain")
                     if hasattr(placement, "rebalance"):
                         with contextlib.suppress(Exception):
                             await self._drain_rebalance(placement)
@@ -538,12 +573,21 @@ class Server:
                     self.app_data,
                 )
         self.registry.remove(oid.type_name, oid.id)
+        removed = False
         if only_if_local_row:
             with contextlib.suppress(Exception):
                 if await self.object_placement.lookup(oid) == self._local_addr:
                     await self.object_placement.remove(oid)
+                    removed = True
         else:
             await self.object_placement.remove(oid)
+            removed = True
+        if removed and self.journal is not None:
+            self.journal.record(
+                PLACE_RELEASE,
+                f"{oid.type_name}/{oid.id}",
+                reason="drain" if only_if_local_row else "shutdown",
+            )
 
     # ------------------------------------------------------------------
 
@@ -574,6 +618,7 @@ class Server:
                 self.members_storage, self.object_placement,
                 self.placement_daemon_config,
                 migrator=self.migration_manager,
+                journal=self.journal,
             )
             self.placement_daemon = daemon
             tasks.append(asyncio.ensure_future(daemon.run()))
@@ -592,6 +637,7 @@ class Server:
                 placement=self.object_placement,
                 storage=self.app_data.get(ReminderStorage),
                 config=self.reminder_daemon_config,
+                journal=self.journal,
             )
             self.reminder_daemon = rdaemon
             tasks.append(asyncio.ensure_future(rdaemon.run()))

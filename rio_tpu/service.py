@@ -25,6 +25,7 @@ from .app_data import AppData
 from .cluster.storage import MembershipStorage
 from .commands import DispatchObserver, ServerDraining
 from .errors import HandlerNotFound, ObjectNotFound, SerializationError, TypeNotFound
+from .journal import ADMIT_SHED, PLACE_ASSIGN, PLACE_RELEASE, Journal
 from .message_router import MessageRouter
 from .object_placement import ObjectPlacement, ObjectPlacementItem
 from .protocol import (
@@ -93,6 +94,10 @@ class Service:
         # Per-handler RED histograms (None when metrics are disabled):
         # every dispatch records (duration, error kind, exemplar trace id).
         self._metrics = app_data.try_get(MetricsRegistry)
+        # Control-plane flight recorder (None when journaling is off).
+        # Recorded on TRANSITIONS only — assign/release/shed — never on the
+        # per-request fast path.
+        self._journal = app_data.try_get(Journal)
 
     # ------------------------------------------------------------------
     # Placement (reference service.rs:193-298)
@@ -155,6 +160,10 @@ class Service:
         if addr == self.address:
             await self.object_placement.remove(object_id)
         self._load.stats.sheds += 1
+        if self._journal is not None:
+            self._journal.record(
+                ADMIT_SHED, f"{object_id.type_name}/{object_id.id}", reason=reason
+            )
         return ResponseError.server_busy(reason)
 
     async def _refuse_if_migrating(self, object_id: ObjectId) -> ResponseError | None:
@@ -177,6 +186,12 @@ class Service:
                 # Corrupt row: drop it and fall through to self-assign
                 # (reference service.rs:213-221).
                 await self.object_placement.remove(object_id)
+                if self._journal is not None:
+                    self._journal.record(
+                        PLACE_RELEASE,
+                        f"{object_id.type_name}/{object_id.id}",
+                        reason="corrupt_row",
+                    )
                 addr = None
             elif addr != self.address and not await self.members_storage.is_active(addr):
                 # Owner is dead. A replicated object fails over FIRST: the
@@ -211,6 +226,14 @@ class Service:
             await self.object_placement.update(
                 ObjectPlacementItem(object_id=object_id, server_address=addr)
             )
+            if self._journal is not None and not self.registry.is_node_scoped(
+                object_id.type_name
+            ):
+                # One event per activation seat (not per request: the fast
+                # path above returns long before this branch).
+                self._journal.record(
+                    PLACE_ASSIGN, f"{object_id.type_name}/{object_id.id}"
+                )
         return addr
 
     async def check_address_mismatch(self, addr: str) -> ResponseError | None:
@@ -446,6 +469,13 @@ class Service:
 
                 cancel_timers(panicked)
             await self.object_placement.remove(object_id)
+            if self._journal is not None:
+                self._journal.record(
+                    PLACE_RELEASE,
+                    f"{object_id.type_name}/{object_id.id}",
+                    reason="panic",
+                    error=repr(e)[:120],
+                )
             log.exception("handler panic for %s", object_id)
             return ResponseEnvelope.err(ResponseError.unknown(f"Panic: {e!r}"))
 

@@ -206,3 +206,87 @@ async def test_redis_wire_golden(monkeypatch):
         for e in log
     ]
     _assert_golden("redis_wire.txt", "\n".join(lines) + "\n")
+
+
+def test_dump_events_frame_golden():
+    """Pin the rio.Admin journal-scrape frames byte for byte.
+
+    DUMP_EVENTS is an operator-facing wire surface (the CLI and any
+    external tooling speak it to arbitrary-version nodes), so the exact
+    msgpack layout of the request envelope and the EventsSnapshot response
+    — including the positional JournalEvent row shape — is a compatibility
+    contract: rows may only ever GROW by appending trailing fields
+    (JournalEvent.from_row tolerates short rows; see MIGRATING.md).
+    """
+    from rio_tpu import codec
+    from rio_tpu.admin import ADMIN_TYPE, DumpEvents, EventsSnapshot
+    from rio_tpu.journal import JournalEvent
+    from rio_tpu.protocol import (
+        RequestEnvelope,
+        ResponseEnvelope,
+        encode_request_frame,
+        encode_response_frame,
+    )
+
+    request = encode_request_frame(
+        RequestEnvelope(
+            handler_type=ADMIN_TYPE,
+            handler_id="10.0.0.1:5000",
+            message_type="rio.DumpEvents",
+            payload=codec.serialize(
+                DumpEvents(
+                    kinds=["migrate_pin", "replica_promote"],
+                    key="Svc/g1",
+                    since_seq=7,
+                    limit=64,
+                )
+            ),
+        )
+    )
+    snapshot = EventsSnapshot(
+        address="10.0.0.1:5000",
+        node_seq=9,
+        dropped=1,
+        rows=[
+            JournalEvent(
+                seq=8,
+                wall_ts=FROZEN_TIME,
+                mono_ts=12.5,
+                node="10.0.0.1:5000",
+                epoch=3,
+                kind="migrate_pin",
+                key="Svc/g1",
+                attrs={"target": "10.0.0.2:5000"},
+                trace_id="ab" * 16,
+            ).to_row(),
+            JournalEvent(
+                seq=9,
+                wall_ts=FROZEN_TIME + 0.25,
+                mono_ts=12.75,
+                node="10.0.0.1:5000",
+                epoch=4,
+                kind="replica_promote",
+                key="Svc/g1",
+            ).to_row(),
+        ],
+    )
+    response = encode_response_frame(
+        ResponseEnvelope(body=codec.serialize(snapshot))
+    )
+
+    def hexdump(label: str, frame: bytes) -> list[str]:
+        lines = [f"== {label} ({len(frame)} bytes)"]
+        for off in range(0, len(frame), 16):
+            chunk = frame[off : off + 16]
+            lines.append(f"{off:04x}  {chunk.hex(' ')}")
+        return lines
+
+    text = "\n".join(hexdump("dump_events.request", request)
+                     + hexdump("dump_events.response", response)) + "\n"
+    _assert_golden("dump_events_frames.txt", text)
+
+    # The pinned bytes must still decode to the same snapshot (a golden
+    # that drifts AND round-trips is a wire version bump, not a bug).
+    back = codec.deserialize(codec.serialize(snapshot), EventsSnapshot)
+    assert [e.seq for e in back.events()] == [8, 9]
+    assert back.events()[0].attrs == {"target": "10.0.0.2:5000"}

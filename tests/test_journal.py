@@ -1,0 +1,363 @@
+"""Control-plane flight recorder: ring semantics + the chaos acceptance bar.
+
+The integration test is ISSUE 9's acceptance criterion: a replicated actor
+is activated, migrated (all four phases under one explicit trace), its new
+primary is hard-killed mid-traffic, and the standby promotes — and the
+merged journal, scraped over the wire from the survivors, reconstructs the
+full causal history gap-free: per-node seqs monotonic and contiguous,
+migration phases in order and sharing one trace id, promotion after the
+flip and linked to a captured request span.
+"""
+
+import asyncio
+
+import pytest
+
+from rio_tpu import (
+    AdminCommand,
+    AppData,
+    Registry,
+    ServiceObject,
+    handler,
+    message,
+    tracing,
+)
+from rio_tpu.admin import ADMIN_TYPE, DumpEvents, EventsSnapshot, explain
+from rio_tpu.journal import (
+    MEMBER_DOWN,
+    MIGRATE_FLIP,
+    MIGRATE_INSTALL,
+    MIGRATE_PIN,
+    MIGRATE_SNAPSHOT,
+    PLACE_ASSIGN,
+    REPLICA_PROMOTE,
+    REPLICA_SEAT,
+    Journal,
+    JournalEvent,
+    format_event,
+    merge_events,
+    subject_key,
+)
+from rio_tpu.commands import ServerInfo
+from rio_tpu.registry import ObjectId
+from rio_tpu.replication import ReplicationConfig
+from rio_tpu.state import LocalState, StateProvider, managed_state
+
+from .server_utils import Cluster, run_integration_test
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    tracing.clear_sinks()
+    tracing.set_sample_rate(0.0)
+    yield
+    tracing.clear_sinks()
+    tracing.set_sample_rate(0.0)
+
+
+# ---------------------------------------------------------------------------
+# Ring semantics
+# ---------------------------------------------------------------------------
+
+
+def test_record_is_sequential_and_bounded():
+    j = Journal(capacity=8, node="n1")
+    for i in range(5):
+        ev = j.record("solve", moved=i)
+        assert ev.seq == i + 1
+        assert ev.node == "n1"
+    assert j.recorded == 5
+    assert len(j) == 5
+    assert j.dropped == 0
+    assert [e.seq for e in j.events()] == [1, 2, 3, 4, 5]
+
+
+def test_ring_overflow_counts_drops_and_never_fails():
+    j = Journal(capacity=8, node="n1")
+    for i in range(20):
+        ev = j.record("place_assign", f"T/{i}")
+        assert ev.seq == i + 1  # record always succeeds, even when full
+    assert j.recorded == 20
+    assert j.dropped == 12  # 20 recorded - 8 slots
+    assert len(j) == 8
+    # The NEWEST capacity-many events survive, oldest → newest.
+    assert [e.seq for e in j.events()] == list(range(13, 21))
+    assert j.gauges()["rio.journal.dropped"] == 12.0
+
+
+def test_events_filters_and_tail_limit():
+    j = Journal(capacity=64, node="n1")
+    for i in range(10):
+        j.record("place_assign" if i % 2 == 0 else "solve", f"T/{i % 3}")
+    assert len(j.events(kinds=["solve"])) == 5
+    assert all(e.kind == "solve" for e in j.events(kinds=["solve"]))
+    by_key = j.events(key="T/0")
+    assert [e.key for e in by_key] == ["T/0"] * len(by_key)
+    # since_seq is exclusive (resume from the last seq you saw).
+    assert [e.seq for e in j.events(since_seq=7)] == [8, 9, 10]
+    # limit keeps the NEWEST matches — a tail, not a head.
+    assert [e.seq for e in j.events(limit=3)] == [8, 9, 10]
+    assert [e.seq for e in j.events(kinds=["solve"], limit=2)] == [8, 10]
+
+
+def test_row_round_trip_and_tolerant_decode():
+    j = Journal(capacity=4, node="a:1")
+    ev = j.record("migrate_pin", "W/w0", epoch=3, target="b:2")
+    back = JournalEvent.from_row(ev.to_row())
+    assert back == ev
+    # Short legacy row: missing trailing fields decode to defaults.
+    short = JournalEvent.from_row([7, 1.5, 2.5, "a:1", 0, "solve"])
+    assert (short.seq, short.kind, short.key, short.attrs, short.trace_id) == (
+        7, "solve", "", {}, None,
+    )
+    # Longer future row: extra trailing fields ignored; garbage attrs → {}.
+    future = JournalEvent.from_row(
+        [1, 1.0, 1.0, "n", 0, "k", "K", "not-a-dict", 42, "future-field"]
+    )
+    assert future.attrs == {}
+    assert future.trace_id is None  # non-str trace slot tolerated
+
+
+def test_merge_preserves_per_node_order_under_wall_ties():
+    a, b = Journal(capacity=8, node="a"), Journal(capacity=8, node="b")
+    for i in range(3):
+        a.record("solve", moved=i)
+        b.record("solve", moved=i)
+    evs = merge_events([a.events(), b.events()])
+    # Pin identical wall clocks to force the tie-break path.
+    for e in evs:
+        e.wall_ts = 100.0
+    evs = merge_events([[e for e in evs if e.node == "a"],
+                        [e for e in evs if e.node == "b"]])
+    for node in ("a", "b"):
+        seqs = [e.seq for e in evs if e.node == node]
+        assert seqs == sorted(seqs)  # per-node order survives the merge
+
+
+def test_record_captures_active_trace():
+    spans = []
+    tracing.add_sink(spans.append)
+    j = Journal(capacity=4, node="n")
+    assert j.record("solve").trace_id is None  # no active span
+    with tracing.span("drive"):
+        ev = j.record("migrate_pin", "W/w0")
+        inside = tracing.current_trace_id()
+    assert ev.trace_id == inside and inside is not None
+    assert spans[-1].trace_id == inside
+    line = format_event(ev)
+    assert "migrate_pin" in line and "W/w0" in line and inside in line
+
+
+# ---------------------------------------------------------------------------
+# Chaos: migrate, kill the new primary mid-traffic, promote — then explain
+# ---------------------------------------------------------------------------
+
+ACTIVE: dict[str, str] = {}
+
+
+@message
+class JAdd:
+    amount: int = 0
+
+
+@message
+class JTotals:
+    total: int = 0
+    hot: int = 0
+    address: str = ""
+
+
+@message
+class JLedgerState:
+    total: int = 0
+
+
+class JLedger(ServiceObject):
+    __replicated__ = True
+
+    state = managed_state(JLedgerState)
+
+    def __init__(self):
+        self.hot = 0
+
+    def __migrate_state__(self):
+        return {"hot": self.hot}
+
+    def __restore_state__(self, value):
+        self.hot = int(value["hot"])
+
+    @handler
+    async def add(self, msg: JAdd, ctx: AppData) -> JTotals:
+        self.state.total += msg.amount
+        self.hot += msg.amount
+        await self.save_state(ctx)
+        return JTotals(
+            total=self.state.total, hot=self.hot, address=ctx.get(ServerInfo).address
+        )
+
+
+def build_registry() -> Registry:
+    return Registry().add_type(JLedger)
+
+
+async def _wait_dead(cluster: Cluster, address: str, timeout: float = 10.0) -> None:
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if not await cluster.members.is_active(address):
+            return
+        await asyncio.sleep(0.02)
+    raise TimeoutError(f"{address} never went inactive")
+
+
+def test_chaos_journal_reconstructs_migration_and_promotion():
+    state = LocalState()
+    spans = []
+
+    async def body(cluster: Cluster):
+        tracing.set_sample_rate(1.0)
+        tracing.add_sink(spans.append)
+        client = cluster.client()
+        try:
+            subject = subject_key("JLedger", "L1")
+            out = await client.send(JLedger, "L1", JAdd(amount=1), returns=JTotals)
+            source_addr = out.address
+            for _ in range(4):
+                out = await client.send(JLedger, "L1", JAdd(amount=1), returns=JTotals)
+            source = next(
+                s for s in cluster.servers if s.local_address == source_addr
+            )
+            # Let the replication daemon seat the standby before migrating.
+            for _ in range(100):
+                held, _ = await cluster.placement.standbys(ObjectId("JLedger", "L1"))
+                if held:
+                    break
+                await asyncio.sleep(0.05)
+            assert held and source_addr not in held
+
+            # Drive the handoff inside an explicit span: every source-side
+            # phase (pin → snapshot → install → flip) must share its trace.
+            target_addr = next(
+                s.local_address
+                for s in cluster.servers
+                if s.local_address != source_addr and s.local_address not in held
+            )
+            with tracing.span("chaos_migrate") as sp:
+                migrate_trace = sp.trace_id
+                ok = await source.migration_manager.migrate_out(
+                    ObjectId("JLedger", "L1"), target_addr
+                )
+            assert ok
+
+            # Traffic lands on the new primary; ship-on-ack re-arms the
+            # standby with post-migration state.
+            acked = 5
+            for _ in range(5):
+                out = await client.send(JLedger, "L1", JAdd(amount=1), returns=JTotals)
+                acked += 1
+            assert out.address == target_addr
+            for _ in range(100):
+                held2, _ = await cluster.placement.standbys(ObjectId("JLedger", "L1"))
+                if held2 and target_addr not in held2:
+                    break
+                await asyncio.sleep(0.05)
+            assert held2 and target_addr not in held2
+
+            # Kill the new primary hard, mid-conversation.
+            target_srv = next(
+                s for s in cluster.servers if s.local_address == target_addr
+            )
+            target_srv.admin_sender().send(AdminCommand.server_exit())
+            await _wait_dead(cluster, target_addr)
+
+            for _ in range(3):
+                out = await client.send(JLedger, "L1", JAdd(amount=1), returns=JTotals)
+                acked += 1
+            assert out.total == acked  # promotion kept every acked write
+
+            # --- the journal acceptance assertions ---
+
+            # Per-node seqs are monotonic AND contiguous (gap-free), and the
+            # ring never dropped: recording is bounded but nothing spilled.
+            for s in cluster.servers:
+                assert s.journal is not None
+                seqs = [e.seq for e in s.journal.events()]
+                assert seqs == list(range(seqs[0], seqs[0] + len(seqs)))
+                assert s.journal.dropped == 0
+
+            # Wire-scraped explain over the SURVIVORS reconstructs the full
+            # causal history (the dead node's rows died with it; the
+            # source-side install row keeps the chain complete).
+            survivors = [
+                s.local_address
+                for s in cluster.servers
+                if s.local_address != target_addr
+            ]
+            history = await explain(client, survivors, "JLedger", "L1")
+            kinds = [e.kind for e in history]
+            assert PLACE_ASSIGN in kinds
+            for k in (MIGRATE_PIN, MIGRATE_SNAPSHOT, MIGRATE_INSTALL,
+                      MIGRATE_FLIP, REPLICA_PROMOTE):
+                assert kinds.count(k) >= 1, k
+            order = [kinds.index(k) for k in
+                     (MIGRATE_PIN, MIGRATE_SNAPSHOT, MIGRATE_FLIP)]
+            assert order == sorted(order)
+            assert kinds.index(MIGRATE_FLIP) < kinds.index(REPLICA_PROMOTE)
+            assert kinds.index(PLACE_ASSIGN) < kinds.index(MIGRATE_PIN)
+
+            # One shared trace across the migration hops: every source-side
+            # phase row carries the explicit span's trace id.
+            phase_traces = {
+                e.trace_id for e in history
+                if e.kind in (MIGRATE_PIN, MIGRATE_SNAPSHOT, MIGRATE_FLIP)
+            }
+            assert phase_traces == {migrate_trace}
+
+            # The promotion ran inside a traced request: its row joins the
+            # captured request spans on trace_id.
+            promote = next(e for e in history if e.kind == REPLICA_PROMOTE)
+            assert promote.trace_id is not None
+            assert promote.trace_id in {s.trace_id for s in spans}
+            assert promote.attrs.get("new_primary") == out.address
+            assert promote.attrs.get("dead") == target_addr
+
+            # Seat churn was journaled too (standby (re)assignments).
+            all_events = merge_events(
+                [s.journal.events() for s in cluster.servers]
+            )
+            assert any(e.kind == REPLICA_SEAT and e.key == subject for e in all_events)
+
+            # Resumable tail over the wire: since_seq excludes what we saw.
+            snap = await client.send(
+                ADMIN_TYPE,
+                survivors[0],
+                DumpEvents(key=subject),
+                returns=EventsSnapshot,
+            )
+            assert snap.node_seq >= max((e.seq for e in snap.events()), default=0)
+            resumed = await client.send(
+                ADMIN_TYPE,
+                survivors[0],
+                DumpEvents(key=subject, since_seq=snap.node_seq),
+                returns=EventsSnapshot,
+            )
+            assert resumed.rows == []
+        finally:
+            client.close()
+
+    async def wrapped(cluster: Cluster):
+        for s in cluster.servers:
+            s.app_data.set(state, as_type=StateProvider)
+        await body(cluster)
+
+    asyncio.run(
+        run_integration_test(
+            wrapped,
+            registry_builder=build_registry,
+            num_servers=3,
+            server_kwargs={
+                "replication_config": ReplicationConfig(
+                    k=1, anti_entropy_interval=0.3, seat_ttl=0.3
+                )
+            },
+        )
+    )

@@ -452,6 +452,91 @@ def test_otlp_sink_replays_spans_through_fake_sdk():
         fake_otel.uninstall(handle)
 
 
+def test_server_gauges_expose_journal_and_solve_history():
+    """ISSUE 9 satellite: rio.journal.* counters and the rolling
+    SolveStats.history summary ride the same server_gauges snapshot that
+    DUMP_STATS serves — no new scrape path."""
+    from rio_tpu.otel import server_gauges
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        try:
+            await client.send(Observed, "g1", Hit(), returns=Echo)
+            per_node = [server_gauges(s) for s in cluster.servers]
+            for gauges in per_node:
+                assert gauges["rio.journal.ring_capacity"] == 4096.0
+                assert gauges["rio.journal.dropped"] == 0.0
+                assert (
+                    gauges["rio.journal.ring_occupancy"]
+                    == gauges["rio.journal.events"]
+                )
+            # The activation seat was journaled on whichever node seated g1.
+            assert sum(g["rio.journal.events"] for g in per_node) >= 1.0
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(body, registry_builder=build_registry, num_servers=2)
+    )
+
+
+def test_solve_history_gauges_summarize_the_window():
+    from rio_tpu.object_placement.jax_placement import SolveStats
+
+    empty = SolveStats()
+    assert empty.history_gauges() == {
+        "rio.placement_solve.history.len": 0.0
+    }
+
+    stats = SolveStats(mode="full", solve_ms=10.0, moved=3, n_objects=10)
+    stats.history.append(
+        SolveStats(mode="full", solve_ms=30.0, moved=5, n_objects=10)
+    )
+    stats.history.append(
+        SolveStats(mode="none", discarded=True)  # discarded solves count too
+    )
+    g = stats.history_gauges()
+    assert g["rio.placement_solve.history.len"] == 3.0
+    assert g["rio.placement_solve.history.solve_ms_last"] == 10.0
+    assert g["rio.placement_solve.history.solve_ms_max"] == 30.0
+    assert g["rio.placement_solve.history.moved_total"] == 8.0
+    assert g["rio.placement_solve.history.discarded_total"] == 1.0
+
+
+def test_otel_auto_registration_picks_up_journal_gauges():
+    """The observable-gauge bridge needs no journal-specific wiring: the
+    rio.journal.* names ride the server_gauges snapshot, so the callback
+    re-scan registers them like any other late-appearing gauge."""
+    from . import fake_otel
+    from rio_tpu.otel import otlp_metrics_exporter, server_gauges
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        handle = fake_otel.install()
+        try:
+            server = cluster.servers[0]
+            provider = otlp_metrics_exporter(
+                lambda: server_gauges(server), interval=9999.0
+            )
+            exporter = handle.metric_exporters[-1]
+            await client.send(Observed, "g2", Hit(), returns=Echo)
+            # First cycle discovers any names that appeared since init;
+            # they export from the second cycle on (fake mirrors the SDK).
+            provider.force_flush()
+            provider.force_flush()
+            exported = exporter.exported[-1]
+            for name in ("events", "dropped", "ring_occupancy", "ring_capacity"):
+                assert f"rio.journal.{name}" in exported
+            assert exported["rio.journal.ring_capacity"] == 4096.0
+        finally:
+            fake_otel.uninstall(handle)
+            client.close()
+
+    asyncio.run(
+        run_integration_test(body, registry_builder=build_registry, num_servers=2)
+    )
+
+
 def test_internal_client_send_carries_trace_ctx():
     """A handler's actor→actor send crosses the internal queue into a
     DIFFERENT task context; the trace must be captured at enqueue."""
