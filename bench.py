@@ -1473,6 +1473,26 @@ def _run_child(
     return proc.returncode, parsed
 
 
+def _host_provenance() -> dict:
+    """Host conditions stamped onto every rpc_* stage result.
+
+    msgs/s on this box is meaningless without knowing how many cores the
+    stage actually had (cpu_count vs the cgroup/affinity mask can differ)
+    and what else was running (loadavg) — the sharded A/Bs in particular
+    read completely differently on 1 core vs 4.
+    """
+    prov: dict = {"cpu_count": os.cpu_count()}
+    try:
+        prov["sched_affinity"] = sorted(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        prov["sched_affinity"] = None
+    try:
+        prov["loadavg"] = [round(x, 2) for x in os.getloadavg()]
+    except OSError:
+        prov["loadavg"] = None
+    return prov
+
+
 def rpc_throughput(baseline: float | None = None) -> dict:
     """Actor data-plane msgs/sec per transport; also printed to stderr.
 
@@ -1489,7 +1509,10 @@ def rpc_throughput(baseline: float | None = None) -> dict:
     if baseline is None:
         baseline = sqlite_baseline_rate()
     transports = ["asyncio"] + (["native"] if native.get() is not None else [])
-    rates: dict = {"sqlite_baseline_in_session": round(baseline)}
+    rates: dict = {
+        "sqlite_baseline_in_session": round(baseline),
+        "host": _host_provenance(),
+    }
     for transport in transports:
         # 600 req/worker: long enough to amortize pool warm-up (the 400
         # default under-reads the steady state by ~25%).
@@ -1508,6 +1531,207 @@ def rpc_throughput(baseline: float | None = None) -> dict:
             file=sys.stderr,
         )
     return rates
+
+
+def rpc_sharded(baseline: float | None = None) -> dict:
+    """Sharded data-plane A/B battery (real worker processes, loopback).
+
+    Four measurements, every pair interleaved in the SAME session (only
+    ratios are comparable across artifacts; ``host`` records how many
+    cores the stage actually had — the aggregate reads completely
+    differently on 1 core vs 4):
+
+    * ``sharded_vs_plain`` — 1 sharded worker (front door + identity port
+      + shard router machinery) vs 1 plain server child: the price of the
+      sharding envelope itself, acceptance ≥ ~0.9.
+    * ``batch_decode`` — workers with the per-read batch decode on vs off
+      (``RIO_TPU_BATCH_DECODE``), same topology otherwise.
+    * ``n_workers`` — aggregate msgs/s through N workers, driven by
+      ``--loadgen`` children (WARM/GO-coordinated concurrent windows).
+    * ``engine`` — N workers on the native transport vs asyncio (identity
+      ports only: the front-door listener is asyncio's), plus the
+      ``engine_profitable`` verdict the dispatch rule would apply.
+    """
+    import asyncio
+    import shutil
+    import statistics
+    import tempfile
+
+    from rio_tpu import native
+    from rio_tpu.sharded import ShardedServer, sqlite_members
+    from rio_tpu.utils.routing_live import measure_rpc_external
+
+    if baseline is None:
+        baseline = sqlite_baseline_rate()
+    here = os.path.dirname(os.path.abspath(__file__))
+    base_env = {
+        "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+        "HOME": os.environ.get("HOME", "/tmp"),
+        "PYTHONPATH": here,
+        "JAX_PLATFORMS": "cpu",
+    }
+    echo = "rio_tpu.utils.routing_live:build_echo_registry"
+    nodes: list = []
+    tmps: list[str] = []
+
+    def boot(workers, *, router=True, front_door=True, env=None,
+             server_kwargs=None):
+        tmp = tempfile.mkdtemp(prefix="rio_sharded_bench_")
+        tmps.append(tmp)
+        node = ShardedServer(
+            address="127.0.0.1:0", workers=workers, registry=echo,
+            data_dir=tmp, router=router, front_door=front_door,
+            env=env, server_kwargs=server_kwargs,
+        )
+        node.start()
+        nodes.append(node)
+        asyncio.run(node.wait_ready(60.0))
+        return node
+
+    def window(node, n_workers=32, per=300, n_objects=128):
+        members = sqlite_members(node.data_dir)
+        try:
+            return asyncio.run(
+                measure_rpc_external(
+                    members, n_workers=n_workers, requests_per_worker=per,
+                    n_objects=n_objects,
+                )
+            )
+        finally:
+            members.close()
+
+    def paired(node_a, node_b, batches=3):
+        """Interleaved A/B windows; median per-batch ratio b/a."""
+        ra, rb = [], []
+        for _ in range(batches):
+            ra.append(window(node_a))
+            rb.append(window(node_b))
+        ratio = statistics.median(b / a for a, b in zip(ra, rb))
+        return [round(r) for r in ra], [round(r) for r in rb], round(ratio, 3)
+
+    def loadgen_aggregate(node, n_gens=2):
+        """Concurrent measured windows from separate loadgen processes."""
+        procs = []
+        for g in range(n_gens):
+            p = subprocess.Popen(
+                [sys.executable, "-m", "rio_tpu.sharded", "--loadgen"],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                env=base_env, text=True,
+            )
+            spec = {
+                "members": node.members_spec, "data_dir": node.data_dir,
+                "n_objects": 128, "n_workers": 16,
+                "requests_per_worker": 200, "prefix": f"lg{g}",
+            }
+            p.stdin.write(json.dumps(spec) + "\n")
+            p.stdin.flush()
+            procs.append(p)
+        try:
+            for p in procs:  # all generators warm before any measures
+                assert "WARM" in p.stdout.readline()
+            for p in procs:  # GO
+                p.stdin.write("\n")
+                p.stdin.flush()
+            gens = []
+            for p in procs:
+                for line in p.stdout:
+                    if line.startswith("RESULT "):
+                        gens.append(json.loads(line[len("RESULT "):]))
+                        break
+                p.wait(timeout=60)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        return {
+            "aggregate_rate": round(sum(g["rate"] for g in gens)),
+            "generators": gens,
+        }
+
+    out: dict = {
+        "sqlite_baseline_in_session": round(baseline),
+        "host": _host_provenance(),
+        "engine_profitable": native.engine_profitable(),
+    }
+    try:
+        n = max(2, min(4, os.cpu_count() or 1))
+        plain = boot(1, router=False, front_door=False)
+        sharded1 = boot(1)
+        pr, sr, ratio = paired(plain, sharded1)
+        out["one_worker"] = {
+            "plain_1proc": pr, "sharded_1worker": sr,
+            "sharded_vs_plain": ratio,
+            "vs_sqlite": round(sr[-1] / baseline, 3),
+        }
+        print(
+            f"# rpc sharded (1 worker vs plain child, paired): "
+            f"{sr[-1]:,.0f} vs {pr[-1]:,.0f} msgs/sec = {ratio:.3f}x",
+            file=sys.stderr,
+        )
+
+        decode_off = boot(
+            1, env={**base_env, "RIO_TPU_BATCH_DECODE": "0"}
+        )
+        # 5 batches: the decode delta is ~1% on one core, inside 3-batch
+        # noise (a 7-batch calibration run read median 1.009, range
+        # 0.97-1.03 — the win needs the extra pairs to resolve).
+        offr, onr, on_vs_off = paired(decode_off, sharded1, batches=5)
+        out["batch_decode"] = {
+            "off": offr, "on": onr, "on_vs_off": on_vs_off,
+        }
+        print(
+            f"# rpc sharded (batch decode on vs off, paired): "
+            f"{onr[-1]:,.0f} vs {offr[-1]:,.0f} msgs/sec = {on_vs_off:.3f}x",
+            file=sys.stderr,
+        )
+
+        node_n = boot(n)
+        agg = loadgen_aggregate(node_n)
+        agg["n_workers"] = n
+        agg["vs_sqlite"] = round(agg["aggregate_rate"] / baseline, 3)
+        out["n_workers"] = agg
+        print(
+            f"# rpc sharded ({n} workers, {len(agg['generators'])} loadgen "
+            f"procs): {agg['aggregate_rate']:,.0f} msgs/sec aggregate "
+            f"({agg['vs_sqlite']:.2f}x in-session sqlite baseline)",
+            file=sys.stderr,
+        )
+
+        # Native-engine A/B, identity ports only: the front-door socket is
+        # the asyncio transport's (the native engine owns its one
+        # listener). On a <2-core host engine_profitable() already says
+        # the handoff is pure loss — the measurement shows it anyway.
+        if native.get() is not None:
+            try:
+                node_async = boot(n, front_door=False)
+                node_native = boot(
+                    n, front_door=False,
+                    server_kwargs={"transport": "native"},
+                )
+                ar, nr, native_vs = paired(node_async, node_native)
+                out["engine"] = {
+                    "asyncio": ar, "native": nr,
+                    "native_vs_asyncio": native_vs,
+                }
+                print(
+                    f"# rpc sharded ({n} workers, native vs asyncio "
+                    f"transport, paired): {nr[-1]:,.0f} vs {ar[-1]:,.0f} "
+                    f"msgs/sec = {native_vs:.3f}x (engine_profitable="
+                    f"{out['engine_profitable']})",
+                    file=sys.stderr,
+                )
+            except Exception as e:
+                out["engine"] = {"error": repr(e)}
+                print(f"# rpc sharded engine A/B failed: {e!r}", file=sys.stderr)
+    finally:
+        for node in nodes:
+            try:
+                node.stop()
+            except Exception:
+                pass
+        for tmp in tmps:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return out
 
 
 def migration_drain() -> dict:
@@ -1951,6 +2175,10 @@ def main() -> None:
     except Exception as e:
         print(f"# rpc throughput failed: {e!r}", file=sys.stderr)
     try:
+        detail["rpc_sharded"] = rpc_sharded(baseline)
+    except Exception as e:
+        print(f"# rpc sharded failed: {e!r}", file=sys.stderr)
+    try:
         detail["migration_drain"] = migration_drain()
     except Exception as e:
         print(f"# migration drain failed: {e!r}", file=sys.stderr)
@@ -2121,6 +2349,9 @@ if __name__ == "__main__":
     # Rehearse the control-plane journal overhead A/B alone (same CPU-safe
     # in-process-cluster shape as --migration).
     parser.add_argument("--journal", action="store_true")
+    # Run the sharded data-plane A/B battery alone and bank it into the
+    # cpu sidecar (real worker processes on loopback; CPU-safe).
+    parser.add_argument("--sharded", action="store_true")
     args = parser.parse_args()
     if args.migration:
         _pin_orchestrator_to_cpu()
@@ -2134,6 +2365,24 @@ if __name__ == "__main__":
     elif args.journal:
         _pin_orchestrator_to_cpu()
         print(json.dumps(journal_overhead()))
+    elif args.sharded:
+        # Standalone --sharded updates the banked cpu sidecar in place:
+        # the stage carries its own in-session sqlite baseline, so it can
+        # refresh independently of the other host stages (each of which
+        # embeds its own baseline too).
+        _pin_orchestrator_to_cpu()
+        out = rpc_sharded()
+        here = os.path.dirname(os.path.abspath(__file__))
+        try:
+            with open(os.path.join(here, "BENCH_DETAIL.cpu.json")) as fh:
+                detail = json.load(fh)
+            if not isinstance(detail, dict):
+                detail = {}
+        except (OSError, ValueError):
+            detail = {}
+        detail["rpc_sharded"] = out
+        _write_detail(detail, here)
+        print(json.dumps(out))
     elif args.delta:
         run_delta_tier(args.tier or 1_048_576, args.platform, args.deadline)
     elif args.tier is not None and args.hier:

@@ -845,9 +845,12 @@ void engine_loop(Engine* e) {
 // Creates the engine and (when host is non-empty) binds the listening
 // socket. host is a dotted quad ("0.0.0.0" for any); an empty host makes a
 // client-only engine with no listener. *port_inout carries the requested
-// port in and the actually-bound port out (0 for client-only). Returns
-// nullptr on failure.
-void* rn_engine_create(const char* host, uint16_t* port_inout) {
+// port in and the actually-bound port out (0 for client-only). reuse_port
+// != 0 sets SO_REUSEPORT before bind (sharded workers: bind an identity
+// port against the supervisor's reservation, or share one front-door port
+// with kernel accept distribution). Returns nullptr on failure.
+void* rn_engine_create_opt(const char* host, uint16_t* port_inout,
+                           int32_t reuse_port) {
   auto* e = new Engine();
   bool want_listener = host != nullptr && host[0] != '\0';
   e->epfd = epoll_create1(EPOLL_CLOEXEC);
@@ -865,6 +868,8 @@ void* rn_engine_create(const char* host, uint16_t* port_inout) {
   if (want_listener) {
     int one = 1;
     setsockopt(e->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    if (reuse_port)
+      setsockopt(e->listen_fd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one));
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
     addr.sin_port = htons(*port_inout);
@@ -896,6 +901,12 @@ void* rn_engine_create(const char* host, uint16_t* port_inout) {
   wev.data.u64 = UINT64_MAX;  // wake tag
   epoll_ctl(e->epfd, EPOLL_CTL_ADD, e->wake_fd, &wev);
   return e;
+}
+
+// Legacy ABI kept for env-pinned prebuilt libraries (RIO_TPU_NATIVE_LIB):
+// the Python binding probes rn_engine_create_opt and falls back here.
+void* rn_engine_create(const char* host, uint16_t* port_inout) {
+  return rn_engine_create_opt(host, port_inout, 0);
 }
 
 // Queue an outbound connect; returns the pre-assigned conn id. The IO

@@ -18,7 +18,14 @@ from .client import Client, ClientBuilder
 from .client.pool import ClientPool
 from .cluster.membership_protocol import ClusterProvider, LocalClusterProvider
 from .cluster.storage import LocalStorage, Member, MembershipStorage
-from .commands import AdminCommand, AdminSender, InternalClientSender, ServerInfo
+from .commands import (
+    AdminCommand,
+    AdminSender,
+    InternalClientSender,
+    ServerInfo,
+    ShardRouter,
+    shard_of,
+)
 from .errors import RioError, ServerBusy
 from .journal import Journal, JournalEvent
 from .load import (
@@ -53,6 +60,16 @@ from .service_object import (
 )
 
 __version__ = "0.7.2"  # tracks the surveyed reference version (pyproject.toml)
+
+
+def __getattr__(name: str):
+    # Lazy: ``python -m rio_tpu.sharded`` executes the module as __main__;
+    # an eager import here would load it twice (runpy's double-exec warning).
+    if name == "ShardedServer":
+        from .sharded import ShardedServer
+
+        return ShardedServer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
     "AppData",
@@ -96,6 +113,9 @@ __all__ = [
     "ServerBusy",
     "ServerInfo",
     "ServiceObject",
+    "ShardRouter",
+    "ShardedServer",
+    "shard_of",
     "handler",
     "make_registry",
     "message",

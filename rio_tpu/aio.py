@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 from collections import deque
 from typing import TYPE_CHECKING, Callable
 
@@ -47,6 +48,26 @@ if TYPE_CHECKING:
     from .service import Service
 
 log = logging.getLogger("rio_tpu.aio")
+
+# Batch-decode (data-plane ladder rung 1): deserialize every complete frame
+# of a data_received burst in one tight pass over the cached codec schemas,
+# instead of alternating decode / dispatch-bookkeeping per frame in the
+# worker loop. Module global (not per-instance) so the bench can A/B it
+# in-session; measured +4-6% under pipelining on the r6 capture.
+_BATCH_DECODE = os.environ.get("RIO_TPU_BATCH_DECODE", "1") != "0"
+
+
+class _BadFrame:
+    """Queued marker for a frame that failed to decode (batch-decode path).
+
+    The error response must leave in arrival order with everything else on
+    the connection, so the failure rides the same queue as decoded inbounds.
+    """
+
+    __slots__ = ("detail",)
+
+    def __init__(self, detail: str) -> None:
+        self.detail = detail
 
 
 class ServerConnProtocol(asyncio.Protocol):
@@ -86,7 +107,9 @@ class ServerConnProtocol(asyncio.Protocol):
         self._on_task = on_task
         self._service: Service | None = None
         self._frames = FrameReader()
-        self._queue: deque[bytes] = deque()  # decoded inbound frame payloads
+        # Inbound work: decoded envelopes / _BadFrame markers (batch-decode
+        # path) or raw frame payloads (RIO_TPU_BATCH_DECODE=0 fallback).
+        self._queue: deque = deque()
         self._waiter: asyncio.Future | None = None  # reader parked on _queue
         self._eof = False
         self._transport: asyncio.Transport | None = None
@@ -121,7 +144,18 @@ class ServerConnProtocol(asyncio.Protocol):
             self._transport.close()
             return
         if payloads:
-            self._queue.extend(payloads)
+            if _BATCH_DECODE:
+                # One tight decode pass per socket read: the cached dataclass
+                # schemas stay hot and the worker loop receives ready
+                # envelopes. Decode failures become in-order error markers.
+                append = self._queue.append
+                for p in payloads:
+                    try:
+                        append(decode_inbound(p))
+                    except Exception as e:  # noqa: BLE001 — malformed frame
+                        append(_BadFrame(str(e)))
+            else:
+                self._queue.extend(payloads)
             self._wake()
             # Inbound backpressure: MAX_CONCURRENT caps in-flight handlers
             # but not buffered frames — a fast pipelining client could grow
@@ -255,15 +289,15 @@ class ServerConnProtocol(asyncio.Protocol):
             self._waiter = None
             w.set_result(None)
 
-    async def _next_payload(self) -> bytes | None:
+    async def _next_inbound(self):
         while not self._queue:
             if self._eof:
                 return None
             self._waiter = asyncio.get_running_loop().create_future()
             await self._waiter
-        payload = self._queue.popleft()
+        item = self._queue.popleft()
         self._maybe_resume_reading()
-        return payload
+        return item
 
     async def _flushed(self) -> None:
         """Honor write backpressure (the StreamWriter.drain equivalent)."""
@@ -279,8 +313,8 @@ class ServerConnProtocol(asyncio.Protocol):
         cancelled = False
         try:
             while True:
-                payload = await self._next_payload()
-                if payload is None:
+                inbound = await self._next_inbound()
+                if inbound is None:
                     # Peer finished sending; keep the socket open until
                     # every in-flight response has been written (the peer
                     # may have half-closed and still be reading).
@@ -288,12 +322,19 @@ class ServerConnProtocol(asyncio.Protocol):
                         self._room = loop.create_future()
                         await self._room
                     return
-                try:
-                    inbound = decode_inbound(payload)
-                except Exception as e:  # malformed frame → error response
+                if type(inbound) is bytes:
+                    # Fallback path (batch decode off): the queue holds raw
+                    # frame payloads; decode them here as before.
+                    try:
+                        inbound = decode_inbound(inbound)
+                    except Exception as e:  # malformed frame → error response
+                        inbound = _BadFrame(str(e))
+                if type(inbound) is _BadFrame:
                     fut: asyncio.Future = loop.create_future()
                     fut.set_result(
-                        ResponseEnvelope.err(ResponseError.unknown(f"bad frame: {e}"))
+                        ResponseEnvelope.err(
+                            ResponseError.unknown(f"bad frame: {inbound.detail}")
+                        )
                     )
                     self._push_response(fut)
                     continue

@@ -10,8 +10,42 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import zlib
 from enum import Enum
 from typing import Any
+
+
+def shard_of(type_name: str, object_id: str, n_shards: int) -> int:
+    """Deterministic shard index for an object key.
+
+    crc32 over the canonical ``type/id`` key: stable across processes and
+    restarts (Python's ``hash()`` is salted per process), cheap, and uniform
+    enough at the worker counts one host runs. Every worker of a sharded
+    node computes the same slice from the same membership slots — no
+    coordination, no directory round trip.
+    """
+    return zlib.crc32(f"{type_name}/{object_id}".encode()) % n_shards
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardRouter:
+    """AppData-injectable shard map for one worker of a sharded node.
+
+    ``slots[i]`` is the identity address of the worker owning shard ``i``
+    (``shard_of(type, id, len(slots))``). The service layer consults this
+    ONLY when seating an unplaced object: a non-owner worker answers the
+    standard ``Redirect`` to the owner instead of self-assigning, so the
+    existing directory machinery routes cross-shard traffic unchanged.
+    Kept here (not in ``rio_tpu.sharded``) for the same reason as
+    :class:`DispatchObserver`: the request engine resolves it per
+    connection and must never import the supervisor module.
+    """
+
+    self_address: str
+    slots: tuple  # worker identity addresses, index == shard
+
+    def owner(self, type_name: str, object_id: str) -> str:
+        return self.slots[shard_of(type_name, object_id, len(self.slots))]
 
 
 class AdminCommandKind(Enum):

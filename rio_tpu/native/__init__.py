@@ -132,6 +132,17 @@ class NativeLib:
 
         dll.rn_engine_create.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint16)]
         dll.rn_engine_create.restype = ctypes.c_void_p
+        try:
+            # Newer ABI with the SO_REUSEPORT flag; absent from env-pinned
+            # prebuilt libraries (RIO_TPU_NATIVE_LIB), which then refuse
+            # reuse_port loudly in the transport instead of ignoring it.
+            dll.rn_engine_create_opt.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint16), ctypes.c_int32,
+            ]
+            dll.rn_engine_create_opt.restype = ctypes.c_void_p
+            self.has_engine_opt = True
+        except AttributeError:
+            self.has_engine_opt = False
         dll.rn_engine_notify_fd.argtypes = [ctypes.c_void_p]
         dll.rn_engine_notify_fd.restype = ctypes.c_int
         dll.rn_engine_port.argtypes = [ctypes.c_void_p]

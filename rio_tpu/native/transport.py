@@ -47,11 +47,25 @@ _MAX_WRITE_BACKLOG = 1 << 20  # pause subscription pumps past 1 MiB unsent
 class Engine:
     """Thin pythonic wrapper over the rn_engine_* C ABI."""
 
-    def __init__(self, lib: NativeLib, host: str, port: int) -> None:
+    def __init__(
+        self, lib: NativeLib, host: str, port: int, reuse_port: bool = False
+    ) -> None:
         self._lib = lib
         self._dll = lib._dll
         port_inout = ctypes.c_uint16(port)
-        self._handle = self._dll.rn_engine_create(host.encode(), ctypes.byref(port_inout))
+        if reuse_port:
+            if not getattr(lib, "has_engine_opt", False):
+                raise OSError(
+                    "reuse_port needs rn_engine_create_opt — rebuild native/ "
+                    "(the env-pinned library predates it)"
+                )
+            self._handle = self._dll.rn_engine_create_opt(
+                host.encode(), ctypes.byref(port_inout), 1
+            )
+        else:
+            self._handle = self._dll.rn_engine_create(
+                host.encode(), ctypes.byref(port_inout)
+            )
         if not self._handle:
             raise OSError(f"rn_engine_create failed for {host}:{port}")
         self.port = port_inout.value
@@ -318,7 +332,13 @@ class NativeServerTransport:
     :class:`rio_tpu.server.Server` (``close()`` + ``wait_closed()``).
     """
 
-    def __init__(self, service_factory: Callable[[], "Service"], host: str, port: int) -> None:
+    def __init__(
+        self,
+        service_factory: Callable[[], "Service"],
+        host: str,
+        port: int,
+        reuse_port: bool = False,
+    ) -> None:
         lib = get()
         if lib is None:
             raise RuntimeError("native library unavailable (build native/ first)")
@@ -336,7 +356,7 @@ class NativeServerTransport:
                 socket.inet_aton(host)
             except OSError:
                 host = socket.gethostbyname(host)
-        self._engine = Engine(lib, host, port)
+        self._engine = Engine(lib, host, port, reuse_port=reuse_port)
         self.port = self._engine.port
         self._conns: dict[int, _ConnState] = {}
         self._workers: set[asyncio.Task] = set()

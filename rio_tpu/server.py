@@ -93,6 +93,8 @@ class Server:
         http_members_address: str | None = None,
         transport: str = "asyncio",
         advertise_address: str | None = None,
+        reuse_port: bool = False,
+        extra_listen_socks=None,
         placement_daemon: bool = False,
         placement_daemon_config=None,
         reminder_daemon: bool = False,
@@ -120,6 +122,16 @@ class Server:
         self.app_data = app_data or AppData()
         self.http_members_address = http_members_address
         self.transport = transport
+        # SO_REUSEPORT on the main listener: a sharded worker binds its
+        # identity port against the supervisor's port reservation (and, on
+        # kernels that distribute accepts, sibling workers can share one
+        # front-door port).
+        self.reuse_port = reuse_port
+        # Pre-bound (unlistened or listening) sockets served with the SAME
+        # protocol/service as the main listener — the sharded front door.
+        # The server takes ownership: they are closed with the listener.
+        self.extra_listen_socks = list(extra_listen_socks or [])
+        self._extra_listeners: list[asyncio.Server] = []
         # Opt-in proactive churn→re-solve loop (SURVEY §7.3); a no-op for
         # placement providers without the solver surface.
         self.placement_daemon_enabled = placement_daemon
@@ -254,6 +266,12 @@ class Server:
 
             from .native.transport import NativeServerTransport
 
+            if self.extra_listen_socks:
+                raise ServerError(
+                    "extra_listen_socks (the sharded front door) requires the "
+                    "asyncio transport — the native engine owns its one "
+                    "listener"
+                )
             if host not in ("", "::", "0.0.0.0"):
                 # The engine takes dotted quads only; resolve names here,
                 # asynchronously — a blocking gethostbyname inside the
@@ -267,7 +285,7 @@ class Server:
                     )
                     host = infos[0][4][0]
             self._native_transport = NativeServerTransport(
-                self._service, host, int(port)
+                self._service, host, int(port), reuse_port=self.reuse_port
             )
             bound_host, bound_port = host, self._native_transport.port
         else:
@@ -279,9 +297,19 @@ class Server:
                 self._conn_tasks.add(task)
                 task.add_done_callback(self._conn_tasks.discard)
 
-            self._listener = await asyncio.get_running_loop().create_server(
-                lambda: ServerConnProtocol(self._service, _track), host, int(port)
+            loop = asyncio.get_running_loop()
+            factory = lambda: ServerConnProtocol(self._service, _track)  # noqa: E731
+            self._listener = await loop.create_server(
+                factory, host, int(port),
+                reuse_port=True if self.reuse_port else None,
             )
+            for esock in self.extra_listen_socks:
+                # Same service, same protocol: a connection accepted on the
+                # front door is indistinguishable from one on the identity
+                # listener (redirects carry the identity address either way).
+                self._extra_listeners.append(
+                    await loop.create_server(factory, sock=esock)
+                )
             sock = self._listener.sockets[0]
             bound_host, bound_port = sock.getsockname()[:2]
         self._local_addr = self._advertised(bound_host, bound_port)
@@ -660,11 +688,15 @@ class Server:
                 await self._native_transport.wait_closed()
             if self._listener is not None:
                 self._listener.close()
+            for extra in self._extra_listeners:
+                extra.close()
             for t in list(self._conn_tasks):
                 t.cancel()
             await asyncio.gather(*self._conn_tasks, return_exceptions=True)
             if self._listener is not None:
                 await self._listener.wait_closed()
+            for extra in self._extra_listeners:
+                await extra.wait_closed()
             if self.migration_manager is not None:
                 self.migration_manager.close()
             if self.replication_manager is not None:

@@ -23,7 +23,7 @@ import time
 
 from .app_data import AppData
 from .cluster.storage import MembershipStorage
-from .commands import DispatchObserver, ServerDraining
+from .commands import DispatchObserver, ServerDraining, ShardRouter
 from .errors import HandlerNotFound, ObjectNotFound, SerializationError, TypeNotFound
 from .journal import ADMIT_SHED, PLACE_ASSIGN, PLACE_RELEASE, Journal
 from .message_router import MessageRouter
@@ -98,6 +98,10 @@ class Service:
         # Recorded on TRANSITIONS only — assign/release/shed — never on the
         # per-request fast path.
         self._journal = app_data.try_get(Journal)
+        # Shard map of a multi-process sharded node (None on plain servers):
+        # consulted only when seating an UNPLACED object — see the seam in
+        # get_or_create_placement.
+        self._shard = app_data.try_get(ShardRouter)
 
     # ------------------------------------------------------------------
     # Placement (reference service.rs:193-298)
@@ -221,6 +225,23 @@ class Service:
             # node held). Adopt a live standby — it holds the shipped
             # replica — instead of self-assigning a fresh instance.
             addr = await self._replication.maybe_promote(object_id)
+        if (
+            addr is None
+            and self._shard is not None
+            and not self.registry.is_node_scoped(object_id.type_name)
+        ):
+            # Sharded worker seating an unplaced object: only the preferred
+            # owner (crc32 slice over the sibling slots) self-assigns; every
+            # other worker answers the standard Redirect WITHOUT writing a
+            # directory row — the owner writes its own row when the
+            # redirected request arrives, so rows are only ever written by
+            # the worker that owns them (no cross-worker write races). A
+            # dead preferred owner falls through to the lazy local
+            # self-assign below: deterministic slicing degrades, seating
+            # never hinges on the hash map.
+            owner = self._shard.owner(object_id.type_name, object_id.id)
+            if owner != self.address and await self.members_storage.is_active(owner):
+                return owner
         if addr is None:
             addr = self.address
             await self.object_placement.update(

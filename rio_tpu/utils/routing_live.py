@@ -45,6 +45,12 @@ class EchoActor(ServiceObject):
         return msg
 
 
+def build_echo_registry() -> Registry:
+    """Factory spec target for sharded workers / bench children
+    (``rio_tpu.utils.routing_live:build_echo_registry``)."""
+    return Registry().add_type(EchoActor)
+
+
 @dataclass
 class LiveHopStats:
     mean: float
@@ -95,7 +101,7 @@ async def boot_echo_cluster(
         for _ in range(n_servers):
             s = Server(
                 address="127.0.0.1:0",
-                registry=Registry().add_type(EchoActor),
+                registry=build_echo_registry(),
                 cluster_provider=LocalClusterProvider(members),
                 object_placement_provider=placement,
                 transport=transport,
@@ -329,30 +335,58 @@ async def measure_rpc_throughput(
     ``transport`` selects the asyncio or the native (C++ epoll) data plane
     on both servers and client.
     """
-    import time
-
     members, _placement, tasks, _servers = await boot_echo_cluster(
         n_servers, transport=transport
     )
     client = Client(members, transport=transport)
     try:
-        # Warm: allocate the whole actor population (placement + activation
-        # out of the timed region) and fill the connection pools.
-        for i in range(n_objects):
-            await client.send(EchoActor, f"w{i}", Echo(value=i), returns=Echo)
-
-        total = n_workers * requests_per_worker
-
-        async def worker(w: int) -> None:
-            for r in range(requests_per_worker):
-                oid = f"w{(w * requests_per_worker + r) % n_objects}"
-                await client.send(EchoActor, oid, Echo(value=r), returns=Echo)
-
-        t0 = time.perf_counter()
-        await asyncio.gather(*[worker(w) for w in range(n_workers)])
-        return total / (time.perf_counter() - t0)
+        return await _drive_echo_load(
+            client, n_workers, requests_per_worker, n_objects
+        )
     finally:
         client.close()
         for t in tasks:
             t.cancel()
         await asyncio.gather(*tasks, return_exceptions=True)
+
+
+async def _drive_echo_load(
+    client, n_workers: int, requests_per_worker: int, n_objects: int
+) -> float:
+    """Warm the echo population, then one timed concurrent window."""
+    import time
+
+    for i in range(n_objects):
+        await client.send(EchoActor, f"w{i}", Echo(value=i), returns=Echo)
+    total = n_workers * requests_per_worker
+
+    async def worker(w: int) -> None:
+        for r in range(requests_per_worker):
+            oid = f"w{(w * requests_per_worker + r) % n_objects}"
+            await client.send(EchoActor, oid, Echo(value=r), returns=Echo)
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*[worker(w) for w in range(n_workers)])
+    return total / (time.perf_counter() - t0)
+
+
+async def measure_rpc_external(
+    members,
+    *,
+    n_workers: int = 64,
+    requests_per_worker: int = 400,
+    n_objects: int = 512,
+    transport: str = "asyncio",
+) -> float:
+    """Messages/sec against an EXTERNAL cluster (servers in other
+    processes, e.g. a :class:`rio_tpu.sharded.ShardedServer`): same load
+    shape as :func:`measure_rpc_throughput`, but this process runs only
+    the client side. ``members`` is the shared membership view (e.g. the
+    sharded node's sqlite storage)."""
+    client = Client(members, transport=transport)
+    try:
+        return await _drive_echo_load(
+            client, n_workers, requests_per_worker, n_objects
+        )
+    finally:
+        client.close()

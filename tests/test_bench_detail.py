@@ -323,6 +323,42 @@ def test_tpu_banked_block_contract(tmp_path):
     assert _tpu_banked_block(here=str(tmp_path)) is None
 
 
+def test_host_provenance_contract():
+    """Every rpc_* stage stamps the host conditions it ran under; the
+    sharded A/Bs are unreadable without cpu_count (1 core vs 4 inverts
+    every conclusion)."""
+    from bench import _host_provenance
+
+    prov = _host_provenance()
+    assert set(prov) == {"cpu_count", "sched_affinity", "loadavg"}
+    assert isinstance(prov["cpu_count"], int) and prov["cpu_count"] >= 1
+    if prov["sched_affinity"] is not None:
+        assert prov["sched_affinity"] == sorted(prov["sched_affinity"])
+        assert len(prov["sched_affinity"]) >= 1
+    if prov["loadavg"] is not None:
+        assert len(prov["loadavg"]) == 3
+
+
+def test_rpc_sharded_banks_to_cpu_sidecar_and_never_carries(tmp_path):
+    """rpc_sharded is a host stage: banked with its in-session baseline
+    and host provenance, but never carried into a later tpu bank (its
+    numbers are meaningless beside another session's baseline)."""
+    stage = {
+        "sqlite_baseline_in_session": 40000,
+        "host": {"cpu_count": 1, "sched_affinity": [0], "loadavg": [0, 0, 0]},
+        "one_worker": {"sharded_vs_plain": 0.97},
+    }
+    _write_detail(
+        {"solve_tier": {"platform": "cpu"}, "rpc_sharded": stage},
+        here=str(tmp_path),
+    )
+    banked = _read(tmp_path, "BENCH_DETAIL.cpu.json")
+    assert banked["rpc_sharded"] == stage
+    # A later tpu run must not inherit it.
+    _write_detail({"solve_tier": {"platform": "tpu"}}, here=str(tmp_path))
+    assert "rpc_sharded" not in _read(tmp_path, "BENCH_DETAIL.tpu.json")
+
+
 def test_committed_tpu_capture_carries_relay_health():
     """The repo's banked r5 capture is annotated: captured while the relay
     was degrading, with every sync-contaminated field enumerated."""

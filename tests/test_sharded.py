@@ -1,0 +1,511 @@
+"""Sharded data plane: crc32 slicing, the router seam, real multi-process runs.
+
+The subprocess tests boot a real :class:`rio_tpu.sharded.ShardedServer`
+(worker OS processes, SO_REUSEPORT front door where available, shared
+sqlite membership/placement) and drive it with a normal client — the
+point is that the EXISTING directory machinery routes cross-shard
+traffic: redirects converge, migration overrides the hash map, a killed
+worker's slice reseats on the survivors, and the wrong-worker answer is
+the stock Redirect, byte-identical to a plain cluster's.
+"""
+
+import asyncio
+import contextlib
+import socket
+import sys
+import zlib
+
+import pytest
+
+from rio_tpu import (
+    Client,
+    LocalClusterProvider,
+    LocalObjectPlacement,
+    LocalStorage,
+    Member,
+    ObjectId,
+    Server,
+    ShardRouter,
+    shard_of,
+)
+from rio_tpu import codec
+from rio_tpu.admin import ADMIN_TYPE, DumpEvents, EventsSnapshot
+from rio_tpu.journal import merge_events
+from rio_tpu.migration import CONTROL_TYPE, MigrateObject, MigrationAck
+from rio_tpu.protocol import (
+    ErrorKind,
+    RequestEnvelope,
+    ResponseEnvelope,
+    ResponseError,
+    encode_request_frame,
+    encode_response_frame,
+)
+from rio_tpu.registry import type_id
+from rio_tpu.sharded import ShardedServer, sqlite_members, sqlite_placement
+from rio_tpu.utils.routing_live import Echo, EchoActor, build_echo_registry
+
+from .sharded_actors import Bump, Get, ShardCounter, Val
+
+HAS_REUSEPORT = hasattr(socket, "SO_REUSEPORT")
+COUNTER_REGISTRY = "tests.sharded_actors:build_registry"
+
+
+# ----------------------------------------------------------------------
+# Unit: the shard map
+# ----------------------------------------------------------------------
+
+
+def test_shard_of_is_pinned_and_stable():
+    # Pinned values: the map is persisted implicitly in every directory row
+    # a sharded node writes, so it must never drift across releases.
+    assert shard_of("EchoActor", "a", 3) == 1
+    assert shard_of("EchoActor", "b", 3) == 2
+    assert shard_of("ShardCounter", "c-0", 3) == 0
+    assert shard_of("T", "x", 7) == zlib.crc32(b"T/x") % 7
+    # Deterministic, in range, and non-degenerate across a small population.
+    for oid in ("a", "b", "zzz"):
+        assert shard_of("EchoActor", oid, 4) == shard_of("EchoActor", oid, 4)
+        assert 0 <= shard_of("EchoActor", oid, 4) < 4
+    assert {shard_of("EchoActor", f"o{i}", 4) for i in range(64)} == {0, 1, 2, 3}
+
+
+def test_shard_router_owner_follows_the_map():
+    slots = ("h:1", "h:2", "h:3")
+    router = ShardRouter(self_address="h:1", slots=slots)
+    for oid in ("a", "b", "c", "zzz"):
+        assert router.owner("EchoActor", oid) == slots[shard_of("EchoActor", oid, 3)]
+
+
+# ----------------------------------------------------------------------
+# In-process: the service-layer seam
+# ----------------------------------------------------------------------
+
+
+async def _boot_router_servers(addrs, slots, members, placement):
+    """Boot one echo server per address with a ShardRouter installed."""
+    servers, tasks = [], []
+    try:
+        for addr in addrs:
+            s = Server(
+                address=addr,
+                registry=build_echo_registry(),
+                cluster_provider=LocalClusterProvider(members),
+                object_placement_provider=placement,
+            )
+            # Before bind(): the Service snapshot of app_data happens there.
+            s.app_data.set(ShardRouter(self_address=addr, slots=tuple(slots)))
+            await s.prepare()
+            await s.bind()
+            servers.append(s)
+        tasks = [asyncio.create_task(s.run()) for s in servers]
+        deadline = asyncio.get_event_loop().time() + 10.0
+        while asyncio.get_event_loop().time() < deadline:
+            if len(await members.active_members()) >= len(addrs):
+                break
+            await asyncio.sleep(0.02)
+    except BaseException:
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        raise
+    return tasks
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def test_router_seam_seats_unplaced_objects_on_their_shard():
+    async def drive():
+        addrs = [f"127.0.0.1:{p}" for p in _free_ports(2)]
+        members, placement = LocalStorage(), LocalObjectPlacement()
+        tasks = await _boot_router_servers(addrs, addrs, members, placement)
+        client = Client(members)
+        try:
+            tname = type_id(EchoActor)
+            for i in range(24):
+                out = await client.send(EchoActor, f"rt-{i}", Echo(value=i), returns=Echo)
+                assert out.value == i
+            for i in range(24):
+                row = await placement.lookup(ObjectId(tname, f"rt-{i}"))
+                assert row == addrs[shard_of(tname, f"rt-{i}", 2)], (i, row)
+        finally:
+            client.close()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    asyncio.run(drive())
+
+
+def test_router_seam_degrades_when_preferred_owner_is_dead():
+    """A slot that is not an active member must NOT black-hole its slice:
+    the receiving worker falls through to lazy self-assign."""
+
+    async def drive():
+        addrs = [f"127.0.0.1:{p}" for p in _free_ports(1)]
+        slots = (addrs[0], "127.0.0.1:1")  # slot 1 is nobody
+        members, placement = LocalStorage(), LocalObjectPlacement()
+        tasks = await _boot_router_servers(addrs, slots, members, placement)
+        client = Client(members)
+        try:
+            tname = type_id(EchoActor)
+            dead_oid = next(
+                f"d-{i}" for i in range(100) if shard_of(tname, f"d-{i}", 2) == 1
+            )
+            out = await client.send(EchoActor, dead_oid, Echo(value=9), returns=Echo)
+            assert out.value == 9
+            assert await placement.lookup(ObjectId(tname, dead_oid)) == addrs[0]
+        finally:
+            client.close()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    asyncio.run(drive())
+
+
+# ----------------------------------------------------------------------
+# In-process: inbound decode paths (batch and non-batch)
+# ----------------------------------------------------------------------
+
+
+async def _raw_roundtrip(host, port, frame_bytes):
+    """One framed request over a bare socket; returns the full reply frame."""
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        writer.write(frame_bytes)
+        await writer.drain()
+        header = await reader.readexactly(4)
+        n = int.from_bytes(header, "big")
+        return header + await reader.readexactly(n)
+    finally:
+        writer.close()
+        with contextlib.suppress(Exception):
+            await writer.wait_closed()
+
+
+@pytest.mark.parametrize("batch", [True, False])
+def test_inbound_decode_bad_frame_keeps_order_and_connection(batch):
+    """Garbage frame → in-order UNKNOWN error response; the connection and
+    the requests behind it keep working — on BOTH decode paths (the
+    batch-decode fast path and the legacy per-frame fallback)."""
+    from rio_tpu import aio
+
+    async def drive():
+        addrs = [f"127.0.0.1:{p}" for p in _free_ports(1)]
+        members, placement = LocalStorage(), LocalObjectPlacement()
+        tasks = await _boot_router_servers(addrs, addrs, members, placement)
+        try:
+            host, _, port = addrs[0].rpartition(":")
+            reader, writer = await asyncio.open_connection(host, int(port))
+            try:
+                good = encode_request_frame(
+                    RequestEnvelope(
+                        type_id(EchoActor), "bf-1", type_id(Echo),
+                        codec.serialize(Echo(value=3)),
+                    )
+                )
+                # Bad frame first, good frame right behind it — one write.
+                writer.write(codec.frame(b"\x07junk") + good)
+                await writer.drain()
+                frames = []
+                for _ in range(2):
+                    header = await asyncio.wait_for(reader.readexactly(4), 10)
+                    n = int.from_bytes(header, "big")
+                    frames.append(await asyncio.wait_for(reader.readexactly(n), 10))
+                bad = ResponseEnvelope.from_bytes(frames[0])
+                assert bad.error is not None
+                assert bad.error.kind == ErrorKind.UNKNOWN
+                assert bad.error.detail.startswith("bad frame:")
+                ok = ResponseEnvelope.from_bytes(frames[1])
+                assert ok.is_ok
+                assert codec.deserialize(ok.body, Echo).value == 3
+            finally:
+                writer.close()
+                with contextlib.suppress(Exception):
+                    await writer.wait_closed()
+        finally:
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    old = aio._BATCH_DECODE
+    aio._BATCH_DECODE = batch
+    try:
+        asyncio.run(drive())
+    finally:
+        aio._BATCH_DECODE = old
+
+
+# ----------------------------------------------------------------------
+# Real multi-process runs
+# ----------------------------------------------------------------------
+
+
+def _drive_sharded(node, coro_factory):
+    """start → drive → stop, dumping worker logs on any failure."""
+    node.start()
+    try:
+        return asyncio.run(coro_factory())
+    except BaseException:
+        for i in range(node.workers):
+            sys.stderr.write(f"--- worker{i}.log ---\n{node.worker_log(i)}\n")
+        raise
+    finally:
+        node.stop()
+
+
+def test_sharded_routing_goldenwire_migration_journal_serialized(tmp_path):
+    """The full 3-worker contract in one boot: directory rows land exactly
+    on the crc32 slice and the client converges; the wrong worker's answer
+    is the stock Redirect, byte-for-byte; per-object execution stays
+    serialized under cross-shard concurrent load; MigrationManager moves an
+    object OFF its hash shard (volatile state intact) and the router honors
+    the seated row; per-worker journals merge into one causal stream."""
+    node = ShardedServer(
+        address="127.0.0.1:0",
+        workers=3,
+        registry=COUNTER_REGISTRY,
+        data_dir=str(tmp_path),
+    )
+
+    async def drive():
+        await node.wait_ready(60.0)
+        members = sqlite_members(node.data_dir)
+        placement = sqlite_placement(node.data_dir)
+        client = Client(members)
+        try:
+            tname = type_id(ShardCounter)
+            ids = [f"c-{i}" for i in range(18)]
+            for i, oid in enumerate(ids):
+                out = await client.send(ShardCounter, oid, Bump(amount=i), returns=Val)
+                assert out.value == i and out.address in node.worker_addresses
+
+            # Every directory row is exactly the crc32 slice's worker.
+            for oid in ids:
+                row = await placement.lookup(ObjectId(tname, oid))
+                assert row == node.worker_addresses[shard_of(tname, oid, 3)], oid
+
+            # Converged: a second pass over a warm placement cache costs
+            # zero extra redirects.
+            before = client.stats.redirects
+            for oid in ids:
+                await client.send(ShardCounter, oid, Get(), returns=Val)
+            assert client.stats.redirects == before
+
+            # Golden wire: a request for a seated object sent to the WRONG
+            # worker answers the standard Redirect to the owner's identity
+            # address — byte-identical to a plain multi-server cluster's.
+            owner = await placement.lookup(ObjectId(tname, "c-0"))
+            wrong = next(a for a in node.worker_addresses if a != owner)
+            req = encode_request_frame(
+                RequestEnvelope(tname, "c-0", type_id(Get), codec.serialize(Get()))
+            )
+            expected = encode_response_frame(
+                ResponseEnvelope.err(ResponseError.redirect(owner))
+            )
+            whost, _, wport = wrong.rpartition(":")
+            assert await _raw_roundtrip(whost, int(wport), req) == expected
+
+            # Per-object serialized execution across shards: 5 concurrent
+            # hammers per object, each racing the bump's interleave window.
+            hot = [f"hot-{i}" for i in range(8)]
+
+            async def hammer(oid):
+                for _ in range(5):
+                    await client.send(ShardCounter, oid, Bump(amount=1), returns=Val)
+
+            await asyncio.gather(*[hammer(o) for o in hot for _ in range(5)])
+            for oid in hot:
+                out = await client.send(ShardCounter, oid, Get(), returns=Val)
+                assert (out.value, out.overlapped) == (25, 0), (oid, out)
+
+            # Migration between shards: the move overrides the hash map.
+            src = await placement.lookup(ObjectId(tname, "c-7"))
+            dst = next(a for a in node.worker_addresses if a != src)
+            ack = await client.send(
+                CONTROL_TYPE,
+                src,
+                MigrateObject(type_name=tname, object_id="c-7", target=dst),
+                returns=MigrationAck,
+            )
+            assert ack.ok, ack.detail
+            assert await placement.lookup(ObjectId(tname, "c-7")) == dst
+            out = await client.send(ShardCounter, "c-7", Get(), returns=Val)
+            assert (out.address, out.value) == (dst, 7)  # volatile state carried
+            out = await client.send(ShardCounter, "c-7", Bump(amount=1), returns=Val)
+            assert (out.address, out.value) == (dst, 8)  # router defers to the row
+
+            # Journals merge causally across worker processes.
+            snaps = [
+                await client.send(ADMIN_TYPE, a, DumpEvents(), returns=EventsSnapshot)
+                for a in node.worker_addresses
+            ]
+            merged = merge_events(s.events() for s in snaps)
+            assert len({e.node for e in merged}) >= 2
+            assert any(e.key.startswith(tname + "/") for e in merged)
+        finally:
+            client.close()
+            members.close()
+            placement.close()
+
+    _drive_sharded(node, drive)
+
+
+def test_sharded_worker_death_reseats_slice_on_survivor(tmp_path):
+    node = ShardedServer(
+        address="127.0.0.1:0",
+        workers=2,
+        registry=COUNTER_REGISTRY,
+        data_dir=str(tmp_path),
+    )
+
+    async def drive():
+        await node.wait_ready(60.0)
+        members = sqlite_members(node.data_dir)
+        placement = sqlite_placement(node.data_dir)
+        client = Client(members)
+        try:
+            tname = type_id(ShardCounter)
+            out = await client.send(ShardCounter, "victim", Bump(amount=5), returns=Val)
+            assert out.value == 5
+            seat = await placement.lookup(ObjectId(tname, "victim"))
+            node.terminate_worker(node.worker_addresses.index(seat))
+
+            # The supervisor's monitor thread records the death.
+            loop = asyncio.get_event_loop()
+            deadline = loop.time() + 30.0
+            while loop.time() < deadline:
+                if not await members.is_active(seat):
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise TimeoutError("dead worker never marked inactive")
+
+            # Next touch: stale row cleaned, reseated on the survivor —
+            # a crash kill loses volatile state (fresh activation).
+            survivor = next(a for a in node.worker_addresses if a != seat)
+            out = await client.send(ShardCounter, "victim", Get(), returns=Val)
+            assert (out.address, out.value) == (survivor, 0)
+            assert await placement.lookup(ObjectId(tname, "victim")) == survivor
+            out = await client.send(ShardCounter, "victim", Bump(amount=2), returns=Val)
+            assert (out.address, out.value) == (survivor, 2)
+        finally:
+            client.close()
+            members.close()
+            placement.close()
+
+    _drive_sharded(node, drive)
+
+
+@pytest.mark.skipif(not HAS_REUSEPORT, reason="needs SO_REUSEPORT")
+def test_sharded_front_door_entry_and_graceful_drain(tmp_path):
+    """A client that knows ONLY the shared front-door address still reaches
+    every shard (redirects carry identity addresses); SIGTERM drains each
+    worker cleanly — exit 0, rows released, membership inactive."""
+    node = ShardedServer(
+        address="127.0.0.1:0",
+        workers=2,
+        registry="rio_tpu.utils.routing_live:build_echo_registry",
+        data_dir=str(tmp_path),
+    )
+    tname = type_id(EchoActor)
+
+    async def drive():
+        await node.wait_ready(60.0)
+        front = LocalStorage()
+        fhost, _, fport = node.front_address.rpartition(":")
+        await front.push(Member(ip=fhost, port=int(fport), active=True))
+        client = Client(front)
+        placement = sqlite_placement(node.data_dir)
+        try:
+            for i in range(12):
+                out = await client.send(EchoActor, f"fd-{i}", Echo(value=i), returns=Echo)
+                assert out.value == i
+            for i in range(12):
+                row = await placement.lookup(ObjectId(tname, f"fd-{i}"))
+                assert row == node.worker_addresses[shard_of(tname, f"fd-{i}", 2)]
+        finally:
+            client.close()
+            placement.close()
+
+    async def after_stop():
+        members = sqlite_members(node.data_dir)
+        placement = sqlite_placement(node.data_dir)
+        try:
+            for a in node.worker_addresses:
+                assert not await members.is_active(a)
+            for i in range(12):
+                assert await placement.lookup(ObjectId(tname, f"fd-{i}")) is None
+        finally:
+            members.close()
+            placement.close()
+
+    node.start()
+    try:
+        asyncio.run(drive())
+        codes = node.stop(graceful=True)
+        assert codes == [0, 0], codes
+        asyncio.run(after_stop())
+    except BaseException:
+        for i in range(node.workers):
+            sys.stderr.write(f"--- worker{i}.log ---\n{node.worker_log(i)}\n")
+        raise
+    finally:
+        node.stop()
+
+
+@pytest.mark.slow
+def test_sharded_chaos_kill_under_load(tmp_path):
+    """SIGKILL one worker while concurrent cross-shard load is in flight:
+    every request eventually lands (client retry + reseat), serialization
+    holds on the survivors, and no object stays seated on the corpse."""
+    node = ShardedServer(
+        address="127.0.0.1:0",
+        workers=3,
+        registry=COUNTER_REGISTRY,
+        data_dir=str(tmp_path),
+    )
+
+    async def drive():
+        await node.wait_ready(60.0)
+        members = sqlite_members(node.data_dir)
+        placement = sqlite_placement(node.data_dir)
+        client = Client(members)
+        try:
+            tname = type_id(ShardCounter)
+            ids = [f"x-{i}" for i in range(16)]
+            for oid in ids:
+                await client.send(ShardCounter, oid, Bump(amount=1), returns=Val)
+
+            async def hammer(oid):
+                for _ in range(30):
+                    await client.send(ShardCounter, oid, Bump(amount=1), returns=Val)
+
+            load = [asyncio.create_task(hammer(o)) for o in ids]
+            await asyncio.sleep(0.2)
+            node.terminate_worker(0)
+            await asyncio.gather(*load)
+
+            dead = node.worker_addresses[0]
+            survivors = set(node.worker_addresses) - {dead}
+            for oid in ids:
+                out = await client.send(ShardCounter, oid, Get(), returns=Val)
+                assert out.address in survivors
+                assert out.overlapped == 0, (oid, out)
+                assert await placement.lookup(ObjectId(tname, oid)) != dead
+        finally:
+            client.close()
+            members.close()
+            placement.close()
+
+    _drive_sharded(node, drive)
