@@ -503,7 +503,7 @@ def _collapsed_rate(
         """The committed rebalance decision, exactly as the provider runs it."""
         base_cost = build_cost_matrix(jnp.zeros((m,), jnp.float32), cap, alive)[0]
         counts = jnp.bincount(cur, length=m)
-        quotas, g = class_quotas(
+        quotas, g, _cls_err = class_quotas(
             base_cost, counts, cap * alive,
             move_cost=move_cost, eps=class_eps, n_iters=n_iters,
         )
@@ -754,7 +754,7 @@ def _incremental_rate(
         # 2. churn re-solve of the seated population (collapsed pipeline).
         base_cost = build_cost_matrix(jnp.zeros((m,), jnp.float32), cap, alive)[0]
         counts = jnp.bincount(cur, length=m)
-        quotas, _ = class_quotas(
+        quotas, _, _ = class_quotas(
             base_cost, counts, cap * alive,
             move_cost=move_cost, eps=class_eps, n_iters=n_iters,
         )
@@ -1834,6 +1834,31 @@ def journal_overhead() -> dict:
     return out
 
 
+def series_overhead() -> dict:
+    """RPC-loop cost of gauge time-series sampling + HealthWatch, A/B'd in
+    the SAME session: servers with timeseries=False vs sampling at an
+    aggressive 0.05 s cadence (20x the shipping 1 s default). The ISSUE 11
+    acceptance bar is ≤ ~1% steady-state; median paired ratio is the
+    stable artifact, stamped with host provenance like every host stage."""
+    import asyncio
+
+    from rio_tpu.utils.series_live import measure_series_overhead
+
+    out = asyncio.run(measure_series_overhead())
+    out["host"] = _host_provenance()
+    m = out["msgs_per_sec"]
+    print(
+        f"# series overhead ({out['batches']} interleaved batches x "
+        f"{out['n_requests_per_batch']} reqs, 2 servers/mode, sampling @"
+        f"{out['sample_interval_s']}s, median paired ratio): off "
+        f"{m['off']:,.0f}/s, on {m['on']:,.0f}/s "
+        f"({out['series_overhead_pct']:+}%, {out['samples_on']} samples, "
+        f"{out['health_alerts_fired_on']} alerts fired)",
+        file=sys.stderr,
+    )
+    return out
+
+
 _TPU_PLATFORMS = os.environ.get("JAX_PLATFORMS")  # as the driver launched us
 
 
@@ -2195,6 +2220,10 @@ def main() -> None:
     except Exception as e:
         print(f"# journal overhead failed: {e!r}", file=sys.stderr)
     try:
+        detail["series"] = series_overhead()
+    except Exception as e:
+        print(f"# series overhead failed: {e!r}", file=sys.stderr)
+    try:
         detail["scaled_routing"] = scaled_route_hops()
     except Exception as e:
         print(f"# scaled routing failed: {e!r}", file=sys.stderr)
@@ -2349,6 +2378,9 @@ if __name__ == "__main__":
     # Rehearse the control-plane journal overhead A/B alone (same CPU-safe
     # in-process-cluster shape as --migration).
     parser.add_argument("--journal", action="store_true")
+    # Run the gauge time-series sampling A/B alone and bank it into the
+    # cpu sidecar (same CPU-safe in-process-cluster shape as --migration).
+    parser.add_argument("--series", action="store_true")
     # Run the sharded data-plane A/B battery alone and bank it into the
     # cpu sidecar (real worker processes on loopback; CPU-safe).
     parser.add_argument("--sharded", action="store_true")
@@ -2365,6 +2397,23 @@ if __name__ == "__main__":
     elif args.journal:
         _pin_orchestrator_to_cpu()
         print(json.dumps(journal_overhead()))
+    elif args.series:
+        # Standalone --series updates the banked cpu sidecar in place (the
+        # --sharded pattern): the A/B carries its own paired baseline, so
+        # it can refresh independently of the other host stages.
+        _pin_orchestrator_to_cpu()
+        out = series_overhead()
+        here = os.path.dirname(os.path.abspath(__file__))
+        try:
+            with open(os.path.join(here, "BENCH_DETAIL.cpu.json")) as fh:
+                detail = json.load(fh)
+            if not isinstance(detail, dict):
+                detail = {}
+        except (OSError, ValueError):
+            detail = {}
+        detail["series"] = out
+        _write_detail(detail, here)
+        print(json.dumps(out))
     elif args.sharded:
         # Standalone --sharded updates the banked cpu sidecar in place:
         # the stage carries its own in-session sqlite baseline, so it can

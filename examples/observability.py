@@ -44,6 +44,12 @@ the demo drives one real migration, then scrapes every node's
 ``explain`` for the migrated actor — "why is w0 on node 2" answered from
 the cluster's own flight recorder.
 
+The fourth plane is the gauge time-series ring (``rio_tpu/timeseries.py``)
+plus the HealthWatch trend alarms (``rio_tpu/health.py``): servers here
+sample at an aggressive cadence so the final ``DumpSeries`` scrape has a
+real window, and the demo prints the same per-node trend table the
+operator CLI renders live (``python -m rio_tpu.admin watch --demo``).
+
 Run::
 
     python examples/observability.py
@@ -256,6 +262,34 @@ class SpanAggregator:
             walk(root, 0)
 
 
+async def series_scrape(client: "Client", members) -> dict:
+    """Scrape every node's gauge time-series ring and render the trend view.
+
+    One ``DumpSeries`` round trip per live node (``scrape_series`` skips
+    nodes predating the ring), then the same pure ``_watch_rows`` →
+    ``_format_watch`` pipeline the ``watch`` CLI loops on: per-node
+    request rate / worst-handler p99 / inflight / sheds, each with a
+    trend arrow over the scraped window, plus the node's solver mode and
+    any active HealthWatch alerts from the snapshot meta.
+    """
+    from rio_tpu.admin import _format_watch, _watch_rows, scrape_series
+    from rio_tpu.timeseries import merge_series
+
+    snapshots = await scrape_series(client, members, limit=64)
+    merged = merge_series(s.samples() for s in snapshots)
+    print(
+        f"\n[series] {len(snapshots)} nodes, {len(merged)} samples in the "
+        "merged window; live trend view (admin `watch` renders this):"
+    )
+    print(_format_watch(_watch_rows(snapshots)))
+    alerts = sum(len(s.meta.get("alerts", ())) for s in snapshots)
+    return {
+        "series_nodes": len(snapshots),
+        "series_samples": len(merged),
+        "series_alerts": alerts,
+    }
+
+
 async def journal_scrape(client: "Client", members, subject: tuple) -> dict:
     """Scrape every node's control-plane journal and explain one actor.
 
@@ -298,6 +332,10 @@ async def main(n_requests: int = 50) -> dict:
             registry=Registry().add_type(Worker),
             cluster_provider=LocalClusterProvider(members),
             object_placement_provider=placement,
+            # Demo-speed sampling so the one-shot DumpSeries scrape at the
+            # end sees a real trend window (shipping default is 1 s).
+            load_interval=0.05,
+            timeseries_interval=0.05,
         )
         await s.prepare()
         print(f"[server] traced node on {await s.bind()}")
@@ -341,6 +379,10 @@ async def main(n_requests: int = 50) -> dict:
     # Flight-recorder scrape: DUMP_EVENTS every node, merge, and explain
     # the actor the demo just migrated.
     journal_summary = await journal_scrape(client, members, (tname, "w0"))
+
+    # Trend scrape: DUMP_SERIES every node and render the per-node trend
+    # table the `watch` CLI shows live.
+    series_summary = await series_scrape(client, members)
     client.close()
 
     if otlp_mode == "in-memory":
@@ -369,6 +411,7 @@ async def main(n_requests: int = 50) -> dict:
         "snapshots": len(exporter.exported) if otlp_mode == "in-memory" else 0,
         "spans": sum(len(d) for d in aggregator.durations.values()),
         **journal_summary,
+        **series_summary,
     }
 
 

@@ -102,6 +102,44 @@ class EventsSnapshot:
         return [JournalEvent.from_row(r) for r in self.rows]
 
 
+@message(name="rio.DumpSeries")
+@dataclass
+class DumpSeries:
+    """Ask a node for a window of its gauge time-series ring.
+
+    ``names`` projects each sample down to the named gauges (a trailing
+    ``.`` makes a name a prefix filter, e.g. ``rio.handler.``); empty
+    means every gauge. ``since_seq`` resumes a tail (only samples with
+    ``seq > since_seq`` return); ``limit`` bounds the response to the
+    NEWEST samples (0 = ring capacity).
+    """
+
+    names: list = field(default_factory=list)
+    since_seq: int = 0
+    limit: int = 240
+
+
+@message(name="rio.SeriesSnapshot")
+@dataclass
+class SeriesSnapshot:
+    """One node's gauge time-series window (merge with ``merge_series``)."""
+
+    address: str = ""
+    node_seq: int = 0  # the node's latest sample seq (tail resume point)
+    dropped: int = 0  # ring-overwrite counter at scrape time
+    # SeriesSample wire rows: [seq, wall_ts, mono_ts, node, gauges] —
+    # decode with SeriesSample.from_row.
+    rows: list = field(default_factory=list)
+    # Node-side context that isn't a time series: solver mode, active
+    # health alerts. String-keyed, append-only growth.
+    meta: dict = field(default_factory=dict)
+
+    def samples(self) -> list:
+        from .timeseries import SeriesSample
+
+        return [SeriesSample.from_row(r) for r in self.rows]
+
+
 @message(name="rio.AdminRequest")
 @dataclass
 class AdminRequest:
@@ -132,6 +170,19 @@ class StatsSource:
 
     gauges: Callable[[], dict[str, float]]
     histogram_rows: Callable[[], list[Any]]
+
+
+@dataclass
+class SeriesSource:
+    """AppData-injectable time-series ring handle (wired at ``Server.bind()``).
+
+    ``series`` is the node's :class:`~rio_tpu.timeseries.GaugeSeries`;
+    ``meta`` returns scrape-time context that isn't a series (solver mode,
+    active health alerts) for :class:`SeriesSnapshot.meta`.
+    """
+
+    series: Any  # rio_tpu.timeseries.GaugeSeries
+    meta: Callable[[], dict] = dict
 
 
 @type_name(ADMIN_TYPE)
@@ -175,6 +226,29 @@ class AdminControl(ServiceObject):
             node_seq=journal.recorded,
             dropped=journal.dropped,
             rows=[e.to_row() for e in events],
+        )
+
+    @handler
+    async def dump_series(self, msg: DumpSeries, ctx: AppData) -> SeriesSnapshot:
+        from .commands import ServerInfo
+
+        info = ctx.try_get(ServerInfo)
+        address = info.address if info else ""
+        source = ctx.try_get(SeriesSource)
+        if source is None or source.series is None:
+            return SeriesSnapshot(address=address)
+        series = source.series
+        samples = series.window(
+            names=msg.names or None,
+            since_seq=msg.since_seq,
+            limit=msg.limit if msg.limit > 0 else None,
+        )
+        return SeriesSnapshot(
+            address=address,
+            node_seq=series.sampled,
+            dropped=series.dropped,
+            rows=[s.to_row() for s in samples],
+            meta=dict(source.meta() or {}),
         )
 
     @handler
@@ -229,6 +303,31 @@ async def scrape_events(
     return snapshots
 
 
+async def scrape_series(
+    client: Any,
+    nodes: Any,
+    *,
+    names: Iterable[str] | None = None,
+    since_seq: int = 0,
+    limit: int = 240,
+) -> list[SeriesSnapshot]:
+    """One :class:`DumpSeries` round trip per live node; dead nodes skipped.
+
+    Nodes predating the series ring answer the admin envelope with an
+    error (unknown message) — they are skipped like unreachable nodes, so
+    a mixed-version cluster still yields the survivors' windows.
+    """
+    msg = DumpSeries(names=list(names or []), since_seq=since_seq, limit=limit)
+    snapshots: list[SeriesSnapshot] = []
+    for address in await _node_addresses(nodes):
+        try:
+            snap = await client.send(ADMIN_TYPE, address, msg, returns=SeriesSnapshot)
+        except Exception:
+            continue
+        snapshots.append(snap)
+    return snapshots
+
+
 async def cluster_events(
     client: Any,
     nodes: Any,
@@ -266,7 +365,80 @@ async def explain(
     )
 
 
-# -- operator CLI: python -m rio_tpu.admin {tail|explain|stats} --------------
+# -- operator CLI: python -m rio_tpu.admin {tail|explain|stats|watch} --------
+
+
+def _watch_rows(snapshots: Sequence[SeriesSnapshot]) -> list[dict]:
+    """Per-node ``watch`` table rows from DumpSeries scrapes.
+
+    Each row carries the newest value and a trend arrow (over the scraped
+    window) for rate/p99/inflight/sheds, plus the node's solver mode and
+    active alerts from the snapshot meta. Pure function — the table the
+    operator sees is exactly what the CLI test asserts on.
+    """
+    from .timeseries import series_values, trend_arrow
+
+    rows: list[dict] = []
+    for snap in sorted(snapshots, key=lambda s: s.address):
+        samples = snap.samples()
+        # Per-sample max over the per-handler p99 gauges: the node's worst
+        # handler latency, trended like any scalar gauge.
+        p99s = [
+            max(v for k, v in s.gauges.items() if k.endswith(".p99_ms"))
+            for s in samples
+            if any(k.endswith(".p99_ms") for k in s.gauges)
+        ]
+        row: dict = {
+            "address": snap.address,
+            "samples": len(samples),
+            "dropped": snap.dropped,
+            "solver_mode": str(snap.meta.get("solver_mode", "") or "-"),
+            "alerts": list(snap.meta.get("alerts", ())),
+            "p99_ms": p99s[-1] if p99s else 0.0,
+            "p99_trend": trend_arrow(p99s),
+        }
+        for col, gauge in (
+            ("rate", "rio.load.req_rate"),
+            ("inflight", "rio.load.inflight"),
+            ("sheds", "rio.load.sheds"),
+        ):
+            vals = series_values(samples, gauge)
+            row[col] = vals[-1] if vals else 0.0
+            row[f"{col}_trend"] = trend_arrow(vals)
+        rows.append(row)
+    return rows
+
+
+def _format_watch(rows: Sequence[dict]) -> str:
+    header = (
+        f"{'node':<22} {'rate':>9}  {'p99_ms':>9}  {'inflight':>9} "
+        f"{'sheds':>7}  {'mode':<12} alerts"
+    )
+    lines = [header, "-" * len(header)]
+    for r in rows:
+        lines.append(
+            f"{r['address']:<22} "
+            f"{r['rate']:>7.1f} {r['rate_trend']}  "
+            f"{r['p99_ms']:>7.2f} {r['p99_trend']}  "
+            f"{r['inflight']:>7.0f} {r['inflight_trend']} "
+            f"{r['sheds']:>5.0f} {r['sheds_trend']}  "
+            f"{r['solver_mode']:<12} "
+            + (",".join(r["alerts"]) or "-")
+        )
+    return "\n".join(lines)
+
+
+def _event_dict(ev: JournalEvent) -> dict:
+    return {
+        "seq": ev.seq,
+        "wall_ts": ev.wall_ts,
+        "node": ev.node,
+        "epoch": ev.epoch,
+        "kind": ev.kind,
+        "key": ev.key,
+        "attrs": ev.attrs,
+        "trace_id": ev.trace_id,
+    }
 
 
 async def _cli_cluster(args: Any):
@@ -282,7 +454,11 @@ async def _cli_cluster(args: Any):
         from .registry import type_id
 
         tracing.set_sample_rate(1.0)  # demo journal rows carry trace ids
-        members, placement, tasks, servers = await boot_echo_cluster(2)
+        members, placement, tasks, servers = await boot_echo_cluster(
+            2,
+            # Aggressive sampling so a one-shot demo scrape has a window.
+            server_kwargs=dict(load_interval=0.05, timeseries_interval=0.05),
+        )
         client = Client(members)
         tname = type_id(EchoActor)
         for i in range(20):
@@ -306,6 +482,8 @@ async def _cli_cluster(args: Any):
             )
             await asyncio.sleep(0.4)  # let the queued migration run
             await client.send(EchoActor, "w0", Echo(value=99), returns=Echo)
+        if getattr(args, "cmd", "") == "watch":
+            await asyncio.sleep(0.5)  # several sampler ticks → a trend window
         if not getattr(args, "subject", None):
             args.subject = (tname, "w0")
 
@@ -334,7 +512,16 @@ async def _cli_cluster(args: Any):
 
 
 async def _cli_main(argv: Sequence[str] | None = None) -> int:
+    """Operator CLI. Exit codes (scriptable, see the CLI test):
+
+    * 0 — scrape succeeded (at least one node answered).
+    * 1 — empty scrape: no node in the target set answered (unreachable /
+      pre-series cluster).
+    * 2 — usage (missing explain subject; argparse errors also exit 2).
+    """
     import argparse
+    import asyncio
+    import json
 
     parser = argparse.ArgumentParser(
         prog="python -m rio_tpu.admin",
@@ -349,19 +536,54 @@ async def _cli_main(argv: Sequence[str] | None = None) -> int:
         help="boot a 2-node in-process cluster, drive traffic + one migration, "
         "then run the subcommand against it",
     )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="machine-readable output (one JSON document on stdout)",
+    )
     sub = parser.add_subparsers(dest="cmd", required=True)
 
-    tail = sub.add_parser("tail", help="merged cluster journal tail")
+    def _common(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
+        # The shared flags are accepted on either side of the subcommand
+        # (`--demo tail` and `watch --demo --once` both work); SUPPRESS
+        # defaults keep a pre-subcommand value from being clobbered.
+        p.add_argument("--nodes", default=argparse.SUPPRESS)
+        p.add_argument("--demo", action="store_true", default=argparse.SUPPRESS)
+        p.add_argument("--json", action="store_true", default=argparse.SUPPRESS)
+        return p
+
+    tail = _common(sub.add_parser("tail", help="merged cluster journal tail"))
     tail.add_argument("--kind", action="append", default=[], help="filter by kind")
     tail.add_argument("--key", default="", help="filter by subject key (type/id)")
     tail.add_argument("--since-seq", type=int, default=0)
     tail.add_argument("--limit", type=int, default=64)
 
-    exp = sub.add_parser("explain", help="one actor's causal placement history")
+    exp = _common(
+        sub.add_parser("explain", help="one actor's causal placement history")
+    )
     exp.add_argument("type_name", nargs="?", default="")
     exp.add_argument("object_id", nargs="?", default="")
 
-    sub.add_parser("stats", help="per-node gauge snapshot (journal counters incl.)")
+    _common(
+        sub.add_parser(
+            "stats", help="per-node gauge snapshot (journal counters incl.)"
+        )
+    )
+
+    watch = _common(
+        sub.add_parser(
+            "watch", help="live per-node trend table over the gauge time-series"
+        )
+    )
+    watch.add_argument(
+        "--once", action="store_true", help="print one table and exit"
+    )
+    watch.add_argument(
+        "--interval", type=float, default=2.0, help="refresh period (seconds)"
+    )
+    watch.add_argument(
+        "--window", type=int, default=64, help="samples scraped per node"
+    )
 
     args = parser.parse_args(argv)
     args.subject = (
@@ -375,7 +597,7 @@ async def _cli_main(argv: Sequence[str] | None = None) -> int:
     client, nodes, cleanup = await _cli_cluster(args)
     try:
         if args.cmd == "tail":
-            events = await cluster_events(
+            snapshots = await scrape_events(
                 client,
                 nodes,
                 kinds=args.kind or None,
@@ -383,30 +605,56 @@ async def _cli_main(argv: Sequence[str] | None = None) -> int:
                 since_seq=args.since_seq,
                 limit=args.limit,
             )
-            for ev in events:
-                print(format_event(ev))
-            print(f"[tail] {len(events)} events")
-        elif args.cmd == "explain":
+            events = merge_events(s.events() for s in snapshots)
+            if args.json:
+                print(json.dumps([_event_dict(e) for e in events]))
+            else:
+                for ev in events:
+                    print(format_event(ev))
+                print(f"[tail] {len(events)} events")
+            return 0 if snapshots else 1
+        if args.cmd == "explain":
             if not args.subject:
                 print("explain: missing TYPE ID (demo picks its migrated actor)")
                 return 2
             tname, oid = args.subject
-            events = await explain(client, nodes, tname, oid)
-            traces = {e.trace_id for e in events if e.trace_id}
-            for ev in events:
-                print(format_event(ev))
-            print(
-                f"[explain] {subject_key(tname, oid)}: {len(events)} events, "
-                f"{len(traces)} linked trace(s)"
+            snapshots = await scrape_events(
+                client, nodes, key=subject_key(tname, oid), limit=512
             )
-        else:  # stats
+            events = merge_events(s.events() for s in snapshots)
+            traces = {e.trace_id for e in events if e.trace_id}
+            if args.json:
+                print(json.dumps({
+                    "subject": subject_key(tname, oid),
+                    "events": [_event_dict(e) for e in events],
+                    "traces": sorted(traces),
+                }))
+            else:
+                for ev in events:
+                    print(format_event(ev))
+                print(
+                    f"[explain] {subject_key(tname, oid)}: {len(events)} events, "
+                    f"{len(traces)} linked trace(s)"
+                )
+            return 0 if snapshots else 1
+        if args.cmd == "stats":
+            reached = 0
+            out: dict[str, Any] = {}
             for address in await _node_addresses(nodes):
                 try:
                     snap = await client.send(
                         ADMIN_TYPE, address, DumpStats(), returns=StatsSnapshot
                     )
                 except Exception as e:
-                    print(f"{address}: unreachable ({e.__class__.__name__})")
+                    if not args.json:
+                        print(f"{address}: unreachable ({e.__class__.__name__})")
+                    continue
+                reached += 1
+                if args.json:
+                    out[snap.address] = {
+                        "gauges": snap.gauges,
+                        "histograms": len(snap.histograms),
+                    }
                     continue
                 journal = {
                     k: v for k, v in snap.gauges.items() if k.startswith("rio.journal.")
@@ -419,9 +667,23 @@ async def _cli_main(argv: Sequence[str] | None = None) -> int:
                         or "off"
                     )
                 )
+            if args.json:
+                print(json.dumps(out))
+            return 0 if reached else 1
+        # watch: the trend table (one shot with --once/--json, else looped).
+        while True:
+            snapshots = await scrape_series(client, nodes, limit=args.window)
+            rows = _watch_rows(snapshots)
+            if args.json:
+                print(json.dumps(rows))
+            else:
+                print(_format_watch(rows))
+            if args.once or args.json or not snapshots:
+                return 0 if snapshots else 1
+            await asyncio.sleep(max(0.1, args.interval))
+            print()
     finally:
         await cleanup()
-    return 0
 
 
 if __name__ == "__main__":  # pragma: no cover - exercised via CLI smoke test

@@ -61,6 +61,10 @@ class AdminCommandKind(Enum):
     # rio.Admin DumpEvents) this node's journal tail. Old servers answer the
     # wire form with the clean unknown-kind AdminAck.
     DUMP_EVENTS = "dump_events"
+    # Gauge time-series ring: log (in-process) or return (wire, via
+    # rio.Admin DumpSeries) this node's periodic gauge samples. Old servers
+    # answer the wire form with the clean unknown-kind AdminAck.
+    DUMP_SERIES = "dump_series"
 
 
 @dataclasses.dataclass
@@ -100,6 +104,12 @@ class AdminCommand:
         """Log this node's control-plane journal tail (the in-process twin
         of the wire ``DumpEvents`` scrape served by ``rio.Admin``)."""
         return cls(AdminCommandKind.DUMP_EVENTS)
+
+    @classmethod
+    def dump_series(cls) -> "AdminCommand":
+        """Log this node's gauge time-series window (the in-process twin
+        of the wire ``DumpSeries`` scrape served by ``rio.Admin``)."""
+        return cls(AdminCommandKind.DUMP_SERIES)
 
     @classmethod
     def migrate(cls, type_name: str, object_id: str, target: str) -> "AdminCommand":

@@ -75,6 +75,8 @@ REMINDER_HANDOFF = "reminder_handoff"  # drain handed shards to a peer
 
 SOLVE = "solve"  # placement solve (full or delta) applied/discarded
 
+HEALTH = "health"  # HealthWatch trend rule fired (degradation alarm)
+
 EVENT_KINDS: tuple[str, ...] = (
     MEMBER_UP,
     MEMBER_DOWN,
@@ -99,6 +101,7 @@ EVENT_KINDS: tuple[str, ...] = (
     REMINDER_RELEASE,
     REMINDER_HANDOFF,
     SOLVE,
+    HEALTH,
 )
 
 

@@ -324,6 +324,10 @@ class LoadMonitor:
         # (rio_tpu.readscale.ReadScaleManager), ticked once per sample so
         # dynamic replica counts ride the existing loop — no new task.
         self.hotness_detector: Any = None
+        # Sync per-sample callbacks riding the same cadence (the series
+        # sampler and HealthWatch, wired by Server.run); each is isolated
+        # like the hotness tick — a failing ticker must not stop sampling.
+        self.tickers: list = []
 
     # -- request-path hooks (sync, called per dispatch) ---------------------
 
@@ -435,6 +439,11 @@ class LoadMonitor:
                     raise
                 except Exception:  # noqa: BLE001 — sampling must not die
                     log.exception("hotness detector tick failed")
+            for ticker in self.tickers:
+                try:
+                    ticker()
+                except Exception:  # noqa: BLE001 — sampling must not die
+                    log.exception("load-loop ticker failed")
             if loop.time() - last_view >= self.view_interval:
                 last_view = loop.time()
                 try:

@@ -27,6 +27,7 @@ service checks ``is_active`` before honoring a placement
 from __future__ import annotations
 
 import asyncio
+import os
 import time
 from dataclasses import dataclass, field, replace
 
@@ -356,10 +357,82 @@ def _class_refresh_device(base, counts, cap_alive, g_seed, *, mode, move_cost, e
         move_cost * jnp.eye(m, dtype=jnp.float32)
     )
     solver = scaling_sinkhorn if mode == "scaling" else sinkhorn
-    _f, g, _err = solver(
+    _f, g, err = solver(
         ccost, counts, cap_alive, eps=eps, n_iters=n_iters, g_init=g_seed
     )
-    return g
+    return g, err
+
+
+# -- solver convergence telemetry helpers (PR 11) ----------------------------
+
+# Cumulative backend-compile seconds seen by this process's jax, fed by a
+# jax.monitoring duration listener. Registered lazily on first use and
+# gated defensively: the listener API has moved across jax versions, and
+# telemetry must never break a solve — when unavailable, compile_ms stays
+# -1 (unobserved) rather than lying with 0.
+_COMPILE_WATCH: dict = {"total_s": 0.0, "ok": None}
+
+
+def _compile_seconds() -> float:
+    """Backend-compile seconds accumulated so far, or -1 if unobservable.
+
+    Snapshot before and after a solve window to split ``solve_ms`` into
+    compile vs execute — the signal the r5 TPU rounds needed (compile_s
+    66→106 across "healthy" runs was the wedge precursor). Process-global
+    on purpose: solves run one at a time in the provider's solver thread.
+    """
+    if _COMPILE_WATCH["ok"] is None:
+        try:
+            from jax import monitoring as _monitoring
+
+            def _on_duration(event: str, duration: float, **_kw) -> None:
+                if "compil" in event:
+                    _COMPILE_WATCH["total_s"] += duration
+
+            _monitoring.register_event_duration_secs_listener(_on_duration)
+            _COMPILE_WATCH["ok"] = True
+        except Exception:  # noqa: BLE001 - older/newer jax: no listener API
+            _COMPILE_WATCH["ok"] = False
+    return _COMPILE_WATCH["total_s"] if _COMPILE_WATCH["ok"] else -1.0
+
+
+def _seed_warm_ratio(seed) -> float:
+    """Warm fraction of a potential seed: finite entries / total.
+
+    The solvers cold-fill non-finite seed entries to zero, so the finite
+    fraction IS the warm-start hit ratio. No seed at all reads as 0.0
+    (fully cold); callers pass -1 themselves for solves that take no seed.
+    """
+    if seed is None:
+        return 0.0
+    arr = np.asarray(seed)
+    if arr.size == 0:
+        return 0.0
+    return float(np.mean(np.isfinite(arr)))
+
+
+def _conv_fields(conv: dict | None) -> dict:
+    """Normalize a solve's convergence record into SolveStats kwargs."""
+    conv = conv or {}
+    return {
+        "solver_iters": int(conv.get("solver_iters", 0)),
+        "residual": float(conv.get("residual", -1.0)),
+        "warm_ratio": float(conv.get("warm_ratio", -1.0)),
+        "compile_ms": float(conv.get("compile_ms", -1.0)),
+        "exec_ms": float(conv.get("exec_ms", -1.0)),
+        "chunks": int(conv.get("chunks", 0)),
+        "chunk_ms": [float(x) for x in conv.get("chunk_ms", ())],
+    }
+
+
+def _conv_timing(conv: dict, t0: float, c0: float) -> tuple[float, dict]:
+    """Close a solve window: wall ms plus the compile/execute split."""
+    ms = (time.perf_counter() - t0) * 1e3
+    c1 = _compile_seconds()
+    if c0 >= 0.0 and c1 >= 0.0:
+        conv["compile_ms"] = round((c1 - c0) * 1e3, 3)
+        conv["exec_ms"] = round(max(ms - conv["compile_ms"], 0.0), 3)
+    return ms, conv
 
 
 def _apply_class_quotas(quotas: np.ndarray, cur_idx: np.ndarray) -> np.ndarray:
@@ -548,6 +621,18 @@ class SolveStats:
     epoch: int = 0
     mode: str = "none"
     discarded: bool = False
+    # -- per-solve convergence record (PR 11) --------------------------------
+    # Scalars flow into `rio.placement_solve.*` gauges automatically via
+    # otel.stats_gauges; -1 means "not applicable / unobserved" (greedy has
+    # no residual, an old jax has no compile listener) — never 0, which
+    # would read as a perfect value.
+    solver_iters: int = 0  # configured iterations (fixed-length scans)
+    residual: float = -1.0  # final L1 column-marginal violation
+    warm_ratio: float = -1.0  # finite fraction of the warm-start seed
+    compile_ms: float = -1.0  # backend-compile share of solve_ms
+    exec_ms: float = -1.0  # solve_ms minus compile_ms
+    chunks: int = 0  # chunked-hierarchical chunk count (0 = unchunked)
+    chunk_ms: list = field(default_factory=list)  # per-chunk wall ms
     # Bounded record of prior completed solves (most recent last, each with
     # an empty history of its own) — lets the daemon/operators see churn
     # cadence and whether solve/apply cost or move counts drift over time.
@@ -580,6 +665,17 @@ class SolveStats:
         out["rio.placement_solve.history.discarded_total"] = float(
             sum(1 for s in window if s.discarded)
         )
+        # Convergence trend: last/worst residual over solves that HAVE one
+        # (-1 = n/a is excluded so a greedy solve can't mask divergence),
+        # plus the cumulative compile cost — the r5 "compile_s rising"
+        # wedge precursor, now a scrapeable counter.
+        residuals = [float(s.residual) for s in window if s.residual >= 0.0]
+        if residuals:
+            out["rio.placement_solve.history.residual_last"] = residuals[-1]
+            out["rio.placement_solve.history.residual_max"] = max(residuals)
+        compiles = [float(s.compile_ms) for s in window if s.compile_ms >= 0.0]
+        if compiles:
+            out["rio.placement_solve.history.compile_ms_total"] = sum(compiles)
         return out
 
 
@@ -1252,10 +1348,13 @@ class JaxObjectPlacement(ObjectPlacement):
 
         ``coarse_g_init`` warm-starts the coarse group solve from a prior
         plan's potentials (delta path); used only when its length matches
-        this solve's group count. Returns ``(assignment, g, coarse_g)``:
-        the flat node potentials are always None here (the two-level solve
-        produces group potentials instead), ``coarse_g`` is the coarse
-        stage's (n_groups,) potentials — None on the sharded path.
+        this solve's group count. Returns ``(assignment, g, coarse_g,
+        conv)``: the flat node potentials are always None here (the
+        two-level solve produces group potentials instead), ``coarse_g``
+        is the coarse stage's (n_groups,) potentials — None on the
+        sharded path — and ``conv`` is the convergence record
+        (iterations, residual, warm ratio, per-chunk timings) SolveStats
+        surfaces.
         """
         from ..parallel.hierarchical import hierarchical_assign
 
@@ -1359,7 +1458,15 @@ class JaxObjectPlacement(ObjectPlacement):
         if coarse_g_init is None or (
             np.asarray(coarse_g_init).shape != (n_groups,)
         ):
+            warm_ratio = 0.0  # cold start (no / mismatched prior seed)
             coarse_g_init = np.zeros((n_groups,), np.float32)
+        else:
+            warm_ratio = _seed_warm_ratio(coarse_g_init)
+        conv: dict = {
+            "solver_iters": 2 * self._n_iters,  # coarse + fine stages
+            "warm_ratio": warm_ratio,
+            "chunks": n_chunks,
+        }
         if self._mesh is not None:
             # Shard the object axis across the mesh (the tier this mode is
             # for); pad to a shard multiple with zero-feature rows and let
@@ -1379,13 +1486,29 @@ class JaxObjectPlacement(ObjectPlacement):
         elif n_chunks > 1:
             from ..parallel import hierarchical as _hier
 
-            res = _hier.chunked_hierarchical_assign(
-                obj_feat, jnp.asarray(node_feat),
-                jnp.asarray(cap_np), jnp.asarray(alive_np),
-                n_chunks=n_chunks,
-                coarse_g_init=jnp.asarray(coarse_g_init),
-                **kw,
-            )
+            if os.environ.get("RIO_TPU_CHUNK_TIMING", "1") != "0":
+                # Host-looped twin: same jitted chunk body (compile stays
+                # pinned to the chunk shape), but each chunk's
+                # dispatch+block cycle is timed — the per-chunk signal
+                # the hierarchical-ladder telemetry needs. Set
+                # RIO_TPU_CHUNK_TIMING=0 to keep the single-executable
+                # lax.map form instead.
+                res, chunk_ms = _hier.chunked_hierarchical_assign_timed(
+                    obj_feat, jnp.asarray(node_feat),
+                    jnp.asarray(cap_np), jnp.asarray(alive_np),
+                    n_chunks=n_chunks,
+                    coarse_g_init=jnp.asarray(coarse_g_init),
+                    **kw,
+                )
+                conv["chunk_ms"] = chunk_ms
+            else:
+                res = _hier.chunked_hierarchical_assign(
+                    obj_feat, jnp.asarray(node_feat),
+                    jnp.asarray(cap_np), jnp.asarray(alive_np),
+                    n_chunks=n_chunks,
+                    coarse_g_init=jnp.asarray(coarse_g_init),
+                    **kw,
+                )
         else:
             res = hierarchical_assign(
                 obj_feat, jnp.asarray(node_feat),
@@ -1396,7 +1519,11 @@ class JaxObjectPlacement(ObjectPlacement):
         coarse_g = (
             None if res.coarse_g is None else np.asarray(res.coarse_g, np.float32)
         )
-        return res.assignment[:n], None, coarse_g
+        if res.coarse_err is not None:
+            # Scalar pull AFTER the solve, never per iteration (CLAUDE.md
+            # r4: value pulls ride the post-timing path).
+            conv["residual"] = float(np.asarray(res.coarse_err))
+        return res.assignment[:n], None, coarse_g, conv
 
     # ---------------------------------------------------- incremental solve
     def _delta_gates_ok(self, plan: PlanState | None, force: bool) -> bool:
@@ -1418,8 +1545,9 @@ class JaxObjectPlacement(ObjectPlacement):
         potentials so a handful of iterations re-converges after one
         liveness flip. No N dependence -> no per-event recompile, one
         cached executable per node axis (see ``_class_refresh_device``).
-        Returns ``(g, score)`` — the new column potentials and the
-        per-node host fill score. A missing seed is passed as zeros, not
+        Returns ``(g, score, err)`` — the new column potentials, the
+        per-node host fill score, and the refresh's scalar convergence
+        residual. A missing seed is passed as zeros, not
         None: cold start IS the zero seed in both solver forms, and a
         None-vs-array flip would mint a second trace."""
         base = build_cost_matrix(jnp.zeros_like(load), cap, alive)[0]
@@ -1428,7 +1556,7 @@ class JaxObjectPlacement(ObjectPlacement):
             if plan.g is None
             else jnp.asarray(plan.g)
         )
-        g_r = _class_refresh_device(
+        g_r, err = _class_refresh_device(
             base,
             jnp.asarray(np.asarray(counts_np, np.float32)),
             jnp.asarray(cap_alive.astype(np.float32)),
@@ -1445,7 +1573,7 @@ class JaxObjectPlacement(ObjectPlacement):
         score = np.asarray(base, np.float64) - np.where(
             np.isfinite(g_np), g_np, -1e30
         )
-        return g_r, score
+        return g_r, score, float(np.asarray(err))
 
     def _delta_fast_snapshot(self, plan, n, cap, alive, force):
         """O(displaced) delta snapshot, taken under the provider lock.
@@ -1523,9 +1651,11 @@ class JaxObjectPlacement(ObjectPlacement):
 
         def _solve():
             t0 = time.perf_counter()
+            c0 = _compile_seconds()
             with span("placement_solve", mode=solved_as, n=n):
                 g_new = None
                 coarse_new = None
+                conv: dict = {}
                 if d == 0:
                     # Nothing displaced (pure load jitter): the plan stands.
                     fill = np.zeros((0,), np.int32)
@@ -1534,7 +1664,7 @@ class JaxObjectPlacement(ObjectPlacement):
                     # the residual columns (chunk-shape compile bound).
                     res_cap = residual.astype(np.float32)
                     res_alive = (residual > 0).astype(np.float32)
-                    fill, _, coarse_new = self._hierarchical_solve(
+                    fill, _, coarse_new, conv = self._hierarchical_solve(
                         [k for k, _ in disp], node_order, res_cap,
                         res_alive, coarse_g_init=plan.coarse_g,
                     )
@@ -1544,10 +1674,15 @@ class JaxObjectPlacement(ObjectPlacement):
                     )
                 else:
                     if mode in ("sinkhorn", "scaling"):
-                        g_new, score = self._class_refresh(
+                        g_new, score, ref_err = self._class_refresh(
                             load, cap, alive, fast["counts"], cap_alive,
                             mode, plan,
                         )
+                        conv = {
+                            "solver_iters": max(4, min(8, self._n_iters)),
+                            "residual": ref_err,
+                            "warm_ratio": _seed_warm_ratio(plan.g),
+                        }
                     else:
                         score = np.where(
                             sched, retained / np.maximum(quota, 1), 1e18
@@ -1565,11 +1700,10 @@ class JaxObjectPlacement(ObjectPlacement):
                 stale = bool(
                     den > 0.0 and num > self._delta_audit_ratio * den
                 )
-                return fill, g_new, coarse_new, (
-                    time.perf_counter() - t0
-                ) * 1e3, stale, counts_after
+                solve_ms, conv = _conv_timing(conv, t0, c0)
+                return fill, g_new, coarse_new, solve_ms, stale, counts_after, conv
 
-        fill, g, coarse_g, solve_ms, stale, counts_after = (
+        fill, g, coarse_g, solve_ms, stale, counts_after, conv = (
             await asyncio.to_thread(_solve)
         )
 
@@ -1584,6 +1718,7 @@ class JaxObjectPlacement(ObjectPlacement):
                     mode=solved_as,
                     discarded=True,
                     history=self._archived_history(),
+                    **_conv_fields(conv),
                 )
                 return 0
             hist = self._archived_history()
@@ -1624,6 +1759,7 @@ class JaxObjectPlacement(ObjectPlacement):
                 mode=solved_as,
                 discarded=False,
                 history=hist,
+                **_conv_fields(conv),
             )
         if planned:
             planned.sort(key=lambda mv: (mv[1], mv[2]))
@@ -1652,7 +1788,8 @@ class JaxObjectPlacement(ObjectPlacement):
 
         Runs in the solver thread over loop-side snapshots only (the
         provider's standard discipline); reads nothing live but immutable
-        config. Returns ``(assignment, g, coarse_g, displaced, stale)``,
+        config. Returns ``(assignment, g, coarse_g, displaced, stale,
+        conv)`` — ``conv`` is the convergence record SolveStats surfaces —
         or None when a gate says this event needs the full solve:
         no plan / plan marked stale / ``max_delta_solves`` consecutive
         deltas exceeded / displaced fraction above ``delta_threshold``
@@ -1686,7 +1823,7 @@ class JaxObjectPlacement(ObjectPlacement):
         d = int(disp_pos.shape[0])
         if d == 0:
             # Nothing displaced (e.g. a node RETURNED): the plan stands.
-            return cur.astype(np.int32), None, None, 0, False
+            return cur.astype(np.int32), None, None, 0, False, {}
         if not force and d > self._delta_threshold * n:
             return None
         # retained[j] = min(counts[j], quota[j]) on schedulable nodes, 0
@@ -1697,6 +1834,7 @@ class JaxObjectPlacement(ObjectPlacement):
 
         g_new = None
         coarse_new = None
+        conv: dict = {}
         if mode == "hierarchical":
             # Route the displaced keys through the two-level solve against
             # the residual capacity columns — the chunked dispatch inside
@@ -1704,7 +1842,7 @@ class JaxObjectPlacement(ObjectPlacement):
             disp_keys = [keys[i] for i in disp_pos.tolist()]
             res_cap = residual.astype(np.float32)
             res_alive = (residual > 0).astype(np.float32)
-            fill, _, coarse_new = self._hierarchical_solve(
+            fill, _, coarse_new, conv = self._hierarchical_solve(
                 disp_keys, node_order, res_cap, res_alive,
                 coarse_g_init=plan.coarse_g,
             )
@@ -1714,10 +1852,15 @@ class JaxObjectPlacement(ObjectPlacement):
         else:
             if mode in ("sinkhorn", "scaling"):
                 # Warm M x M potential refresh (see _class_refresh).
-                g_new, score = self._class_refresh(
+                g_new, score, ref_err = self._class_refresh(
                     load, cap, alive, np.bincount(cur, minlength=m),
                     cap_alive, mode, plan,
                 )
+                conv = {
+                    "solver_iters": max(4, min(8, self._n_iters)),
+                    "residual": ref_err,
+                    "warm_ratio": _seed_warm_ratio(plan.g),
+                }
             else:
                 # Greedy has no potentials: order nodes by how full their
                 # retained population already is. Every feasible fill hits
@@ -1744,7 +1887,7 @@ class JaxObjectPlacement(ObjectPlacement):
         num = float(np.sum(counts_after**2 / safe_cap))
         den = float(np.sum(quota.astype(np.float64) ** 2 / safe_cap))
         stale = bool(den > 0.0 and num > self._delta_audit_ratio * den)
-        return out, g_new, coarse_new, d, stale
+        return out, g_new, coarse_new, d, stale, conv
 
     async def rebalance(
         self,
@@ -1824,6 +1967,7 @@ class JaxObjectPlacement(ObjectPlacement):
             live — and makes the epoch-discard check below load-bearing.
             Only the snapshots taken under the lock are read here."""
             t0 = time.perf_counter()
+            c0 = _compile_seconds()
             from ..tracing import span
 
             if no_capacity:
@@ -1837,7 +1981,7 @@ class JaxObjectPlacement(ObjectPlacement):
                 with span("placement_solve", mode=solved_as, n=n):
                     return cur_idx.copy(), None, None, (
                         time.perf_counter() - t0
-                    ) * 1e3, solved_as, 0, False
+                    ) * 1e3, solved_as, 0, False, {}
             # Per-object move prices (object_costs hook; tracker-measured
             # request rates + snapshot bytes by default). Evaluated in the
             # solver thread — hooks must read only atomically-swapped
@@ -1868,14 +2012,14 @@ class JaxObjectPlacement(ObjectPlacement):
                         force=(delta is True),
                     )
                     if d_res is not None:
-                        out_d, g_d, coarse_d, displaced, stale = d_res
+                        out_d, g_d, coarse_d, displaced, stale, conv = d_res
                         out_d = _route_unseatable(
                             out_d, len(node_order), load, alive, cap
                         )
+                        solve_ms, conv = _conv_timing(conv, t0, c0)
                         return (
-                            out_d, g_d, coarse_d,
-                            (time.perf_counter() - t0) * 1e3,
-                            f"{mode}+delta", displaced, stale,
+                            out_d, g_d, coarse_d, solve_ms,
+                            f"{mode}+delta", displaced, stale, conv,
                         )
             # Decide the actual code path up front so traces, profiler
             # labels, and SolveStats.mode all agree on what ran.
@@ -1946,9 +2090,10 @@ class JaxObjectPlacement(ObjectPlacement):
                     )
 
                 coarse_g = None
+                conv = {}
                 if mode == "hierarchical" or route_hier:
                     # Never materializes the flat (bucket x node_axis) cost.
-                    assignment, g, coarse_g = self._hierarchical_solve(
+                    assignment, g, coarse_g, conv = self._hierarchical_solve(
                         keys, node_order, cap, alive,
                         cur_idx=cur_idx if route_hier else None,
                         move_cost=self._move_cost if route_hier else 0.0,
@@ -1983,7 +2128,7 @@ class JaxObjectPlacement(ObjectPlacement):
                     class_eps = min(
                         self._eps, self._move_cost / 25.0 if self._move_cost > 0 else self._eps
                     )
-                    quotas, g = class_quotas(
+                    quotas, g, cls_err = class_quotas(
                         base_cost,
                         counts,
                         cap * alive,
@@ -2016,6 +2161,13 @@ class JaxObjectPlacement(ObjectPlacement):
                     # approximately capacity; the shared repair makes node
                     # loads exactly integer-quota (still O(N log N)).
                     assignment = _repair_exact(expanded)
+                    conv = {
+                        "solver_iters": self._n_iters,
+                        "residual": float(np.asarray(cls_err)),
+                        "warm_ratio": _seed_warm_ratio(
+                            plan.g if plan is not None else None
+                        ),
+                    }
                 else:
                     base_cost = build_cost_matrix(jnp.zeros_like(load), cap, alive)
                     cost = jnp.broadcast_to(base_cost, (bucket, base_cost.shape[1]))
@@ -2063,6 +2215,13 @@ class JaxObjectPlacement(ObjectPlacement):
                                 self._mesh, cost, mass, cap * alive,
                                 eps=self._eps, n_iters=self._n_iters,
                             )
+                            # The sharded solvers return no residual (a
+                            # collective just for telemetry isn't worth
+                            # it) and take no warm seed: cold by design.
+                            conv = {
+                                "solver_iters": self._n_iters,
+                                "warm_ratio": 0.0,
+                            }
                         else:
                             dense = (
                                 scaling_sinkhorn
@@ -2078,6 +2237,13 @@ class JaxObjectPlacement(ObjectPlacement):
                                     else None
                                 ),
                             )
+                            conv = {
+                                "solver_iters": self._n_iters,
+                                "residual": float(np.asarray(_err)),
+                                "warm_ratio": _seed_warm_ratio(
+                                    plan.g if plan is not None else None
+                                ),
+                            }
                         assignment = plan_rounded_assign(cost, f, g, self._eps)
                         # Exact-capacity repair (bucket-shaped for trace
                         # reuse; padding rows ride a sentinel column; see
@@ -2130,13 +2296,11 @@ class JaxObjectPlacement(ObjectPlacement):
             out = _route_unseatable(
                 np.asarray(assignment)[:n], len(node_order), load, alive, cap
             )
-            return (
-                out, g, coarse_g,
-                (time.perf_counter() - t0) * 1e3, solved_as, n, False,
-            )
+            solve_ms, conv = _conv_timing(conv, t0, c0)
+            return out, g, coarse_g, solve_ms, solved_as, n, False, conv
 
         (
-            assignment, g, coarse_g, solve_ms, solved_as, displaced, stale
+            assignment, g, coarse_g, solve_ms, solved_as, displaced, stale, conv
         ) = await asyncio.to_thread(_solve)
 
         async with self._lock:
@@ -2155,6 +2319,7 @@ class JaxObjectPlacement(ObjectPlacement):
                     mode=solved_as,
                     discarded=True,
                     history=self._archived_history(),
+                    **_conv_fields(conv),
                 )
                 return 0
             # Touch only the movers: non-movers are _set_placement no-ops
@@ -2233,6 +2398,7 @@ class JaxObjectPlacement(ObjectPlacement):
                 mode=solved_as,
                 discarded=False,
                 history=hist,
+                **_conv_fields(conv),
             )
         if planned:
             # Grouped emission: the migration engine batches one burst per

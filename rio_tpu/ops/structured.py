@@ -48,7 +48,7 @@ def class_quotas(
     eps: float = 0.05,
     n_iters: int = 30,
     g_init: jax.Array | None = None,
-) -> tuple[jax.Array, jax.Array]:
+) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Integer per-class quotas for the collapsed rebalance problem.
 
     Args:
@@ -63,10 +63,12 @@ def class_quotas(
         back in, so a churn re-solve converges in a handful of iterations).
 
     Returns:
-      (quotas, g): quotas is (M, M) int32 where ``quotas[k, j]`` objects of
-      class k should end on node j — every row sums EXACTLY to
+      (quotas, g, err): quotas is (M, M) int32 where ``quotas[k, j]``
+      objects of class k should end on node j — every row sums EXACTLY to
       ``counts[k]``; ``g`` is the (M,) node potential from the class solve
-      (seed for the incremental warm-start path).
+      (seed for the incremental warm-start path); ``err`` is the solve's
+      scalar final L1 column-marginal violation (the convergence residual
+      ``SolveStats`` surfaces).
     """
     m = base_cost.shape[0]
     counts = counts.astype(jnp.float32)
@@ -102,7 +104,7 @@ def class_quotas(
         jnp.arange(m)[:, None], order
     ].set(jnp.broadcast_to(jnp.arange(m)[None, :], (m, m)))
     quotas = (base + (rank < short[:, None])).astype(jnp.int32)
-    return quotas, res.g
+    return quotas, res.g, res.err
 
 
 @jax.jit

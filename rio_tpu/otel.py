@@ -105,6 +105,15 @@ def server_gauges(server: Any) -> dict[str, float]:
         # Rolling solve-history summary (rio.placement_solve.history.*) —
         # stats_gauges above only sees the LAST solve's scalar fields.
         gauges.update(history_gauges())
+    series = getattr(server, "timeseries", None)
+    if series is not None:
+        # Gauge time-series ring counters (rio.series.*).
+        gauges.update(series.gauges())
+    health = getattr(server, "health_watch", None)
+    if health is not None:
+        # Trend-alarm state (rio.health.*): active/total alert counts plus
+        # one 0/1 gauge per configured rule.
+        gauges.update(health.gauges())
     return gauges
 
 

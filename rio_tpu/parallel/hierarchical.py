@@ -49,6 +49,7 @@ from ..ops.sinkhorn import (
 __all__ = [
     "HierarchicalResult",
     "chunked_hierarchical_assign",
+    "chunked_hierarchical_assign_timed",
     "hierarchical_assign",
     "sharded_hierarchical_assign",
 ]
@@ -62,6 +63,11 @@ class HierarchicalResult(NamedTuple):
     # (delta) solve's coarse stage. None on the sharded path (each shard
     # solves its own coarse problem; no single seed to return).
     coarse_g: jax.Array | None = None
+    # Scalar final L1 column-marginal violation of the coarse solve —
+    # the convergence residual SolveStats surfaces. None on the sharded
+    # path (per-shard residuals have no single summary without a
+    # collective this solve otherwise never needs).
+    coarse_err: jax.Array | None = None
 
 
 @functools.partial(
@@ -220,7 +226,8 @@ def hierarchical_assign(
     missed = jnp.zeros((n,), bool).at[order].set(~in_bucket)
     assignment = jnp.where(missed, fallback[group], assignment)
     return HierarchicalResult(
-        assignment=assignment, group=group, overflow=overflow, coarse_g=res_c.g
+        assignment=assignment, group=group, overflow=overflow,
+        coarse_g=res_c.g, coarse_err=res_c.err,
     )
 
 
@@ -270,6 +277,67 @@ def chunked_hierarchical_assign(
         # 1/n_chunks of each node), so any chunk's coarse potentials are a
         # valid warm seed for the next solve; keep the last.
         coarse_g=res.coarse_g[-1],
+        coarse_err=res.coarse_err[-1],
+    )
+
+
+def chunked_hierarchical_assign_timed(
+    obj_feat: jax.Array,
+    node_feat: jax.Array,
+    node_capacity: jax.Array,
+    alive: jax.Array,
+    *,
+    n_groups: int,
+    n_chunks: int,
+    coarse_g_init: jax.Array | None = None,
+    **kw,
+) -> tuple[HierarchicalResult, list[float]]:
+    """:func:`chunked_hierarchical_assign` with per-chunk host timings.
+
+    The ``lax.map`` form runs every chunk inside ONE executable, so chunk
+    boundaries are invisible to the host; this twin loops the chunks on
+    the host instead, calling the SAME jitted :func:`hierarchical_assign`
+    per chunk (compile stays pinned to the chunk shape — the whole point
+    of chunking) and timing each dispatch+``block_until_ready`` cycle.
+    Identical inputs per chunk, so outputs match the ``lax.map`` form
+    exactly (``tests/test_hierarchical.py`` pins the parity); the first
+    chunk's timing includes the one-time compile, which is exactly the
+    compile-vs-execute signal SolveStats wants. The sync per chunk is a
+    single ``block_until_ready`` on a chained jit result — the pattern
+    CLAUDE.md's r4 wedge notes mark safe (sub-ms, unlike eager pulls).
+
+    Returns ``(result, chunk_ms)`` with one wall-ms entry per chunk.
+    """
+    import time as _time
+
+    n = obj_feat.shape[0]
+    assert n % n_chunks == 0, (n, n_chunks)
+    of = jnp.asarray(obj_feat).reshape(n_chunks, n // n_chunks, obj_feat.shape[1])
+    assignments: list[jax.Array] = []
+    groups: list[jax.Array] = []
+    overflow = jnp.zeros((), jnp.int32)
+    chunk_ms: list[float] = []
+    res = None
+    for c in range(n_chunks):
+        t0 = _time.perf_counter()
+        res = hierarchical_assign(
+            of[c], node_feat, node_capacity / n_chunks, alive,
+            n_groups=n_groups, coarse_g_init=coarse_g_init, **kw,
+        )
+        jax.block_until_ready(res.assignment)
+        chunk_ms.append(round((_time.perf_counter() - t0) * 1e3, 3))
+        assignments.append(res.assignment)
+        groups.append(res.group)
+        overflow = overflow + res.overflow
+    return (
+        HierarchicalResult(
+            assignment=jnp.concatenate(assignments),
+            group=jnp.concatenate(groups),
+            overflow=overflow,
+            coarse_g=res.coarse_g,
+            coarse_err=res.coarse_err,
+        ),
+        chunk_ms,
     )
 
 

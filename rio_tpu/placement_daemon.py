@@ -228,6 +228,27 @@ class PlacementDaemon:
                 apply_ms=round(float(getattr(stats_now, "apply_ms", 0.0) or 0.0), 3),
                 discarded=bool(getattr(stats_now, "discarded", False)),
             )
+            # Convergence detail (ISSUE 11): only fields the solve actually
+            # observed — -1 sentinels and zero-chunk counts stay off the
+            # wire so legacy readers see the same attrs they always did.
+            iters = int(getattr(stats_now, "solver_iters", 0) or 0)
+            if iters > 0:
+                attrs["solver_iters"] = iters
+            residual = float(getattr(stats_now, "residual", -1.0))
+            if residual >= 0.0:
+                attrs["residual"] = residual
+            warm = float(getattr(stats_now, "warm_ratio", -1.0))
+            if warm >= 0.0:
+                attrs["warm_ratio"] = round(warm, 4)
+            compile_ms = float(getattr(stats_now, "compile_ms", -1.0))
+            if compile_ms >= 0.0:
+                attrs["compile_ms"] = round(compile_ms, 3)
+                attrs["exec_ms"] = round(
+                    float(getattr(stats_now, "exec_ms", 0.0) or 0.0), 3
+                )
+            chunks = int(getattr(stats_now, "chunks", 0) or 0)
+            if chunks > 1:
+                attrs["chunks"] = chunks
         self.journal.record(SOLVE, epoch=epoch, **attrs)
 
     def _solve_epoch(self):

@@ -104,9 +104,15 @@ class Server:
         read_scale_config=None,
         load_monitor: bool = True,
         load_thresholds=None,
+        load_interval: float = 1.0,
         metrics: bool = True,
         journal: bool = True,
         journal_capacity: int = 4096,
+        timeseries: bool = True,
+        timeseries_capacity: int = 240,
+        timeseries_interval: float = 1.0,
+        health_watch: bool = True,
+        health_rules=None,
     ) -> None:
         if transport not in ("asyncio", "native", "auto"):
             raise ValueError(f"unknown transport {transport!r}")
@@ -219,12 +225,40 @@ class Server:
                 members_storage=self.members_storage,
                 placement=self.object_placement,
                 thresholds=load_thresholds,
+                interval=load_interval,
             )
             self.app_data.set(self.load_monitor)
             # Heartbeat pushes carry this node's encoded vector from now on.
             self.cluster_provider.set_load_source(
                 self.load_monitor.encoded_snapshot
             )
+        # Gauge time-series ring + trend alarms (rio_tpu/timeseries,
+        # rio_tpu/health): on by default — the sampler and HealthWatch tick
+        # ride the LoadMonitor loop (no new task, off without it), one
+        # bounded gauge-dict copy per ``timeseries_interval``. The node id
+        # is stamped at bind(); the alarm set defaults to
+        # ``health.default_rules()`` (``health_rules`` overrides).
+        self.timeseries = None
+        self.health_watch = None
+        if timeseries and self.load_monitor is not None:
+            from .timeseries import GaugeSeries
+
+            self.timeseries = GaugeSeries(
+                capacity=timeseries_capacity, interval=timeseries_interval
+            )
+            if health_watch:
+                from .health import HealthWatch
+
+                self.health_watch = HealthWatch(
+                    self.timeseries,
+                    journal=self.journal,
+                    exemplars=(
+                        self.metrics_registry.exemplars
+                        if self.metrics_registry is not None
+                        else None
+                    ),
+                    rules=health_rules,
+                )
 
     # ------------------------------------------------------------------
 
@@ -318,6 +352,8 @@ class Server:
             # Events recorded before bind (none today) would carry "";
             # everything from here on names this node in merged histories.
             self.journal.node = self._local_addr
+        if self.timeseries is not None:
+            self.timeseries.node = self._local_addr
         if self.migration_manager is None:
             # Wire the migration control plane: the coordinator in AppData
             # (service layer refusals + lifecycle restore find it there) and
@@ -336,8 +372,23 @@ class Server:
             self.app_data.set(self.migration_manager)
             self.registry.add_type(MigrationControl)
             self.registry.add_type(MigrationInbox)
-        from .admin import AdminControl, StatsSource
+        from .admin import AdminControl, SeriesSource, StatsSource
 
+        if self.timeseries is not None and SeriesSource not in self.app_data:
+
+            def _series_meta() -> dict:
+                meta: dict = {}
+                stats = getattr(self.object_placement, "stats", None)
+                mode = getattr(stats, "mode", "")
+                if mode:
+                    meta["solver_mode"] = str(mode)
+                if self.health_watch is not None:
+                    meta.update(self.health_watch.meta())
+                return meta
+
+            self.app_data.set(
+                SeriesSource(series=self.timeseries, meta=_series_meta)
+            )
         if StatsSource not in self.app_data:
             # The wire ops/observability endpoint every node answers for
             # (rio.Admin, node-scoped like the migration control plane).
@@ -484,6 +535,23 @@ class Server:
                         self._local_addr, self.journal.recorded,
                         self.journal.dropped,
                         "\n".join(format_event(e) for e in tail),
+                    )
+            if cmd.kind == AdminCommandKind.DUMP_SERIES:
+                # In-process twin of the rio.Admin DumpSeries wire scrape:
+                # dump the newest slice of the gauge ring to the log.
+                if self.timeseries is None:
+                    log.info("%s: AdminCommand::DumpSeries (timeseries off)",
+                             self._local_addr)
+                else:
+                    window = self.timeseries.window(limit=8)
+                    log.info(
+                        "%s: AdminCommand::DumpSeries (%d sampled, %d dropped)\n%s",
+                        self._local_addr, self.timeseries.sampled,
+                        self.timeseries.dropped,
+                        "\n".join(
+                            f"#{s.seq} @{s.wall_ts:.3f} {len(s.gauges)} gauges"
+                            for s in window
+                        ),
                     )
             if cmd.kind == AdminCommandKind.MIGRATE_OBJECT:
                 if self.migration_manager is not None:
@@ -636,6 +704,19 @@ class Server:
             asyncio.ensure_future(self._stopped.wait()),
         ]
         if self.load_monitor is not None:
+            if self.timeseries is not None:
+                # The series sampler (and HealthWatch, evaluating the window
+                # the sample just extended) ride the load loop's cadence —
+                # rate-limited inside GaugeSeries.tick, no new task.
+                from .otel import server_gauges
+
+                def _series_tick() -> None:
+                    if self.timeseries.tick(lambda: server_gauges(self)) is None:
+                        return
+                    if self.health_watch is not None:
+                        self.health_watch.tick()
+
+                self.load_monitor.tickers.append(_series_tick)
             tasks.append(asyncio.ensure_future(self.load_monitor.run()))
         if self.replication_manager is not None:
             tasks.append(asyncio.ensure_future(self.replication_manager.run()))

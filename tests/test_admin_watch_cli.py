@@ -1,0 +1,108 @@
+"""The ``watch`` operator CLI (ISSUE 11): trend table, --json, exit codes.
+
+``_watch_rows`` is a pure function over DumpSeries snapshots — the table
+the operator sees is asserted here on synthetic scrapes; the ``--demo``
+one-shots run the CLI exactly as tier-1 CI does.
+"""
+
+import asyncio
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from rio_tpu.admin import SeriesSnapshot, _cli_main, _format_watch, _watch_rows
+from rio_tpu.timeseries import SeriesSample
+
+
+def _snap(address: str, per_sample: list[dict], **meta) -> SeriesSnapshot:
+    rows = [
+        SeriesSample(seq=i + 1, wall_ts=float(i), mono_ts=float(i),
+                     node=address, gauges=g).to_row()
+        for i, g in enumerate(per_sample)
+    ]
+    return SeriesSnapshot(address=address, node_seq=len(rows), rows=rows,
+                          meta=meta)
+
+
+def test_watch_rows_trend_table_from_synthetic_scrape():
+    snapshots = [
+        _snap(
+            "10.0.0.2:9001",
+            [
+                {"rio.load.req_rate": 100.0, "rio.load.inflight": 4.0,
+                 "rio.load.sheds": 0.0, "rio.handler.Svc.Get.p99_ms": 2.0},
+                {"rio.load.req_rate": 100.0, "rio.load.inflight": 4.0,
+                 "rio.load.sheds": 0.0, "rio.handler.Svc.Get.p99_ms": 2.0},
+                {"rio.load.req_rate": 100.0, "rio.load.inflight": 4.0,
+                 "rio.load.sheds": 0.0, "rio.handler.Svc.Get.p99_ms": 2.0,
+                 "rio.handler.Svc.Put.p99_ms": 9.0},  # worst handler wins
+            ],
+            solver_mode="sinkhorn+delta",
+            alerts=["p99_rising:rio.handler.Svc.Put.p99_ms"],
+        ),
+        _snap(
+            "10.0.0.1:9001",
+            [
+                {"rio.load.req_rate": 50.0, "rio.load.inflight": 1.0,
+                 "rio.load.sheds": 0.0},
+                {"rio.load.req_rate": 80.0, "rio.load.inflight": 1.0,
+                 "rio.load.sheds": 3.0},
+            ],
+        ),
+    ]
+    rows = _watch_rows(snapshots)
+    # Sorted by address, regardless of scrape order.
+    assert [r["address"] for r in rows] == ["10.0.0.1:9001", "10.0.0.2:9001"]
+    quiet, busy = rows
+    assert busy["rate"] == 100.0 and busy["rate_trend"] == "→"
+    assert busy["p99_ms"] == 9.0 and busy["p99_trend"] == "↑"
+    assert busy["solver_mode"] == "sinkhorn+delta"
+    assert busy["alerts"] == ["p99_rising:rio.handler.Svc.Put.p99_ms"]
+    assert quiet["rate"] == 80.0 and quiet["rate_trend"] == "↑"
+    assert quiet["sheds"] == 3.0 and quiet["sheds_trend"] == "↑"
+    assert quiet["p99_ms"] == 0.0  # no handler gauges at all
+    assert quiet["solver_mode"] == "-"
+    # The rendered table carries every row and the alert label.
+    table = _format_watch(rows)
+    assert "10.0.0.1:9001" in table and "10.0.0.2:9001" in table
+    assert "p99_rising:rio.handler.Svc.Put.p99_ms" in table
+    assert "sinkhorn+delta" in table
+
+
+def test_watch_rows_tolerate_empty_snapshot():
+    rows = _watch_rows([SeriesSnapshot(address="n:1")])
+    assert rows[0]["samples"] == 0
+    assert rows[0]["rate"] == 0.0 and rows[0]["rate_trend"] == "→"
+    _format_watch(rows)  # renders without raising
+
+
+def test_watch_demo_once_prints_trend_table(capsys):
+    assert asyncio.run(_cli_main(["watch", "--demo", "--once"])) == 0
+    out = capsys.readouterr().out
+    assert "node" in out and "p99_ms" in out and "alerts" in out
+    # Two demo nodes, each with a live sample window.
+    body = [l for l in out.splitlines() if l.startswith("127.0.0.1:")]
+    assert len(body) == 2
+
+
+def test_watch_demo_json_is_machine_readable(capsys):
+    assert asyncio.run(_cli_main(["--demo", "watch", "--json"])) == 0
+    rows = json.loads(capsys.readouterr().out)
+    assert len(rows) == 2
+    for row in rows:
+        assert row["samples"] > 0
+        assert {"address", "rate", "p99_ms", "inflight", "sheds",
+                "solver_mode", "alerts"} <= set(row)
+
+
+def test_unreachable_cluster_exits_1(capsys):
+    assert asyncio.run(_cli_main(["--nodes", "127.0.0.1:1", "watch",
+                                  "--once"])) == 1
+    assert asyncio.run(_cli_main(["--nodes", "127.0.0.1:1", "tail"])) == 1
+
+
+def test_explain_without_subject_exits_2(capsys):
+    assert asyncio.run(_cli_main(["--nodes", "127.0.0.1:1", "explain"])) == 2
+    assert "missing TYPE ID" in capsys.readouterr().out

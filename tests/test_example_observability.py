@@ -29,6 +29,9 @@ def test_observability_example_end_to_end():
     assert result["tail"] > 0
     assert result["explain"] >= 5  # assign + pin/snapshot/install(s)/flip
     assert result["traces"] >= 1
+    # Trend plane: every node answered DumpSeries with a real window.
+    assert result["series_nodes"] == 2
+    assert result["series_samples"] > 0
 
 
 def test_admin_cli_demo_modes(capsys):

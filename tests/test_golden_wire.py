@@ -290,3 +290,83 @@ def test_dump_events_frame_golden():
     back = codec.deserialize(codec.serialize(snapshot), EventsSnapshot)
     assert [e.seq for e in back.events()] == [8, 9]
     assert back.events()[0].attrs == {"target": "10.0.0.2:5000"}
+
+
+def test_dump_series_frame_golden():
+    """Pin the rio.Admin time-series-scrape frames byte for byte.
+
+    DUMP_SERIES is the second operator-facing admin scrape (the ``watch``
+    CLI and trend tooling speak it to arbitrary-version nodes); the
+    request envelope and the SeriesSnapshot response — including the
+    positional SeriesSample row shape — are a compatibility contract:
+    rows may only ever GROW by appending trailing fields
+    (SeriesSample.from_row tolerates short rows; see MIGRATING.md).
+    """
+    from rio_tpu import codec
+    from rio_tpu.admin import ADMIN_TYPE, DumpSeries, SeriesSnapshot
+    from rio_tpu.protocol import (
+        RequestEnvelope,
+        ResponseEnvelope,
+        encode_request_frame,
+        encode_response_frame,
+    )
+    from rio_tpu.timeseries import SeriesSample
+
+    request = encode_request_frame(
+        RequestEnvelope(
+            handler_type=ADMIN_TYPE,
+            handler_id="10.0.0.1:5000",
+            message_type="rio.DumpSeries",
+            payload=codec.serialize(
+                DumpSeries(
+                    names=["rio.load.", "rio.handler.Svc.Get.p99_ms"],
+                    since_seq=3,
+                    limit=120,
+                )
+            ),
+        )
+    )
+    snapshot = SeriesSnapshot(
+        address="10.0.0.1:5000",
+        node_seq=5,
+        dropped=2,
+        rows=[
+            SeriesSample(
+                seq=4,
+                wall_ts=FROZEN_TIME,
+                mono_ts=41.5,
+                node="10.0.0.1:5000",
+                gauges={"rio.load.inflight": 3.0, "rio.load.sheds": 0.0},
+            ).to_row(),
+            SeriesSample(
+                seq=5,
+                wall_ts=FROZEN_TIME + 1.0,
+                mono_ts=42.5,
+                node="10.0.0.1:5000",
+                gauges={"rio.load.inflight": 5.0, "rio.load.sheds": 1.0},
+            ).to_row(),
+        ],
+        meta={"solver_mode": "sinkhorn+delta", "alerts": []},
+    )
+    response = encode_response_frame(
+        ResponseEnvelope(body=codec.serialize(snapshot))
+    )
+
+    def hexdump(label: str, frame: bytes) -> list[str]:
+        lines = [f"== {label} ({len(frame)} bytes)"]
+        for off in range(0, len(frame), 16):
+            chunk = frame[off : off + 16]
+            lines.append(f"{off:04x}  {chunk.hex(' ')}")
+        return lines
+
+    text = "\n".join(hexdump("dump_series.request", request)
+                     + hexdump("dump_series.response", response)) + "\n"
+    _assert_golden("dump_series_frames.txt", text)
+
+    back = codec.deserialize(codec.serialize(snapshot), SeriesSnapshot)
+    assert [s.seq for s in back.samples()] == [4, 5]
+    assert back.samples()[1].gauges["rio.load.sheds"] == 1.0
+    assert back.meta["solver_mode"] == "sinkhorn+delta"
+    # Tolerant decode: a short legacy row (no gauges) still parses.
+    legacy = SeriesSample.from_row([1, FROZEN_TIME, 40.0])
+    assert legacy.seq == 1 and legacy.node == "" and legacy.gauges == {}

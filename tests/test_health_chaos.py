@@ -1,0 +1,246 @@
+"""Chaos: injected rising handler latency must raise a HEALTH alarm.
+
+ISSUE 11 acceptance: a cluster whose p99 is quietly climbing — the r4/r5
+"degrades before it fails" signature — must journal a ``HEALTH`` event
+within the rule's K windows, naming the offending gauge and carrying an
+exemplar trace id that links the alarm to one actual slow request.
+
+The tier-1 variant drives the sampler deterministically (one sample per
+injection round, through the server's REAL gauge scrape, ring, rule
+engine, journal, and exemplar registry) and pins the alarm to exactly
+the K-th rising window. The ``slow`` soak runs the whole loop live —
+LoadMonitor-cadenced sampling included — with the sample interval sized
+above the longest injected request so every window sees the risen p99
+(the RED histogram's po2-bucketed quantile is flat between crossings;
+a sample taken mid-request would reset the strictly-rising streak).
+"""
+
+import asyncio
+
+import pytest
+
+from rio_tpu import AppData, ObjectId, Registry, ServiceObject, handler, message, tracing
+from rio_tpu.health import TrendRule, default_rules
+from rio_tpu.journal import HEALTH
+from rio_tpu.registry import type_id
+
+from .server_utils import Cluster, run_integration_test
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    tracing.clear_sinks()
+    tracing.set_sample_rate(1.0)  # exemplars need sampled traces
+    yield
+    tracing.clear_sinks()
+    tracing.set_sample_rate(0.0)
+
+
+@message(name="chaos.Lag")
+class Lag:
+    delay_ms: float = 0.0
+
+
+@message(name="chaos.Done")
+class Done:
+    trace_id: str = ""
+
+
+class Laggy(ServiceObject):
+    @handler
+    async def lag(self, msg: Lag, ctx: AppData) -> Done:
+        await asyncio.sleep(msg.delay_ms / 1000.0)
+        return Done(trace_id=tracing.current_trace_id() or "")
+
+
+def build_registry() -> Registry:
+    return Registry().add_type(Laggy)
+
+
+def _health_events(cluster: Cluster, rule: str):
+    return [
+        e
+        for s in cluster.servers
+        if s.journal is not None
+        for e in s.journal.events(kinds=[HEALTH])
+        if e.key == rule
+    ]
+
+
+async def _seated_server(cluster: Cluster, object_id: str):
+    addr = await cluster.placement.lookup(ObjectId(type_id(Laggy), object_id))
+    return next(s for s in cluster.servers if s.local_address == addr)
+
+
+def _assert_alarm(events, rule: str, windows: int) -> None:
+    assert events, f"no {rule} HEALTH event within the injection budget"
+    ev = events[0]
+    assert ev.kind == HEALTH and ev.key == rule
+    # The alarm names the exact gauge that degraded...
+    assert ev.attrs["gauge"].startswith("rio.handler.")
+    assert ev.attrs["gauge"].endswith(".p99_ms")
+    assert ev.attrs["windows"] == windows
+    assert ev.attrs["value"] > 0.0
+    assert "rose" in ev.attrs["detail"]
+    # ...and carries the exemplar trace of one real slow request.
+    assert len(ev.trace_id) == 32
+
+
+def test_rising_p99_fires_health_alarm_at_kth_window():
+    windows = 3
+
+    async def body(cluster: Cluster):
+        from rio_tpu.otel import server_gauges
+
+        client = cluster.client()
+        try:
+            # One injection round per sample window: burst at the round's
+            # delay, then take THE window's sample on every node (the
+            # server's real gauge scrape feeding its real ring + engine).
+            for round_no, delay in enumerate([1.0, 4.0, 16.0, 40.0], 1):
+                await asyncio.gather(*[
+                    client.send(Laggy, "hot", Lag(delay_ms=delay),
+                                returns=Done)
+                    for _ in range(4)
+                ])
+                for s in cluster.servers:
+                    s.timeseries.sample(server_gauges(s))
+                    s.health_watch.tick()
+                if round_no <= windows:  # round 1 is the baseline window
+                    assert _health_events(cluster, "p99_rising") == [], (
+                        f"alarm before {windows} full rising windows"
+                    )
+            # The K-th rising window (round windows+1) fired the alarm.
+            _assert_alarm(
+                _health_events(cluster, "p99_rising"), "p99_rising", windows
+            )
+            seated = await _seated_server(cluster, "hot")
+            g = server_gauges(seated)
+            assert g["rio.health.alerts_total"] >= 1.0
+            assert g["rio.health.alert.p99_rising"] == 1.0
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_registry,
+            num_servers=2,
+            server_kwargs=dict(
+                # Keep the live sampler out of the way (its boot sample has
+                # no handler gauges yet, so it can't perturb the streak).
+                timeseries_interval=3600.0,
+                health_rules=[
+                    TrendRule(
+                        name="p99_rising",
+                        gauge="rio.handler.*.p99_ms",
+                        kind="rising",
+                        windows=windows,
+                        min_delta=0.1,
+                        cooldown=3,
+                    )
+                ],
+            ),
+        )
+    )
+
+
+def test_steady_latency_stays_quiet():
+    """The control: flat (even slow-ish) latency must NOT alarm — the
+    rules alarm on trends, not levels."""
+
+    async def body(cluster: Cluster):
+        from rio_tpu.otel import server_gauges
+
+        client = cluster.client()
+        try:
+            for _ in range(8):
+                await client.send(Laggy, "flat", Lag(delay_ms=5.0),
+                                  returns=Done)
+                for s in cluster.servers:
+                    s.timeseries.sample(server_gauges(s))
+                    s.health_watch.tick()
+        finally:
+            client.close()
+        assert _health_events(cluster, "p99_rising") == []
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_registry,
+            num_servers=2,
+            server_kwargs=dict(
+                timeseries_interval=3600.0,
+                health_rules=[
+                    TrendRule(
+                        name="p99_rising",
+                        gauge="rio.handler.*.p99_ms",
+                        kind="rising",
+                        windows=3,
+                        min_delta=0.5,
+                    )
+                ],
+            ),
+        )
+    )
+
+
+@pytest.mark.slow
+def test_rising_p99_soak_fires_stock_rules_on_live_sampler():
+    """The same chaos fully live: the LoadMonitor-cadenced sampler takes
+    the windows, ``default_rules()`` evaluates them, and the stock
+    p99_rising rule catches the degradation. The injected delay doubles
+    once per OBSERVED sample window and stays under the 0.5 s sample
+    interval, so every live window sees a risen (new-bucket) p99."""
+    interval = 0.5
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        try:
+            await client.send(Laggy, "hot", Lag(delay_ms=1.0), returns=Done)
+            seated = await _seated_server(cluster, "hot")
+            delay = 2.0
+            deadline = asyncio.get_event_loop().time() + 45.0
+            while (
+                not _health_events(cluster, "p99_rising")
+                and delay <= 320.0
+                and asyncio.get_event_loop().time() < deadline
+            ):
+                await client.send(Laggy, "hot", Lag(delay_ms=delay),
+                                  returns=Done)
+                # Wait for the live sampler to take this round's window.
+                target = seated.timeseries.sampled + 1
+                while (
+                    seated.timeseries.sampled < target
+                    and asyncio.get_event_loop().time() < deadline
+                ):
+                    await asyncio.sleep(0.02)
+                delay *= 2.0
+            _assert_alarm(
+                _health_events(cluster, "p99_rising"), "p99_rising", 3
+            )
+            # The alarm surfaces on the scrape plane of the node that fired.
+            from rio_tpu.otel import server_gauges
+
+            fired = [
+                s for s in cluster.servers
+                if s.health_watch is not None and s.health_watch.fired_total
+            ]
+            assert fired
+            assert server_gauges(fired[0])["rio.health.alerts_total"] >= 1.0
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_registry,
+            num_servers=2,
+            timeout=60.0,
+            server_kwargs=dict(
+                load_interval=0.05,
+                timeseries_interval=interval,
+                health_rules=default_rules(),
+            ),
+        )
+    )

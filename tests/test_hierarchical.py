@@ -276,3 +276,35 @@ def test_chunked_hierarchical_matches_flat_quality():
     q_chunk = on[np.arange(n), a].mean()
     spread = on.std()
     assert q_chunk >= q_flat - 0.02 * spread
+
+
+def test_chunked_timed_twin_matches_lax_map_form_exactly():
+    """The host-loop twin (``chunked_hierarchical_assign_timed``) calls
+    the SAME jitted per-chunk solve the ``lax.map`` form runs, so its
+    outputs are bit-identical — and it yields the per-chunk wall timings
+    SolveStats banks (ISSUE 11 solver telemetry)."""
+    from rio_tpu.parallel.hierarchical import (
+        chunked_hierarchical_assign,
+        chunked_hierarchical_assign_timed,
+    )
+
+    n, d, m, g, chunks = 256, 8, 8, 4, 4
+    obj, node = _features(jax.random.PRNGKey(7), n, d, m)
+    cap = jnp.ones((m,), jnp.float32)
+    alive = jnp.ones((m,), jnp.float32).at[3].set(0.0)
+
+    mapped = chunked_hierarchical_assign(
+        obj, node, cap, alive, n_groups=g, n_chunks=chunks
+    )
+    timed, chunk_ms = chunked_hierarchical_assign_timed(
+        obj, node, cap, alive, n_groups=g, n_chunks=chunks
+    )
+    assert np.array_equal(np.asarray(mapped.assignment),
+                          np.asarray(timed.assignment))
+    assert np.array_equal(np.asarray(mapped.group), np.asarray(timed.group))
+    assert int(mapped.overflow) == int(timed.overflow)
+    assert len(chunk_ms) == chunks
+    assert all(ms > 0.0 for ms in chunk_ms)
+    # The first chunk pays the one-time compile — the compile-vs-execute
+    # signal the telemetry wants is visible in the timings themselves.
+    assert chunk_ms[0] >= max(chunk_ms[1:])

@@ -744,13 +744,17 @@ async def test_hierarchical_rebalance_chunks_above_threshold(monkeypatch):
 
     monkeypatch.setattr(jp_mod, "_HIER_CHUNK_ROWS", 512)
     calls = {"n_chunks": None}
-    real = hier_mod.chunked_hierarchical_assign
+    # The placement routes through the timed host-loop twin by default
+    # (RIO_TPU_CHUNK_TIMING=1) and the lax.map form when it's off; spy on
+    # both so the test pins the routing, not the timing flavor.
+    for name in ("chunked_hierarchical_assign", "chunked_hierarchical_assign_timed"):
+        real = getattr(hier_mod, name)
 
-    def spy(*args, **kw):
-        calls["n_chunks"] = kw.get("n_chunks")
-        return real(*args, **kw)
+        def spy(*args, _real=real, **kw):
+            calls["n_chunks"] = kw.get("n_chunks")
+            return _real(*args, **kw)
 
-    monkeypatch.setattr(hier_mod, "chunked_hierarchical_assign", spy)
+        monkeypatch.setattr(hier_mod, name, spy)
 
     p = JaxObjectPlacement(mode="hierarchical", n_iters=10)
     members = [f"10.31.0.{i}:70" for i in range(6)]

@@ -378,6 +378,13 @@ def test_admin_request_bridges_to_admin_queue():
             ADMIN_TYPE, target, AdminRequest(kind="no_such_kind"), returns=AdminAck
         )
         assert not ack.ok and "no_such_kind" in ack.detail
+        # The new DUMP_SERIES enum value is a known kind: the admin bridge
+        # accepts it (the unknown-kind ack above stays reserved for truly
+        # unknown strings, even as the enum grows).
+        ack = await client.send(
+            ADMIN_TYPE, target, AdminRequest(kind="dump_series"), returns=AdminAck
+        )
+        assert ack.ok
         client.close()
 
     asyncio.run(
@@ -556,3 +563,43 @@ def test_internal_client_send_carries_trace_ctx():
         assert await task == b"done"
 
     asyncio.run(main())
+
+
+def test_otel_auto_registration_picks_up_health_gauges():
+    """ISSUE 11: the rio.series.* sampler counters and rio.health.* alarm
+    gauges ride the same server_gauges snapshot the OTLP bridge scrapes —
+    the observable-gauge re-scan registers them with zero new wiring."""
+    from . import fake_otel
+    from rio_tpu.otel import otlp_metrics_exporter, server_gauges
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        handle = fake_otel.install()
+        try:
+            server = cluster.servers[0]
+            provider = otlp_metrics_exporter(
+                lambda: server_gauges(server), interval=9999.0
+            )
+            exporter = handle.metric_exporters[-1]
+            await client.send(Observed, "g3", Hit(), returns=Echo)
+            provider.force_flush()
+            provider.force_flush()
+            exported = exporter.exported[-1]
+            for name in ("samples", "dropped", "ring_occupancy",
+                         "ring_capacity"):
+                assert f"rio.series.{name}" in exported
+            for name in ("rules", "alerts_active", "alerts_total"):
+                assert f"rio.health.{name}" in exported
+            # Each stock rule exports its own 0/1 alarm gauge.
+            from rio_tpu.health import default_rules
+
+            for rule in default_rules():
+                assert f"rio.health.alert.{rule.name}" in exported
+            assert exported["rio.health.rules"] == float(len(default_rules()))
+        finally:
+            fake_otel.uninstall(handle)
+            client.close()
+
+    asyncio.run(
+        run_integration_test(body, registry_builder=build_registry, num_servers=2)
+    )

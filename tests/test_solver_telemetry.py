@@ -1,0 +1,120 @@
+"""Solver convergence telemetry (ISSUE 11 tentpole): every solve path
+records how hard it worked — iterations, final residual, warm-start
+ratio, compile-vs-execute split, chunk timings — on ``SolveStats``.
+
+Acceptance: telemetry present for full AND ``+delta`` solves, including
+the mesh-sharded path on the 8-virtual-device CPU mesh (conftest).
+"""
+
+import pytest
+
+from rio_tpu import ObjectId
+from rio_tpu.object_placement.jax_placement import JaxObjectPlacement
+
+
+class _Member:
+    def __init__(self, address: str, active: bool = True) -> None:
+        self.address = address
+        self.active = active
+
+
+def _members(n, dead=()):
+    return [_Member(f"10.8.0.{i}:5000", i not in dead) for i in range(n)]
+
+
+async def _seeded(n_obj, n_nodes, **kw):
+    p = JaxObjectPlacement(node_axis_size=n_nodes, **kw)
+    p.sync_members(_members(n_nodes))
+    await p.assign_batch([ObjectId("T", str(i)) for i in range(n_obj)])
+    await p.rebalance(delta=False)
+    return p
+
+
+def _assert_converged(stats, *, residual=True):
+    assert stats.solver_iters > 0
+    if residual:
+        assert stats.residual >= 0.0
+    # The compile listener is jax-version dependent; when it IS available
+    # both halves of the split are present (exec clamps at 0 — nested
+    # compile durations can slightly exceed the timed solve region).
+    if stats.compile_ms >= 0.0:
+        assert stats.exec_ms >= 0.0
+    else:
+        assert stats.exec_ms == -1.0
+
+
+@pytest.mark.parametrize("mode", ["sinkhorn", "scaling"])
+async def test_full_solve_records_convergence(mode):
+    p = await _seeded(256, 4, mode=mode, n_iters=12)
+    stats = p.stats
+    # Small populations collapse to the class-level solve; either way the
+    # configured solver ran and reported its convergence.
+    assert stats.mode in (mode, f"{mode}+collapsed")
+    _assert_converged(stats)
+    assert stats.solver_iters == 12
+    # A converged fixed-point solve leaves a tiny column-marginal violation.
+    assert stats.residual < 1e-2
+    # Full solves don't warm-start: the field reads "cold/not applicable".
+    assert stats.warm_ratio <= 0.0
+
+
+async def test_delta_solve_records_warm_start_ratio():
+    p = await _seeded(512, 8, mode="sinkhorn", n_iters=12)
+    p.sync_members(_members(8, dead={0}))
+    await p.rebalance()
+    stats = p.stats
+    assert stats.mode == "sinkhorn+delta"
+    _assert_converged(stats)
+    # The delta warm-starts from the committed plan's potentials: the seed
+    # coverage is a real fraction, not the -1 "n/a" sentinel.
+    assert 0.0 <= stats.warm_ratio <= 1.0
+
+
+async def test_hierarchical_solve_records_coarse_plus_fine_iters():
+    p = await _seeded(256, 4, mode="hierarchical", n_iters=8)
+    stats = p.stats
+    assert stats.mode == "hierarchical"
+    # Two stacked solves (coarse groups, then fine within groups).
+    assert stats.solver_iters == 16
+    _assert_converged(stats, residual=False)
+
+
+async def test_mesh_sharded_solve_records_convergence():
+    """The acceptance path: a sharded solve over the 8-virtual-device CPU
+    mesh still reports its convergence telemetry."""
+    from rio_tpu.parallel import make_mesh
+
+    mesh = make_mesh()
+    p = JaxObjectPlacement(mode="sinkhorn", n_iters=10, mesh=mesh)
+    members = [f"10.8.1.{i}:70" for i in range(6)]
+    p.sync_members(members)
+    await p.assign_batch([ObjectId("MeshT", str(i)) for i in range(700)])
+    await p.rebalance()
+    stats = p.stats
+    assert stats.mode == "sinkhorn"
+    _assert_converged(stats, residual=False)
+    assert stats.solver_iters == 10
+
+
+async def test_greedy_solve_reports_no_iterations():
+    """Non-iterative modes must not fake convergence numbers."""
+    p = await _seeded(128, 4, mode="greedy")
+    assert p.stats.solver_iters == 0
+    assert p.stats.residual == -1.0
+
+
+async def test_history_gauges_carry_convergence_trend():
+    p = await _seeded(512, 8, mode="sinkhorn", n_iters=12)
+    p.sync_members(_members(8, dead={0}))
+    await p.rebalance()
+    g = p.stats.history_gauges()
+    assert g["rio.placement_solve.history.residual_last"] >= 0.0
+    assert (
+        g["rio.placement_solve.history.residual_max"]
+        >= g["rio.placement_solve.history.residual_last"]
+    )
+    if p.stats.compile_ms >= 0.0 or any(
+        s.compile_ms >= 0.0 for s in p.stats.history
+    ):
+        assert g["rio.placement_solve.history.compile_ms_total"] >= 0.0
+    assert g["rio.placement_solve.history.delta_fraction"] > 0.0

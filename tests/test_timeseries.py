@@ -1,0 +1,321 @@
+"""Gauge time-series ring + HealthWatch trend rules (ISSUE 11 tentpole).
+
+Pure host-side units: the ring's overwrite/window/projection contract,
+the wire row's tolerant decode, the shared trend helpers, and the
+HealthWatch rule kinds (rising / delta / drift) with journal fire,
+cooldown, and exemplar-trace attach.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from rio_tpu.health import HealthAlert, HealthWatch, TrendRule, default_rules
+from rio_tpu.journal import HEALTH, Journal
+from rio_tpu.timeseries import (
+    GaugeSeries,
+    SeriesSample,
+    merge_series,
+    rising_streak,
+    series_values,
+    trend_arrow,
+)
+
+# ---------------------------------------------------------------------------
+# GaugeSeries ring
+# ---------------------------------------------------------------------------
+
+
+def test_ring_overwrites_oldest_and_counts_dropped():
+    s = GaugeSeries(capacity=4, node="n1")
+    for i in range(6):
+        s.sample({"g": float(i)})
+    assert s.sampled == 6
+    assert len(s) == 4
+    assert s.dropped == 2
+    window = s.window()
+    assert [x.seq for x in window] == [3, 4, 5, 6]
+    assert all(x.node == "n1" for x in window)
+    # seq stays gap-free and monotonic across overwrite.
+    assert [x.gauges["g"] for x in window] == [2.0, 3.0, 4.0, 5.0]
+
+
+def test_window_projection_since_seq_and_limit():
+    s = GaugeSeries(capacity=16)
+    for i in range(8):
+        s.sample(
+            {
+                "rio.load.req_rate": float(i),
+                "rio.load.sheds": 0.0,
+                "rio.handler.Svc.Get.p99_ms": 1.0 + i,
+                "other": 9.0,
+            }
+        )
+    # Exact name + prefix (trailing ".") projection.
+    win = s.window(names=["rio.load.req_rate", "rio.handler."])
+    assert len(win) == 8
+    assert set(win[-1].gauges) == {
+        "rio.load.req_rate",
+        "rio.handler.Svc.Get.p99_ms",
+    }
+    # since_seq is exclusive and resumable.
+    assert [x.seq for x in s.window(since_seq=5)] == [6, 7, 8]
+    # limit keeps the NEWEST samples (a tail, not a head).
+    assert [x.seq for x in s.window(limit=3)] == [6, 7, 8]
+    assert [x.seq for x in s.window(since_seq=2, limit=2)] == [7, 8]
+
+
+def test_tick_is_rate_limited_by_interval():
+    s = GaugeSeries(capacity=8, interval=3600.0)
+    assert s.tick(lambda: {"g": 1.0}) is not None
+    # Second tick inside the interval records nothing (and must not even
+    # evaluate the read callback's result into the ring).
+    assert s.tick(lambda: {"g": 2.0}) is None
+    assert s.sampled == 1
+
+
+def test_sample_row_round_trip_and_tolerant_decode():
+    s = SeriesSample(seq=7, wall_ts=123.5, mono_ts=9.25, node="a:1",
+                     gauges={"g": 2.0})
+    assert SeriesSample.from_row(s.to_row()) == s
+    # Short legacy row: missing trailing fields default.
+    short = SeriesSample.from_row([3, 11.0])
+    assert (short.seq, short.wall_ts, short.node, short.gauges) == (
+        3, 11.0, "", {})
+    # A newer sender's extra trailing fields are ignored.
+    extended = SeriesSample.from_row(s.to_row() + ["future", {"x": 1}])
+    assert extended == s
+
+
+def test_merge_series_orders_by_wall_clock_then_node():
+    a = [SeriesSample(1, 10.0, 0, "a", {}), SeriesSample(2, 30.0, 0, "a", {})]
+    b = [SeriesSample(1, 20.0, 0, "b", {}), SeriesSample(2, 30.0, 0, "b", {})]
+    merged = merge_series([a, b])
+    assert [(s.node, s.seq) for s in merged] == [
+        ("a", 1), ("b", 1), ("a", 2), ("b", 2)]
+
+
+def test_series_gauges_scrape_keys():
+    s = GaugeSeries(capacity=4)
+    s.sample({})
+    g = s.gauges()
+    assert g["rio.series.samples"] == 1.0
+    assert g["rio.series.dropped"] == 0.0
+    assert g["rio.series.ring_occupancy"] == 1.0
+    assert g["rio.series.ring_capacity"] == 4.0
+
+
+# ---------------------------------------------------------------------------
+# trend helpers
+# ---------------------------------------------------------------------------
+
+
+def test_rising_streak_and_min_delta():
+    assert rising_streak([1, 2, 3, 4]) == 3
+    assert rising_streak([5, 1, 2, 3]) == 2
+    assert rising_streak([3, 2, 1]) == 0
+    assert rising_streak([1]) == 0
+    # The jitter floor: +0.4 steps don't count against min_delta=0.5.
+    assert rising_streak([1.0, 1.4, 1.8], min_delta=0.5) == 0
+    assert rising_streak([1.0, 2.0, 3.1], min_delta=0.5) == 2
+
+
+def test_trend_arrow_dead_band():
+    assert trend_arrow([10, 10, 10, 10.2]) == "→"  # within ±5% of mean
+    assert trend_arrow([10, 10, 10, 12]) == "↑"
+    assert trend_arrow([10, 10, 10, 8]) == "↓"
+    assert trend_arrow([5.0]) == "→"
+    assert trend_arrow([]) == "→"
+
+
+def test_series_values_skips_samples_missing_the_gauge():
+    samples = [
+        SeriesSample(1, 1.0, 0, "n", {"a": 1.0}),
+        SeriesSample(2, 2.0, 0, "n", {"b": 5.0}),
+        SeriesSample(3, 3.0, 0, "n", {"a": 2.0}),
+    ]
+    assert series_values(samples, "a") == [1.0, 2.0]
+
+
+# ---------------------------------------------------------------------------
+# HealthWatch
+# ---------------------------------------------------------------------------
+
+
+def _fed_series(values_by_gauge: dict[str, list[float]]) -> GaugeSeries:
+    """A ring pre-fed column-wise: one sample per index across gauges."""
+    n = max(len(v) for v in values_by_gauge.values())
+    s = GaugeSeries(capacity=max(8, n), node="n1")
+    for i in range(n):
+        s.sample({k: v[i] for k, v in values_by_gauge.items() if i < len(v)})
+    return s
+
+
+def test_rising_rule_fires_and_journals_health_event():
+    series = _fed_series({"rio.load.loop_lag_ms": [1.0, 2.0, 3.0, 4.0]})
+    journal = Journal(node="n1")
+    hw = HealthWatch(
+        series,
+        journal=journal,
+        rules=[TrendRule(name="lag", gauge="rio.load.loop_lag_ms",
+                         kind="rising", windows=3, min_delta=0.5)],
+    )
+    active = hw.tick()
+    assert [a.rule for a in active] == ["lag"]
+    assert active[0].gauge == "rio.load.loop_lag_ms"
+    assert active[0].value == 4.0
+    assert hw.fired_total == 1
+    events = [e for e in journal.events() if e.kind == HEALTH]
+    assert len(events) == 1
+    assert events[0].key == "lag"
+    assert events[0].attrs["gauge"] == "rio.load.loop_lag_ms"
+    assert events[0].attrs["windows"] == 3
+    # Scrape + meta surfaces agree.
+    g = hw.gauges()
+    assert g["rio.health.alerts_active"] == 1.0
+    assert g["rio.health.alert.lag"] == 1.0
+    assert hw.meta() == {"alerts": ["lag:rio.load.loop_lag_ms"]}
+
+
+def test_rising_rule_respects_jitter_floor():
+    series = _fed_series({"g": [1.0, 1.1, 1.2, 1.3]})  # rising, but tiny
+    hw = HealthWatch(series, rules=[
+        TrendRule(name="r", gauge="g", kind="rising", windows=3,
+                  min_delta=0.5)])
+    assert hw.tick() == []
+    assert hw.gauges()["rio.health.alert.r"] == 0.0
+
+
+def test_delta_rule_fires_on_counter_growth():
+    series = _fed_series({"rio.load.sheds": [0.0, 0.0, 2.0, 5.0]})
+    hw = HealthWatch(series, rules=[
+        TrendRule(name="sheds", gauge="rio.load.sheds", kind="delta",
+                  windows=3)])
+    active = hw.tick()
+    assert [a.rule for a in active] == ["sheds"]
+    assert "+5" in active[0].detail
+
+
+def test_drift_rule_needs_factor_and_absolute_floor():
+    # 3x the mean but under the 5-unit absolute floor: no fire.
+    quiet = _fed_series({"g": [1.0, 1.0, 1.0, 3.0]})
+    hw = HealthWatch(quiet, rules=[
+        TrendRule(name="d", gauge="g", kind="drift", windows=3, factor=2.0,
+                  min_delta=5.0)])
+    assert hw.tick() == []
+    # Over both the factor and the floor: fires.
+    loud = _fed_series({"g": [10.0, 10.0, 10.0, 40.0]})
+    hw = HealthWatch(loud, rules=[
+        TrendRule(name="d", gauge="g", kind="drift", windows=3, factor=2.0,
+                  min_delta=5.0)])
+    active = hw.tick()
+    assert [a.rule for a in active] == ["d"]
+
+
+def test_unknown_rule_kind_is_a_noop():
+    series = _fed_series({"g": [1.0, 2.0, 3.0, 4.0]})
+    hw = HealthWatch(series, rules=[
+        TrendRule(name="x", gauge="g", kind="quantum")])
+    assert hw.tick() == []
+
+
+def test_cooldown_rate_limits_journal_refires():
+    series = _fed_series({"g": [1.0, 2.0, 3.0, 4.0]})
+    journal = Journal(node="n1")
+    hw = HealthWatch(series, journal=journal, rules=[
+        TrendRule(name="r", gauge="g", kind="rising", windows=3,
+                  cooldown=3)])
+    hw.tick()
+    assert hw.fired_total == 1
+    # Condition persists over the next two samples: still active, no refire.
+    series.sample({"g": 5.0})
+    series.sample({"g": 6.0})
+    assert len(hw.tick()) == 1
+    assert hw.fired_total == 1
+    # A third sample clears the cooldown window: refires.
+    series.sample({"g": 7.0})
+    hw.tick()
+    assert hw.fired_total == 2
+    assert len([e for e in journal.events() if e.kind == HEALTH]) == 2
+
+
+def test_handler_latency_alert_attaches_exemplar_trace():
+    series = _fed_series(
+        {"rio.handler.Svc.Get.p99_ms": [1.0, 2.0, 3.0, 4.0]})
+    journal = Journal(node="n1")
+    hw = HealthWatch(
+        series,
+        journal=journal,
+        exemplars=lambda: {"Svc.Get": "0af7651916cd43dd8448eb211c80319c"},
+        rules=[TrendRule(name="p99", gauge="rio.handler.*.p99_ms",
+                         kind="rising", windows=3, min_delta=0.5)],
+    )
+    active = hw.tick()
+    assert active[0].trace_id == "0af7651916cd43dd8448eb211c80319c"
+    ev = [e for e in journal.events() if e.kind == HEALTH][0]
+    assert ev.trace_id == "0af7651916cd43dd8448eb211c80319c"
+
+
+def test_exemplar_lookup_failure_never_blocks_the_alert():
+    series = _fed_series(
+        {"rio.handler.Svc.Get.p99_ms": [1.0, 2.0, 3.0, 4.0]})
+
+    def boom():
+        raise RuntimeError("registry gone")
+
+    hw = HealthWatch(series, exemplars=boom, rules=[
+        TrendRule(name="p99", gauge="rio.handler.*.p99_ms", kind="rising",
+                  windows=3, min_delta=0.5)])
+    active = hw.tick()
+    assert len(active) == 1 and active[0].trace_id == ""
+
+
+def test_too_few_samples_keeps_watch_quiet():
+    series = GaugeSeries(capacity=8)
+    series.sample({"g": 1.0})
+    hw = HealthWatch(series)
+    assert hw.tick() == []
+    assert hw.active == []
+
+
+def test_default_journal_dropped_rule_catches_ring_overflow():
+    """Regression (ISSUE 11 satellite): a journal ring that starts dropping
+    events — the flight recorder overwriting unread history — must raise
+    the stock ``journal_dropped`` alarm from its own gauge feed."""
+    journal = Journal(capacity=2, node="n1")
+    series = GaugeSeries(capacity=16, node="n1")
+    hw = HealthWatch(series, journal=journal, rules=default_rules())
+
+    def snapshot():
+        series.sample(journal.gauges())
+        return hw.tick()
+
+    journal.record("member_up", "n1")
+    assert snapshot() == []  # single sample: quiet
+    assert snapshot() == []  # flat dropped count: quiet
+    for i in range(4):  # capacity 2 → these overwrite, dropped grows
+        journal.record("member_up", f"n{i}")
+    active = snapshot()
+    assert "journal_dropped" in {a.rule for a in active}
+    fired = [e for e in journal.events() if e.kind == HEALTH]
+    assert fired and fired[0].key == "journal_dropped"
+    assert fired[0].attrs["gauge"] == "rio.journal.dropped"
+
+
+def test_default_rules_cover_the_stock_alarm_set():
+    names = {r.name for r in default_rules()}
+    assert names == {
+        "p99_rising", "loop_lag_rising", "journal_dropped", "shed_rate",
+        "residual_diverging", "solve_ms_drift",
+    }
+    kinds = {r.name: r.kind for r in default_rules()}
+    assert kinds["journal_dropped"] == "delta"
+    assert kinds["solve_ms_drift"] == "drift"
+
+
+def test_health_alert_defaults():
+    a = HealthAlert(rule="r", gauge="g", value=1.0)
+    assert a.trace_id == "" and a.seq == 0 and a.detail == ""
