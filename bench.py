@@ -1834,6 +1834,36 @@ def journal_overhead() -> dict:
     return out
 
 
+def faults_overhead() -> dict:
+    """Disabled-overhead parity of the fault-injection layer, A/B'd in the
+    SAME session: bare storage backends vs the same backends wrapped in
+    Faulty* wrappers around a DISABLED schedule (passthrough swap active —
+    the inner bound methods serve directly, so the per-request directory
+    lookup pays nothing). The trait-lookup ladder also prices armed-idle
+    delegation (what a soak pays while no fault fires). Median paired
+    ratio is the stable artifact."""
+    import asyncio
+
+    from rio_tpu.utils.faults_live import measure_faults_overhead
+
+    out = asyncio.run(measure_faults_overhead())
+    out["host"] = _host_provenance()
+    m = out["msgs_per_sec"]
+    lk = out["lookup_ops_per_sec"]
+    print(
+        f"# faults overhead ({out['batches']} interleaved batches x "
+        f"{out['n_requests_per_batch']} reqs, 2 servers/mode, median "
+        f"paired ratio): off {m['off']:,.0f}/s, on {m['on']:,.0f}/s "
+        f"({out['faults_overhead_pct']:+}%); trait lookup bare "
+        f"{lk['bare']:,.0f}/s, disabled {lk['disabled']:,.0f}/s "
+        f"({out['lookup_overhead_disabled_pct']:+}%), armed-idle "
+        f"{lk['armed_idle']:,.0f}/s "
+        f"({out['lookup_overhead_armed_idle_pct']:+}%)",
+        file=sys.stderr,
+    )
+    return out
+
+
 def series_overhead() -> dict:
     """RPC-loop cost of gauge time-series sampling + HealthWatch, A/B'd in
     the SAME session: servers with timeseries=False vs sampling at an
@@ -2224,6 +2254,10 @@ def main() -> None:
     except Exception as e:
         print(f"# series overhead failed: {e!r}", file=sys.stderr)
     try:
+        detail["faults"] = faults_overhead()
+    except Exception as e:
+        print(f"# faults overhead failed: {e!r}", file=sys.stderr)
+    try:
         detail["scaled_routing"] = scaled_route_hops()
     except Exception as e:
         print(f"# scaled routing failed: {e!r}", file=sys.stderr)
@@ -2384,6 +2418,9 @@ if __name__ == "__main__":
     # Run the sharded data-plane A/B battery alone and bank it into the
     # cpu sidecar (real worker processes on loopback; CPU-safe).
     parser.add_argument("--sharded", action="store_true")
+    # Run the fault-injection disabled-overhead A/B alone and bank it into
+    # the cpu sidecar (same CPU-safe in-process-cluster shape as --series).
+    parser.add_argument("--faults", action="store_true")
     args = parser.parse_args()
     if args.migration:
         _pin_orchestrator_to_cpu()
@@ -2430,6 +2467,23 @@ if __name__ == "__main__":
         except (OSError, ValueError):
             detail = {}
         detail["rpc_sharded"] = out
+        _write_detail(detail, here)
+        print(json.dumps(out))
+    elif args.faults:
+        # Standalone --faults updates the banked cpu sidecar in place (the
+        # --series pattern): the A/B carries its own paired baseline, so
+        # it can refresh independently of the other host stages.
+        _pin_orchestrator_to_cpu()
+        out = faults_overhead()
+        here = os.path.dirname(os.path.abspath(__file__))
+        try:
+            with open(os.path.join(here, "BENCH_DETAIL.cpu.json")) as fh:
+                detail = json.load(fh)
+            if not isinstance(detail, dict):
+                detail = {}
+        except (OSError, ValueError):
+            detail = {}
+        detail["faults"] = out
         _write_detail(detail, here)
         print(json.dumps(out))
     elif args.delta:

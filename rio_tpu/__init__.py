@@ -62,6 +62,26 @@ from .service_object import (
 __version__ = "0.7.2"  # tracks the surveyed reference version (pyproject.toml)
 
 
+# Fault-injection surface, re-exported lazily for the same reason as
+# ShardedServer: ``python -m rio_tpu.faults --demo`` (the tier-1 smoke)
+# executes the module as __main__.
+_FAULTS_EXPORTS = frozenset(
+    {
+        "FaultRule",
+        "FaultSchedule",
+        "FaultyMembershipStorage",
+        "FaultyObjectPlacement",
+        "FaultyReminderStorage",
+        "InjectedFault",
+        "LinkRule",
+        "OutageWindow",
+        "StorageHealth",
+        "StorageResilienceConfig",
+        "TransportFaults",
+    }
+)
+
+
 def __getattr__(name: str):
     # Lazy: ``python -m rio_tpu.sharded`` executes the module as __main__;
     # an eager import here would load it twice (runpy's double-exec warning).
@@ -69,6 +89,10 @@ def __getattr__(name: str):
         from .sharded import ShardedServer
 
         return ShardedServer
+    if name in _FAULTS_EXPORTS:
+        from . import faults
+
+        return getattr(faults, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
@@ -80,7 +104,18 @@ __all__ = [
     "ClientBuilder",
     "ClusterLoadView",
     "ClusterProvider",
+    "FaultRule",
+    "FaultSchedule",
+    "FaultyMembershipStorage",
+    "FaultyObjectPlacement",
+    "FaultyReminderStorage",
+    "InjectedFault",
     "InternalClientSender",
+    "LinkRule",
+    "OutageWindow",
+    "StorageHealth",
+    "StorageResilienceConfig",
+    "TransportFaults",
     "Journal",
     "JournalEvent",
     "LifecycleKind",

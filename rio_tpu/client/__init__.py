@@ -73,11 +73,19 @@ class _ServerConns:
     # (deeper stacks grow head-of-line batches past the cork's sweet spot).
     PIPELINE_DEPTH = 32
 
-    def __init__(self, address: str, limit: int, timeout: float, engine=None) -> None:
+    def __init__(
+        self, address: str, limit: int, timeout: float, engine=None,
+        faults=None, identity: str = "",
+    ) -> None:
         self.address = address
         self.limit = max(1, limit)
         self.timeout = timeout
         self.engine = engine
+        # Fault-injection handle (rio_tpu.faults.TransportFaults) + this
+        # client's source identity for (src, dst) link rules; None in every
+        # production path — the gates below are then never consulted.
+        self.faults = faults
+        self.identity = identity
         self.conns: list = []
         self.sem = asyncio.Semaphore(self.limit * self.PIPELINE_DEPTH)
         self._dialing = 0
@@ -85,14 +93,23 @@ class _ServerConns:
 
     async def _connect(self):
         host, _, port = self.address.rpartition(":")
+        if self.faults is not None:
+            try:
+                await self.faults.connect_gate(self.identity, self.address)
+            except OSError as e:
+                raise ServerNotAvailable(f"{self.address}: {e}") from e
         if self.engine is not None:
-            return await self.engine.connect(host, int(port), self.timeout)
-        from .. import aio
+            conn = await self.engine.connect(host, int(port), self.timeout)
+        else:
+            from .. import aio
 
-        try:
-            return await aio.connect(host, int(port), self.timeout)
-        except (OSError, asyncio.TimeoutError) as e:
-            raise ServerNotAvailable(f"{self.address}: {e}") from e
+            try:
+                conn = await aio.connect(host, int(port), self.timeout)
+            except (OSError, asyncio.TimeoutError) as e:
+                raise ServerNotAvailable(f"{self.address}: {e}") from e
+        if self.faults is not None:
+            conn = self.faults.wrap_conn(conn, self.identity, self.address)
+        return conn
 
     async def acquire(self):
         await self.sem.acquire()
@@ -181,11 +198,17 @@ class Client:
         membership_view_ttl: float = 1.0,
         read_scale: Any | None = None,
         standby_resolver: Callable[[str, str], Awaitable[list[str]]] | None = None,
+        transport_faults: Any | None = None,
+        identity: str = "",
     ) -> None:
         if transport not in ("asyncio", "native", "auto"):
             raise ValueError(f"unknown transport {transport!r}")
         self.members_storage = members_storage
         self.stats = ClientStats()
+        # Fault-injection handle + source identity for (src, dst) link
+        # rules (rio_tpu.faults.TransportFaults); None in production.
+        self._transport_faults = transport_faults
+        self._identity = identity
         self._placement_resolver = placement_resolver
         self._view_ttl = membership_view_ttl
         self._view_ts = float("-inf")
@@ -231,7 +254,21 @@ class Client:
         loop = asyncio.get_event_loop()
         stale = (loop.time() - self._view_ts) > self._view_ttl
         if refresh or stale or not self._active_servers:
-            members = await self.members_storage.active_members()
+            try:
+                members = await self.members_storage.active_members()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — rendezvous outage
+                if self._active_servers:
+                    # Serve the stale view: the servers in it are (probably)
+                    # still up even though the membership store is not.
+                    # Re-stamp the TTL so a long outage costs one failed
+                    # refresh per TTL, not one per request.
+                    self._view_ts = loop.time()
+                    return self._active_servers
+                raise ServerNotAvailable(
+                    f"membership view unavailable: {e!r}"
+                ) from e
             self._active_servers = [m.address for m in members]
             self._view_ts = loop.time()
         return self._active_servers
@@ -242,6 +279,7 @@ class Client:
             pool = _ServerConns(
                 address, self._pool_per_server, self._connect_timeout,
                 engine=self._client_engine,
+                faults=self._transport_faults, identity=self._identity,
             )
             self._conns[address] = pool
         return pool
@@ -614,6 +652,11 @@ class Client:
     async def ping(self, address: str) -> bool:
         """TCP reachability probe with the gossip timeout (500 ms default)."""
         host, _, port = address.rpartition(":")
+        if self._transport_faults is not None:
+            try:
+                await self._transport_faults.connect_gate(self._identity, address)
+            except OSError:
+                return False
         try:
             _, writer = await asyncio.wait_for(
                 asyncio.open_connection(host, int(port)), self._connect_timeout

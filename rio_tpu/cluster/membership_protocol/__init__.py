@@ -32,6 +32,17 @@ class ClusterProvider(abc.ABC):
     def set_load_source(self, source: Callable[[], str] | None) -> None:
         self._load_source = source
 
+    # Optional observability hooks, wired by the server the same way as the
+    # load source: a Journal for STORAGE outage/recovery events and a
+    # StorageHealth for rio.storage.* gauges. Both default to None — a bare
+    # provider (tests, examples) journals nothing and never fails on it.
+    _journal = None
+    _storage_health = None
+
+    def set_observability(self, journal=None, storage_health=None) -> None:
+        self._journal = journal
+        self._storage_health = storage_health
+
     def _load_snapshot(self) -> str:
         """Encoded load for the next heartbeat push ('' when unmonitored
         or the monitor's snapshot fails — telemetry never blocks liveness)."""
@@ -56,14 +67,33 @@ class LocalClusterProvider(ClusterProvider):
         return self._storage
 
     async def serve(self, address: str) -> None:
-        await self._storage.push(
-            Member.from_address(address, active=True, load=self._load_snapshot())
-        )
+        # Same outage contract as the gossip provider: a storage blip must
+        # never kill the provider task (and with it the server). Retry the
+        # registration, swallow heartbeat push failures.
+        while True:
+            try:
+                await self._storage.push(
+                    Member.from_address(
+                        address, active=True, load=self._load_snapshot()
+                    )
+                )
+                break
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — storage outage at boot
+                await asyncio.sleep(0.1)
         while True:
             if self._load_source is None:
                 await asyncio.sleep(3600)
                 continue
             await asyncio.sleep(0.2)
-            await self._storage.push(
-                Member.from_address(address, active=True, load=self._load_snapshot())
-            )
+            try:
+                await self._storage.push(
+                    Member.from_address(
+                        address, active=True, load=self._load_snapshot()
+                    )
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — ride out the blip
+                pass

@@ -7,6 +7,13 @@ records failures in the shared membership storage's failure ledger, marks
 peers inactive once failures-in-window cross the threshold (``:101-112``),
 drops long-inactive members (``:175-185``), and re-activates reachable ones
 (``:188-192``).
+
+Outage resilience (beyond the reference): the tick survives storage
+exceptions — the loop keeps probing from its last good membership view,
+backs off with decorrelated jitter instead of the full interval, journals
+one STORAGE event per degraded/recovered edge, and resumes cleanly when
+the rendezvous returns. A single ``members()`` blip must never kill the
+cluster's failure detector.
 """
 
 from __future__ import annotations
@@ -17,6 +24,8 @@ import logging
 import time
 
 from ...client import Client
+from ...journal import STORAGE
+from ...utils.backoff import DecorrelatedJitter
 from ..storage import Member, MembershipStorage
 from . import ClusterProvider
 
@@ -33,6 +42,23 @@ class PeerToPeerClusterConfig:
     limit_monitored_members: int | None = None
     drop_inactive_after_secs: float | None = None
     ping_timeout: float = 0.5
+    # Suppress the inactive verdict for a member whose heartbeat row is
+    # fresher than the failure window: it still reaches the rendezvous, so
+    # it is alive — we just can't reach it (asymmetric partition). Flipping
+    # it inactive would flap forever against its own active re-push every
+    # tick. A genuinely dead member stops pushing, its row goes stale, and
+    # the verdict lands unsuppressed one window later.
+    trust_heartbeat_freshness: bool = True
+
+
+@dataclasses.dataclass
+class GossipStats:
+    """Tick/outage counters (duck-typed into ``otel.stats_gauges``)."""
+
+    ticks: int = 0  # completed probe rounds (healthy or degraded)
+    degraded_ticks: int = 0  # rounds where ≥1 storage call failed
+    storage_errors: int = 0  # individual failed storage calls
+    suppressed_verdicts: int = 0  # inactive flips vetoed by fresh heartbeats
 
 
 class PeerToPeerClusterProvider(ClusterProvider):
@@ -40,12 +66,47 @@ class PeerToPeerClusterProvider(ClusterProvider):
         self,
         members_storage: MembershipStorage,
         config: PeerToPeerClusterConfig | None = None,
+        transport_faults=None,
     ) -> None:
         self._storage = members_storage
         self.config = config or PeerToPeerClusterConfig()
+        self.stats = GossipStats()
+        # Fault-injection handle (rio_tpu.faults.TransportFaults): routes
+        # the prober's pings through per-(src, dst) link verdicts so tests
+        # can script asymmetric partitions without touching the network.
+        self._transport_faults = transport_faults
+        self._storage_down = False
 
     def members_storage(self) -> MembershipStorage:
         return self._storage
+
+    # -- storage-outage bookkeeping (one journal event per edge) -------------
+
+    def _note_storage_error(self, op: str, exc: BaseException) -> None:
+        self.stats.storage_errors += 1
+        if self._storage_health is not None:
+            self._storage_health.note_error(op, exc, source="gossip")
+        if not self._storage_down:
+            self._storage_down = True
+            log.warning("gossip: storage degraded at %s: %r", op, exc)
+            if self._journal is not None:
+                self._journal.record(
+                    STORAGE,
+                    source="gossip",
+                    op=op,
+                    mode="degraded",
+                    error=repr(exc)[:120],
+                )
+
+    def _note_storage_ok(self) -> None:
+        if not self._storage_down:
+            return
+        self._storage_down = False
+        log.info("gossip: storage recovered")
+        if self._storage_health is not None:
+            self._storage_health.note_ok("gossip")
+        if self._journal is not None:
+            self._journal.record(STORAGE, source="gossip", mode="recovered")
 
     # -- monitored-subset selection (reference peer_to_peer.rs:50-78) -------
 
@@ -74,6 +135,23 @@ class PeerToPeerClusterProvider(ClusterProvider):
         window_start = time.time() - self.config.interval_secs_threshold
         recent = [f for f in failures if f >= window_start]
         if len(recent) >= self.config.num_failures_threshold and member.active:
+            if (
+                self.config.trust_heartbeat_freshness
+                and member.last_seen
+                and member.last_seen >= window_start
+            ):
+                # Asymmetric partition: this node cannot reach the member,
+                # but its heartbeat row is fresher than the failure window —
+                # it demonstrably reaches the rendezvous and re-pushes
+                # itself active every tick. Keep recording failures in the
+                # ledger; do not flip the verdict (it would flap
+                # active/inactive once per tick against the re-push).
+                self.stats.suppressed_verdicts += 1
+                log.debug(
+                    "gossip: %s unreachable but heartbeat-fresh; verdict suppressed",
+                    member.address,
+                )
+                return
             log.info("gossip: marking %s inactive (%d recent failures)",
                      member.address, len(recent))
             await self._storage.set_inactive(member.ip, member.port)
@@ -90,31 +168,93 @@ class PeerToPeerClusterProvider(ClusterProvider):
 
     # -- main loop (reference peer_to_peer.rs:144-209) ------------------------
 
+    def _backoff(self) -> DecorrelatedJitter:
+        # Retry sleeps during a storage outage: start well under the tick
+        # interval (the outage may be a blip) and cap at one interval — a
+        # degraded detector should probe MORE eagerly than a healthy one,
+        # never less.
+        interval = max(1e-3, self.config.interval_secs)
+        return DecorrelatedJitter(base=interval / 8.0, cap=interval)
+
     async def serve(self, address: str) -> None:
-        await self._storage.push(
-            Member.from_address(address, active=True, load=self._load_snapshot())
+        backoff = self._backoff()
+        while True:
+            # Registration must survive a rendezvous that is down at boot:
+            # retry with jitter instead of dying before the first tick.
+            try:
+                await self._storage.push(
+                    Member.from_address(address, active=True, load=self._load_snapshot())
+                )
+                self._note_storage_ok()
+                break
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — storage outage at boot
+                self._note_storage_error("membership.push", e)
+                await asyncio.sleep(backoff.next())
+        client = Client(
+            self._storage,
+            connect_timeout=self.config.ping_timeout,
+            transport_faults=self._transport_faults,
+            identity=address,
         )
-        client = Client(self._storage, connect_timeout=self.config.ping_timeout)
+        view: list[Member] = []  # last good membership snapshot
         try:
             while True:
                 tick_start = time.monotonic()
-                members = await self._storage.members()
+                tick_ok = True
+                try:
+                    members = await self._storage.members()
+                    view = members
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — keep serving last good view
+                    tick_ok = False
+                    self._note_storage_error("membership.members", e)
+                    members = view
                 monitored = self._members_to_monitor(members, address)
-                await asyncio.gather(
+                results = await asyncio.gather(
                     *(self._test_member(client, m) for m in monitored),
                     return_exceptions=True,
                 )
-                await self._drop_stale(members)
+                for r in results:
+                    if isinstance(r, asyncio.CancelledError):
+                        raise r
+                    if isinstance(r, BaseException):
+                        # A ping verdict's storage bookkeeping failed; the
+                        # other members' probes already ran (gather).
+                        tick_ok = False
+                        self._note_storage_error("membership.verdict", r)
+                try:
+                    await self._drop_stale(members)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001
+                    tick_ok = False
+                    self._note_storage_error("membership.remove", e)
                 # Keep our own registration fresh — re-push (not just
                 # set_active) so a node whose row was dropped while it was
                 # partitioned can rejoin once reachable again. The push also
                 # refreshes this node's load vector for peers' views.
-                await self._storage.push(
-                    Member.from_address(
-                        address, active=True, load=self._load_snapshot()
+                try:
+                    await self._storage.push(
+                        Member.from_address(
+                            address, active=True, load=self._load_snapshot()
+                        )
                     )
-                )
-                elapsed = time.monotonic() - tick_start
-                await asyncio.sleep(max(0.0, self.config.interval_secs - elapsed))
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001
+                    tick_ok = False
+                    self._note_storage_error("membership.push", e)
+                self.stats.ticks += 1
+                if tick_ok:
+                    self._note_storage_ok()
+                    backoff = self._backoff()  # reset the jitter sequence
+                    elapsed = time.monotonic() - tick_start
+                    await asyncio.sleep(max(0.0, self.config.interval_secs - elapsed))
+                else:
+                    self.stats.degraded_ticks += 1
+                    await asyncio.sleep(backoff.next())
         finally:
             client.close()

@@ -122,6 +122,13 @@ def default_rules(
             min_delta=0.0,
         ),
         TrendRule(
+            name="storage_errors",
+            gauge="rio.storage.errors",
+            kind="delta",
+            windows=windows,
+            min_delta=0.0,  # any growth in rendezvous-storage failures
+        ),
+        TrendRule(
             name="solve_ms_drift",
             gauge="rio.placement_solve.solve_ms",
             kind="drift",

@@ -77,6 +77,9 @@ SOLVE = "solve"  # placement solve (full or delta) applied/discarded
 
 HEALTH = "health"  # HealthWatch trend rule fired (degradation alarm)
 
+STORAGE = "storage"  # rendezvous storage degraded / recovered (outage story)
+FAULT = "fault"  # fault-injection schedule transition (scripted outage edges)
+
 EVENT_KINDS: tuple[str, ...] = (
     MEMBER_UP,
     MEMBER_DOWN,
@@ -102,6 +105,8 @@ EVENT_KINDS: tuple[str, ...] = (
     REMINDER_HANDOFF,
     SOLVE,
     HEALTH,
+    STORAGE,
+    FAULT,
 )
 
 

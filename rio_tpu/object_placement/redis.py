@@ -11,7 +11,7 @@ from __future__ import annotations
 from typing import Callable
 
 from ..registry import ObjectId
-from ..utils.resp import RedisClient, RespError
+from ..utils.resp import RedisClient, RespError, check_replies
 from . import ObjectPlacement, ObjectPlacementItem, sanitize_standby_row
 
 # Optimistic-lock retries before a standby CAS gives up. Contention on one
@@ -45,8 +45,10 @@ class RedisObjectPlacement(ObjectPlacement):
         if not items:
             return
         keys = [str(i.object_id) for i in items]
-        olds = await self.client.execute_pipeline(
-            [("GET", self._obj_key(k)) for k in keys]
+        olds = check_replies(
+            await self.client.execute_pipeline(
+                [("GET", self._obj_key(k)) for k in keys]
+            )
         )
         cmds: list[tuple] = []
         for item, key, old in zip(items, keys, olds):
@@ -57,7 +59,7 @@ class RedisObjectPlacement(ObjectPlacement):
             else:
                 cmds.append(("SET", self._obj_key(key), item.server_address))
                 cmds.append(("SADD", self._server_key(item.server_address), key))
-        await self.client.execute_pipeline(cmds)
+        check_replies(await self.client.execute_pipeline(cmds))
 
     async def lookup(self, object_id: ObjectId) -> str | None:
         raw = await self.client.execute("GET", self._obj_key(str(object_id)))
@@ -78,8 +80,10 @@ class RedisObjectPlacement(ObjectPlacement):
         raw_keys = await self.client.execute("SMEMBERS", self._server_key(address))
         keys = [k.decode() for k in raw_keys or []]
         if keys:
-            current = await self.client.execute_pipeline(
-                [("GET", self._obj_key(k)) for k in keys]
+            current = check_replies(
+                await self.client.execute_pipeline(
+                    [("GET", self._obj_key(k)) for k in keys]
+                )
             )
             stale = [
                 self._obj_key(k)
@@ -96,7 +100,7 @@ class RedisObjectPlacement(ObjectPlacement):
         cmds: list[tuple] = [("DEL", self._obj_key(key)), ("DEL", self._standby_key(key))]
         if old is not None:
             cmds.insert(0, ("SREM", self._server_key(old.decode()), key))
-        await self.client.execute_pipeline(cmds)
+        check_replies(await self.client.execute_pipeline(cmds))
 
     @staticmethod
     def _parse_standby(raw: object) -> tuple[list[str], int]:
@@ -187,8 +191,12 @@ class RedisObjectPlacement(ObjectPlacement):
         return new_epoch
 
     async def lookup_batch(self, object_ids: list[ObjectId]) -> list[str | None]:
-        raws = await self.client.execute_pipeline(
-            [("GET", self._obj_key(str(o))) for o in object_ids]
+        # A failed GET must raise, not read as "unplaced" — a None here
+        # green-lights a second activation of a possibly-seated object.
+        raws = check_replies(
+            await self.client.execute_pipeline(
+                [("GET", self._obj_key(str(o))) for o in object_ids]
+            )
         )
         return [r.decode() if isinstance(r, bytes) else None for r in raws]
 
@@ -202,8 +210,10 @@ class RedisObjectPlacement(ObjectPlacement):
         keys = [k.decode()[len(prefix):] for k in raw_keys or []]
         if not keys:
             return []
-        raws = await self.client.execute_pipeline(
-            [("GET", self._obj_key(k)) for k in keys]
+        raws = check_replies(
+            await self.client.execute_pipeline(
+                [("GET", self._obj_key(k)) for k in keys]
+            )
         )
         return [
             ObjectPlacementItem(ObjectId(*k.split(".", 1)), r.decode())

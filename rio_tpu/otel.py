@@ -114,6 +114,17 @@ def server_gauges(server: Any) -> dict[str, float]:
         # Trend-alarm state (rio.health.*): active/total alert counts plus
         # one 0/1 gauge per configured rule.
         gauges.update(health.gauges())
+    storage = getattr(server, "storage_health", None)
+    if storage is not None:
+        # Rendezvous-storage outage ledger (rio.storage.*): error/degraded
+        # counters shared by the service layer, gossip loop, and daemons.
+        gauges.update(storage.gauges())
+    provider = getattr(server, "cluster_provider", None)
+    gossip_stats = getattr(provider, "stats", None)
+    if gossip_stats is not None:
+        # Gossip tick/outage counters (rio.gossip.*), including verdicts
+        # suppressed by the heartbeat-freshness anti-flap rule.
+        gauges.update(stats_gauges(gossip=gossip_stats))
     return gauges
 
 

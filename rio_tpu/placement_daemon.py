@@ -34,8 +34,9 @@ import random
 from dataclasses import dataclass
 
 from .cluster.storage import MembershipStorage
-from .journal import MEMBER_DOWN, MEMBER_UP, SOLVE
+from .journal import MEMBER_DOWN, MEMBER_UP, SOLVE, STORAGE
 from .object_placement import ObjectPlacement
+from .utils.backoff import DecorrelatedJitter
 
 log = logging.getLogger("rio_tpu.placement_daemon")
 
@@ -51,6 +52,7 @@ class PlacementDaemonStats:
     rebalances_skipped: int = 0  # sibling daemon on a shared provider won
     rebalances_discarded: int = 0  # lost an epoch race; retried next poll
     retries_abandoned: int = 0  # discard-retry budget exhausted; wait for churn
+    degraded_polls: int = 0  # polls lost to storage errors (backoff pacing)
     moves: int = 0
     bursts: int = 0  # MigrateBatch bursts this daemon's rebalances produced
     burst_keys: int = 0  # keys those bursts carried
@@ -103,6 +105,7 @@ class PlacementDaemon:
         *,
         migrator=None,
         journal=None,
+        storage_health=None,
     ) -> None:
         self.members_storage = members_storage
         self.placement = placement
@@ -114,11 +117,42 @@ class PlacementDaemon:
         # provider may be shared by several in-process servers, and only
         # the daemon knows which NODE observed the transition.
         self.journal = journal
+        # Shared rio.storage.* outage ledger (rio_tpu.faults.StorageHealth).
+        self.storage_health = storage_health
+        self._storage_down = False
         self._last_liveness: frozenset[tuple[str, bool]] | None = None
         self._retry_solve = False  # last solve was epoch-discarded
         self._consecutive_discards = 0
         self._retry_not_before = float("-inf")  # backoff gate (loop time)
         self._kick_event = asyncio.Event()
+
+    # -- storage-outage bookkeeping (one journal event per edge) -------------
+
+    def _note_storage_error(self, op: str, exc: BaseException) -> None:
+        if self.storage_health is not None:
+            self.storage_health.note_error(op, exc, source="placement_daemon")
+        if not self._storage_down:
+            self._storage_down = True
+            if self.journal is not None:
+                self.journal.record(
+                    STORAGE,
+                    source="placement_daemon",
+                    op=op,
+                    mode="degraded",
+                    error=repr(exc)[:120],
+                )
+
+    def _note_storage_ok(self) -> None:
+        if not self._storage_down:
+            return
+        self._storage_down = False
+        log.info("placement daemon: storage recovered")
+        if self.storage_health is not None:
+            self.storage_health.note_ok("placement_daemon")
+        if self.journal is not None:
+            self.journal.record(
+                STORAGE, source="placement_daemon", mode="recovered"
+            )
 
     def kick(self) -> None:
         """Wake the poll loop now (idempotent, loop-thread only).
@@ -286,10 +320,20 @@ class PlacementDaemon:
             # clean_server), so churn reaction is bounded by debounce +
             # solve time, not poll_interval.
             self.placement.add_churn_listener(self.kick)
+        # Degraded-poll pacing: jittered retries while the rendezvous is
+        # down, so co-located daemons don't stampede it on recovery. The
+        # daemon's plan state (_last_liveness, retry ladder) is instance-
+        # resident and the provider's warm-start state is provider-resident
+        # — both survive an outage untouched; the next good poll resumes
+        # exactly where the blip interrupted.
+        interval = max(1e-3, cfg.poll_interval)
+        storage_backoff = DecorrelatedJitter(base=interval / 2.0, cap=interval * 4.0)
         while True:
+            poll_failed = False
             try:
                 liveness, members = await self._liveness()
                 self.stats.polls += 1
+                self._note_storage_ok()
                 self._sync_load(members)
                 retry = self._retry_solve and loop.time() >= self._retry_not_before
                 changed = liveness != self._last_liveness
@@ -395,9 +439,18 @@ class PlacementDaemon:
                         )
             except asyncio.CancelledError:
                 raise
-            except Exception:
+            except Exception as e:  # noqa: BLE001
                 # The daemon must never die to a transient storage error —
                 # liveness watching is the node's recovery path.
+                poll_failed = True
                 self.stats.errors += 1
+                self.stats.degraded_polls += 1
+                self._note_storage_error("placement.poll", e)
                 log.exception("placement daemon poll failed")
-            await self._idle(cfg.poll_interval)
+            if poll_failed:
+                await self._idle(storage_backoff.next())
+            else:
+                storage_backoff = DecorrelatedJitter(
+                    base=interval / 2.0, cap=interval * 4.0
+                )
+                await self._idle(cfg.poll_interval)

@@ -52,11 +52,12 @@ from dataclasses import dataclass, field
 
 from ..client import Client
 from ..cluster.storage import MembershipStorage
-from ..journal import REMINDER_HANDOFF, REMINDER_RELEASE, REMINDER_SEAT
+from ..journal import REMINDER_HANDOFF, REMINDER_RELEASE, REMINDER_SEAT, STORAGE
 from ..object_placement import ObjectPlacement, ObjectPlacementItem
 from ..registry import ObjectId
 from ..service_object import ReminderFired
 from ..utils import ExponentialBackoff
+from ..utils.backoff import DecorrelatedJitter
 from . import Reminder, ReminderStorage
 
 log = logging.getLogger("rio_tpu.reminders")
@@ -99,6 +100,8 @@ class ReminderDaemonStats:
     missed_ticks: int = 0  # periods skipped by catch-up accounting
     delivery_failures: int = 0  # transport-level; reminder stays due
     errors: int = 0
+    shard_errors: int = 0  # single-shard failures skipped mid-scan
+    degraded_polls: int = 0  # polls where ≥1 storage call failed
 
 
 class ReminderDaemon:
@@ -114,6 +117,7 @@ class ReminderDaemon:
         config: ReminderDaemonConfig | None = None,
         client: Client | None = None,
         journal=None,
+        storage_health=None,
     ) -> None:
         self.address = address
         self.members_storage = members_storage
@@ -124,9 +128,42 @@ class ReminderDaemon:
         self._client = client
         # Control-plane flight recorder; seat transitions only, never ticks.
         self.journal = journal
+        # Shared rio.storage.* outage ledger (rio_tpu.faults.StorageHealth).
+        self.storage_health = storage_health
         self._held: dict[int, int] = {}  # shard -> lease epoch we hold
         self._handed_off: dict[int, float] = {}  # shard -> when we released it
         self._draining = False
+        self._storage_down = False
+        # Last good active-member view: a membership blip must not stall the
+        # whole scan — held leases keep their shards ticking from this view.
+        self._last_active: set[str] = set()
+
+    # -- storage-outage bookkeeping (one journal event per edge) -------------
+
+    def _note_storage_error(self, op: str, exc: BaseException) -> None:
+        if self.storage_health is not None:
+            self.storage_health.note_error(op, exc, source="reminders")
+        if not self._storage_down:
+            self._storage_down = True
+            log.warning("reminder daemon: storage degraded at %s: %r", op, exc)
+            if self.journal is not None:
+                self.journal.record(
+                    STORAGE,
+                    source="reminders",
+                    op=op,
+                    mode="degraded",
+                    error=repr(exc)[:120],
+                )
+
+    def _note_storage_ok(self) -> None:
+        if not self._storage_down:
+            return
+        self._storage_down = False
+        log.info("reminder daemon: storage recovered")
+        if self.storage_health is not None:
+            self.storage_health.note_ok("reminders")
+        if self.journal is not None:
+            self.journal.record(STORAGE, source="reminders", mode="recovered")
 
     def _jrecord(self, kind: str, shard: int, **attrs) -> None:
         if self.journal is not None:
@@ -211,33 +248,63 @@ class ReminderDaemon:
             with contextlib.suppress(Exception):
                 await self.storage.release_lease(shard, self.address, epoch)
 
-    async def poll_once(self, now: float | None = None) -> None:
-        """One full pass over the shard space."""
+    async def poll_once(self, now: float | None = None) -> bool:
+        """One full pass over the shard space. Returns True when every
+        storage call succeeded (False → the caller backs off).
+
+        Outage resilience: a failed ``active_members`` falls back to the
+        last good view, and each shard is isolated — one shard's storage
+        error skips THAT shard (its held lease/seat untouched, so it
+        resumes where it left off after the blip) and the scan continues.
+        """
         now = time.time() if now is None else now
         cfg = self.config
-        active = {m.address for m in await self.members_storage.active_members()}
+        poll_ok = True
+        try:
+            active = {m.address for m in await self.members_storage.active_members()}
+            self._last_active = active
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — membership blip
+            poll_ok = False
+            self._note_storage_error("membership.active_members", e)
+            active = self._last_active
         owned = 0
         for shard in range(self.storage.num_shards):
             if self._draining:
                 break
-            owner = await self._resolve_owner(shard, active, now)
-            if owner != self.address:
-                # Seated elsewhere (or unclaimed and not ours to claim):
-                # make sure we are not still ticking it.
-                await self._release_held(shard)
-                continue
-            lease = await self.storage.acquire_lease(
-                shard, self.address, cfg.lease_ttl, now
-            )
-            if lease is None:
-                # Directory says us, lease says someone else: the previous
-                # owner's lease has not expired yet. Back off until it does.
-                self._held.pop(shard, None)
-                continue
-            self._held[shard] = lease.epoch
-            owned += 1
-            await self._tick_shard(shard, now)
+            try:
+                owner = await self._resolve_owner(shard, active, now)
+                if owner != self.address:
+                    # Seated elsewhere (or unclaimed and not ours to claim):
+                    # make sure we are not still ticking it.
+                    await self._release_held(shard)
+                    continue
+                lease = await self.storage.acquire_lease(
+                    shard, self.address, cfg.lease_ttl, now
+                )
+                if lease is None:
+                    # Directory says us, lease says someone else: the previous
+                    # owner's lease has not expired yet. Back off until it does.
+                    self._held.pop(shard, None)
+                    continue
+                self._held[shard] = lease.epoch
+                owned += 1
+                await self._tick_shard(shard, now)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — skip shard, keep scanning
+                poll_ok = False
+                self.stats.shard_errors += 1
+                if shard in self._held:
+                    owned += 1  # lease still ours; resumes after the blip
+                self._note_storage_error(f"reminders.shard.{shard}", e)
         self.stats.owned_shards = owned
+        if poll_ok:
+            self._note_storage_ok()
+        else:
+            self.stats.degraded_polls += 1
+        return poll_ok
 
     # ------------------------------------------------------------------
     # Ticking
@@ -329,10 +396,16 @@ class ReminderDaemon:
     async def run(self) -> None:
         """Serve until cancelled (a ``Server.run`` child task)."""
         await self.storage.prepare()
+        # Degraded-poll retry pacing: jittered so a cluster of daemons does
+        # not hammer a recovering rendezvous in lockstep; capped a little
+        # above the healthy interval — the scheduler keeps scanning.
+        interval = max(1e-3, self.config.poll_interval)
+        backoff = DecorrelatedJitter(base=interval / 2.0, cap=interval * 4.0)
         try:
             while not self._draining:
+                poll_ok = False
                 try:
-                    await self.poll_once()
+                    poll_ok = await self.poll_once()
                     self.stats.polls += 1
                 except asyncio.CancelledError:
                     raise
@@ -341,7 +414,11 @@ class ReminderDaemon:
                     # membership error must never kill the scheduler.
                     self.stats.errors += 1
                     log.exception("reminder daemon poll failed")
-                await asyncio.sleep(self.config.poll_interval)
+                if poll_ok:
+                    backoff = DecorrelatedJitter(base=interval / 2.0, cap=interval * 4.0)
+                    await asyncio.sleep(self.config.poll_interval)
+                else:
+                    await asyncio.sleep(backoff.next())
             await asyncio.Event().wait()  # drained: park until cancelled
         finally:
             if self._client is not None:

@@ -24,7 +24,7 @@ from __future__ import annotations
 import json
 import time
 
-from ..utils.resp import RedisClient
+from ..utils.resp import RedisClient, check_replies
 from . import NUM_REMINDER_SHARDS, Lease, Reminder, ReminderStorage
 
 _SEP = "\x1f"  # object ids may contain ':' and '.', so field-separate keys
@@ -79,20 +79,20 @@ class RedisReminderStorage(ReminderStorage):
         r = reminder
         r.shard = self.shard_for(r.object_kind, r.object_id)
         member = self._member(r.object_kind, r.object_id, r.reminder_name)
-        await self.client.execute_pipeline([
+        check_replies(await self.client.execute_pipeline([
             ("SET", self._rem_key(r.object_kind, r.object_id, r.reminder_name), self._doc(r)),
             ("ZADD", self._sched_key(r.shard), r.next_due, member),
             ("SADD", self._obj_key(r.object_kind, r.object_id), r.reminder_name),
-        ])
+        ]))
 
     async def remove(self, object_kind: str, object_id: str, reminder_name: str) -> None:
         shard = self.shard_for(object_kind, object_id)
         member = self._member(object_kind, object_id, reminder_name)
-        await self.client.execute_pipeline([
+        check_replies(await self.client.execute_pipeline([
             ("DEL", self._rem_key(object_kind, object_id, reminder_name)),
             ("ZREM", self._sched_key(shard), member),
             ("SREM", self._obj_key(object_kind, object_id), reminder_name),
-        ])
+        ]))
 
     async def remove_object(self, object_kind: str, object_id: str) -> None:
         names = await self.client.execute("SMEMBERS", self._obj_key(object_kind, object_id))
@@ -108,9 +108,9 @@ class RedisReminderStorage(ReminderStorage):
         )
         if not names:
             return []
-        raws = await self.client.execute_pipeline(
+        raws = check_replies(await self.client.execute_pipeline(
             [("GET", self._rem_key(object_kind, object_id, n)) for n in names]
-        )
+        ))
         return [r for r in (self._parse(raw) for raw in raws) if r is not None]
 
     async def due(self, shard: int, now: float, limit: int = 256) -> list[Reminder]:
@@ -123,7 +123,9 @@ class RedisReminderStorage(ReminderStorage):
         for m in members:
             kind, oid, name = m.decode().split(_SEP)
             keys.append(self._rem_key(kind, oid, name))
-        raws = await self.client.execute_pipeline([("GET", k) for k in keys])
+        raws = check_replies(
+            await self.client.execute_pipeline([("GET", k) for k in keys])
+        )
         return [r for r in (self._parse(raw) for raw in raws) if r is not None]
 
     async def reschedule(
@@ -137,15 +139,15 @@ class RedisReminderStorage(ReminderStorage):
             return
         r.next_due = next_due
         member = self._member(object_kind, object_id, reminder_name)
-        await self.client.execute_pipeline([
+        check_replies(await self.client.execute_pipeline([
             ("SET", self._rem_key(object_kind, object_id, reminder_name), self._doc(r)),
             ("ZADD", self._sched_key(r.shard), next_due, member),
-        ])
+        ]))
 
     async def shard_counts(self) -> dict[int, int]:
-        counts = await self.client.execute_pipeline(
+        counts = check_replies(await self.client.execute_pipeline(
             [("ZCARD", self._sched_key(s)) for s in range(self.num_shards)]
-        )
+        ))
         return {s: int(c) for s, c in enumerate(counts) if int(c)}
 
     # -- leases -------------------------------------------------------------

@@ -198,6 +198,17 @@ class Server:
         self.journal = Journal(capacity=journal_capacity) if journal else None
         if self.journal is not None:
             self.app_data.set(self.journal)
+        # Storage-outage health ledger (rio_tpu/faults.StorageHealth): the
+        # service layer, gossip loop, and daemons all report degraded /
+        # recovered edges into the same instance, so rio.storage.* gauges
+        # and the HealthWatch storage rule see one coherent picture.
+        from .faults import StorageHealth
+
+        self.storage_health = StorageHealth()
+        self.app_data.set(self.storage_health)
+        self.cluster_provider.set_observability(
+            journal=self.journal, storage_health=self.storage_health
+        )
         # Per-handler RED histograms (rio_tpu/metrics): on by default — an
         # O(1) unlocked record per dispatch; ``metrics=False`` removes even
         # that (the service layer sees no registry and skips the timing).
@@ -728,6 +739,7 @@ class Server:
                 self.placement_daemon_config,
                 migrator=self.migration_manager,
                 journal=self.journal,
+                storage_health=self.storage_health,
             )
             self.placement_daemon = daemon
             tasks.append(asyncio.ensure_future(daemon.run()))
@@ -747,6 +759,7 @@ class Server:
                 storage=self.app_data.get(ReminderStorage),
                 config=self.reminder_daemon_config,
                 journal=self.journal,
+                storage_health=self.storage_health,
             )
             self.reminder_daemon = rdaemon
             tasks.append(asyncio.ensure_future(rdaemon.run()))

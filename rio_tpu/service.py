@@ -25,7 +25,7 @@ from .app_data import AppData
 from .cluster.storage import MembershipStorage
 from .commands import DispatchObserver, ServerDraining, ShardRouter
 from .errors import HandlerNotFound, ObjectNotFound, SerializationError, TypeNotFound
-from .journal import ADMIT_SHED, PLACE_ASSIGN, PLACE_RELEASE, Journal
+from .journal import ADMIT_SHED, PLACE_ASSIGN, PLACE_RELEASE, STORAGE, Journal
 from .message_router import MessageRouter
 from .object_placement import ObjectPlacement, ObjectPlacementItem
 from .protocol import (
@@ -102,6 +102,18 @@ class Service:
         # consulted only when seating an UNPLACED object — see the seam in
         # get_or_create_placement.
         self._shard = app_data.try_get(ShardRouter)
+        # Storage-outage degraded mode: node-wide health counters plus the
+        # optional bound on the routing block's directory awaits. Both None
+        # on servers that predate the fault subsystem (bare Service uses in
+        # tests) — the request path is then byte-identical to before.
+        # Import deferred: a module-level one loads rio_tpu.faults during
+        # ``import rio_tpu``, and ``python -m rio_tpu.faults`` then
+        # double-executes it (runpy's sys.modules warning).
+        from .faults import StorageHealth, StorageResilienceConfig
+
+        self._storage_health = app_data.try_get(StorageHealth)
+        resilience = app_data.try_get(StorageResilienceConfig)
+        self._route_timeout = resilience.route_timeout if resilience else None
 
     # ------------------------------------------------------------------
     # Placement (reference service.rs:193-298)
@@ -296,7 +308,12 @@ class Service:
                 )
             except Exception as e:  # lifecycle failure → full rollback
                 self.registry.remove(object_id.type_name, object_id.id)
-                await self.object_placement.remove(object_id)
+                try:
+                    await self.object_placement.remove(object_id)
+                except Exception:  # noqa: BLE001 — directory down mid-rollback
+                    # The stale row self-heals: the next lookup prunes rows
+                    # owned by this node once the object is gone locally.
+                    log.warning("rollback row removal failed for %s", object_id)
                 log.warning("activation of %s failed: %r", object_id, e)
                 return ResponseError.allocate(str(e))
         return None
@@ -374,6 +391,87 @@ class Service:
         finally:
             release(token)
 
+    async def _route(
+        self, req: RequestEnvelope, object_id: ObjectId
+    ) -> ResponseEnvelope | ResponseError | None:
+        """The non-node-scoped routing block: readscale standby serve,
+        overload shed, drain/migration refusals, directory resolution.
+        ``None`` means "this node owns the object — dispatch locally"."""
+        if self._readscale is not None:
+            # Standby serve-or-forward runs BEFORE the overload shed: a
+            # replica read never activates anything here, so shedding it
+            # (or redirecting to the primary we exist to offload) would
+            # defeat the read scale-out exactly when it matters.
+            served = await self._readscale.try_serve_standby(req, object_id)
+            if served is not None:
+                return served
+        shed = await self._shed_if_overloaded(object_id)
+        if shed is not None:
+            return shed
+        refusal = await self._refuse_if_draining(object_id)
+        if refusal is None:
+            refusal = await self._refuse_if_migrating(object_id)
+        if refusal is not None:
+            return refusal
+        addr = await self.get_or_create_placement(object_id)
+        mismatch = await self.check_address_mismatch(addr)
+        if mismatch is not None:
+            return mismatch
+        if self._readscale is not None:
+            # This node IS the primary. Under load, divert @readonly
+            # requests to the standby seats (named in the SERVER_BUSY
+            # payload) instead of queueing them on the object's dispatch
+            # lock — the activated-objects-always-served rule above only
+            # holds for writes once reads have somewhere else to go.
+            busy = self._readscale.shed_read(req, object_id, self._load)
+            if busy is not None:
+                return busy
+        if self._storage_health is not None and self._storage_health.degraded:
+            # Routing succeeded end to end: mark the request path recovered
+            # (journal one STORAGE event per outage edge, not per request).
+            if self._storage_health.note_ok("service") and self._journal is not None:
+                self._journal.record(STORAGE, source="service", mode="recovered")
+        return None
+
+    def _placement_degraded(
+        self, object_id: ObjectId, exc: Exception
+    ) -> ResponseError | None:
+        """Storage-down fallback for the routing block.
+
+        Seated actors keep serving from the local registry cache — their
+        directory row cannot have moved without a migration, and migrations
+        need the same storage that just failed. Everything else sheds with
+        the retryable SERVER_BUSY path: the client backs off with
+        decorrelated jitter and re-routes, so new placements degrade to
+        bounded retries instead of errors or hangs.
+        """
+        health = self._storage_health
+        first = False
+        if health is not None:
+            first = health.note_error("placement.route", exc, source="service")
+        seated = self.registry.has(object_id.type_name, object_id.id)
+        key = f"{object_id.type_name}/{object_id.id}"
+        if first:
+            log.warning("storage degraded on request path (%s): %r", key, exc)
+            if self._journal is not None:
+                self._journal.record(
+                    STORAGE,
+                    key,
+                    source="service",
+                    mode="degraded",
+                    seated=seated,
+                    error=repr(exc)[:120],
+                )
+        if seated:
+            if health is not None:
+                health.note_degraded_serve()
+            return None
+        if health is not None:
+            health.note_shed()
+        return ResponseError.server_busy(
+            f"storage unavailable: {type(exc).__name__}"
+        )
+
     async def _call_timed(
         self, req: RequestEnvelope, trace_id: str | None
     ) -> ResponseEnvelope:
@@ -403,35 +501,23 @@ class Service:
             if routing is not None:
                 return ResponseEnvelope.err(routing)
         else:
-            if self._readscale is not None:
-                # Standby serve-or-forward runs BEFORE the overload shed: a
-                # replica read never activates anything here, so shedding it
-                # (or redirecting to the primary we exist to offload) would
-                # defeat the read scale-out exactly when it matters.
-                served = await self._readscale.try_serve_standby(req, object_id)
-                if served is not None:
-                    return served
-            shed = await self._shed_if_overloaded(object_id)
-            if shed is not None:
-                return ResponseEnvelope.err(shed)
-            refusal = await self._refuse_if_draining(object_id)
-            if refusal is None:
-                refusal = await self._refuse_if_migrating(object_id)
-            if refusal is not None:
-                return ResponseEnvelope.err(refusal)
-            addr = await self.get_or_create_placement(object_id)
-            mismatch = await self.check_address_mismatch(addr)
-            if mismatch is not None:
-                return ResponseEnvelope.err(mismatch)
-            if self._readscale is not None:
-                # This node IS the primary. Under load, divert @readonly
-                # requests to the standby seats (named in the SERVER_BUSY
-                # payload) instead of queueing them on the object's dispatch
-                # lock — the activated-objects-always-served rule above only
-                # holds for writes once reads have somewhere else to go.
-                busy = self._readscale.shed_read(req, object_id, self._load)
-                if busy is not None:
-                    return ResponseEnvelope.err(busy)
+            try:
+                t = self._route_timeout
+                if t is None:
+                    routed = await self._route(req, object_id)
+                else:
+                    # Bounded directory awaits: a HUNG (not erroring)
+                    # rendezvous times the routing block out into the same
+                    # degraded path an exception takes.
+                    routed = await asyncio.wait_for(self._route(req, object_id), t)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — rendezvous down
+                routed = self._placement_degraded(object_id, e)
+            if routed is not None:
+                if isinstance(routed, ResponseEnvelope):
+                    return routed
+                return ResponseEnvelope.err(routed)
 
         start_err = await self.start_service_object(object_id)
         if start_err is not None:

@@ -17,11 +17,33 @@ from __future__ import annotations
 import asyncio
 from typing import Any
 
-__all__ = ["RespError", "RedisClient", "Transaction", "encode_command"]
+__all__ = [
+    "RespError",
+    "RedisClient",
+    "Transaction",
+    "check_replies",
+    "encode_command",
+]
 
 
 class RespError(Exception):
     """Server-side error reply (``-ERR ...``)."""
+
+
+def check_replies(replies: list) -> list:
+    """Raise the first in-place ``RespError`` from a pipelined reply list.
+
+    ``execute_pipeline`` returns server errors in place so callers that can
+    tolerate per-command failure see all replies — but a caller that acks a
+    WRITE pipeline without checking silently drops the failed command (the
+    chaos matrix caught exactly that: an injected -ERR on the SET half of a
+    placement upsert acked a write that never landed). Every pipeline whose
+    errors must not be swallowed goes through this gate.
+    """
+    for r in replies:
+        if isinstance(r, RespError):
+            raise r
+    return replies
 
 
 def encode_command(*args: Any) -> bytes:

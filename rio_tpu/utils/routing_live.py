@@ -82,6 +82,7 @@ async def boot_echo_cluster(
     n_servers: int,
     *,
     transport: str = "asyncio",
+    members=None,
     placement=None,
     server_kwargs: dict | None = None,
 ):
@@ -91,9 +92,11 @@ async def boot_echo_cluster(
     measured benchmarks (route hops, RPC throughput). Callers cancel the
     returned tasks to tear the cluster down. ``server_kwargs`` are forwarded
     to every :class:`Server` (the tracing A/B boots with ``metrics=False``
-    to reconstruct the pre-metrics hot path).
+    to reconstruct the pre-metrics hot path); ``members``/``placement``
+    substitute the storage backends (the faults A/B boots over idle
+    fault-injection wrappers).
     """
-    members = LocalStorage()
+    members = members if members is not None else LocalStorage()
     placement = placement if placement is not None else LocalObjectPlacement()
     servers: list[Server] = []
     tasks: list[asyncio.Task] = []

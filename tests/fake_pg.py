@@ -32,6 +32,28 @@ _ENGINES: dict[str, sqlite3.Connection] = {}
 _ENGINES_LOCK = threading.Lock()
 _EXEC_LOCK = threading.RLock()  # serialize all statements on the shared engine
 
+# Scriptable fault injection (rio_tpu.faults.FaultSchedule | None): when
+# set, every connect() and statement execution consults the schedule
+# synchronously (these run on executor threads via PgDb's asyncio.to_thread
+# bridge — ``apply_sync`` sleeps/raises there without touching the loop).
+# Ops: "pg.connect", "pg.execute", "pg.commit". Chaos tests script outages
+# here to prove the REAL Postgres backends ride the resilience paths.
+_FAULTS = None
+
+
+def set_faults(schedule) -> None:
+    """Install (or clear, with None) the module-wide fault schedule."""
+    global _FAULTS
+    _FAULTS = schedule
+
+
+def _perturb(op: str) -> None:
+    if _FAULTS is not None:
+        try:
+            _FAULTS.apply_sync(op)
+        except Exception as e:
+            raise Error(f"injected: {e}") from e
+
 
 class Error(Exception):
     """DBAPI base error (psycopg.Error stand-in)."""
@@ -66,6 +88,7 @@ class FakeCursor:
         self._cur.close()
 
     def execute(self, sql: str, params=()) -> None:
+        _perturb("pg.execute")
         with _EXEC_LOCK:
             try:
                 self._cur.execute(_qmark(sql), tuple(params or ()))
@@ -99,6 +122,7 @@ class FakeConnection:
         return FakeCursor(self._engine)
 
     def commit(self) -> None:
+        _perturb("pg.commit")
         with _EXEC_LOCK:
             self._engine.commit()
 
@@ -112,11 +136,14 @@ class FakeConnection:
 
 
 def connect(dsn: str) -> FakeConnection:
+    _perturb("pg.connect")
     return FakeConnection(dsn)
 
 
 def reset() -> None:
     """Drop all fake databases (test isolation)."""
+    global _FAULTS
+    _FAULTS = None
     with _ENGINES_LOCK:
         for engine in _ENGINES.values():
             engine.close()

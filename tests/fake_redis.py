@@ -67,6 +67,22 @@ class FakeRedisServer:
         self._server: asyncio.AbstractServer | None = None
         self._writers: set[asyncio.StreamWriter] = set()
         self.port = 0
+        # Scriptable fault injection (rio_tpu.faults.FaultSchedule | None):
+        # consulted before every command under ops "redis.<cmd>" (e.g.
+        # "redis.get", "redis.zadd"). Injected errors surface as wire-level
+        # ``-ERR injected ...`` replies; latency sleeps on the server side;
+        # a hang parks the command until ``schedule.heal()`` — exactly what
+        # a stalled real Redis looks like to the client pool.
+        self.faults = None
+        # When True, an injected error CLOSES the connection instead of
+        # replying -ERR — models a crashing/restarting server, exercising
+        # the client's reconnect path rather than its error path.
+        self.faults_reset_conn = False
+
+    def set_faults(self, schedule, *, reset_conn: bool = False) -> None:
+        """Install (or clear, with None) the server's fault schedule."""
+        self.faults = schedule
+        self.faults_reset_conn = reset_conn
 
     async def start(self) -> "FakeRedisServer":
         self._server = await asyncio.start_server(self._serve, "127.0.0.1", 0)
@@ -93,6 +109,23 @@ class FakeRedisServer:
                     break
                 if not cmd:
                     break
+                # Faults fire on standalone commands only: once a MULTI is
+                # open the transaction's atomicity is the backend contract
+                # (commands must reach the queue or the whole EXEC aborts),
+                # so injecting a per-command -ERR there would simulate a
+                # corruption no real Redis exhibits.
+                if self.faults is not None and session.multi is None:
+                    op = "redis." + cmd[0].decode().lower()
+                    try:
+                        await self.faults.perturb(op)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e:  # noqa: BLE001 — injected
+                        if self.faults_reset_conn:
+                            break  # close the socket: simulated crash
+                        writer.write(b"-ERR injected %s\r\n" % str(e).encode())
+                        await writer.drain()
+                        continue
                 try:
                     reply = self._handle(session, cmd)
                 except Exception as e:  # noqa: BLE001 — surfaced as -ERR

@@ -1,0 +1,346 @@
+"""Unit tests for the fault-injection subsystem (rio_tpu/faults.py).
+
+Covers the schedule's determinism contract (same seed + same call sequence
+=> same fault pattern), scripted and time-window outages, hang/heal
+parking, the storage-trait wrappers' gating and health accounting, and the
+transport layer's directional link verdicts.
+"""
+
+import asyncio
+
+import pytest
+
+from rio_tpu.cluster.storage import LocalStorage, Member
+from rio_tpu.errors import Disconnect
+from rio_tpu.faults import (
+    FaultRule,
+    FaultSchedule,
+    FaultyMembershipStorage,
+    FaultyObjectPlacement,
+    FaultyReminderStorage,
+    InjectedFault,
+    LinkRule,
+    OutageWindow,
+    StorageHealth,
+    TransportFaults,
+)
+from rio_tpu.journal import FAULT, Journal
+from rio_tpu.object_placement import (
+    LocalObjectPlacement,
+    ObjectId,
+    ObjectPlacementItem,
+)
+from rio_tpu.reminders import LocalReminderStorage, Reminder
+
+
+# ---------------------------------------------------------------------------
+# FaultSchedule
+# ---------------------------------------------------------------------------
+
+
+def _decisions(seed: int, n: int = 64) -> list[tuple[float, bool, bool]]:
+    s = FaultSchedule(
+        seed=seed,
+        rules=[FaultRule(op="placement.*", error_rate=0.3, jitter=0.01)],
+    )
+    return [s.decide("placement.lookup") for _ in range(n)]
+
+
+def test_schedule_is_deterministic_per_seed():
+    assert _decisions(7) == _decisions(7)
+    assert _decisions(7) != _decisions(8)  # astronomically unlikely to match
+
+
+def test_rules_match_by_fnmatch_pattern():
+    s = FaultSchedule(rules=[FaultRule(op="membership.*", error_rate=1.0)])
+    assert s.decide("membership.members")[1] is True
+    assert s.decide("placement.lookup")[1] is False
+
+
+def test_fail_all_and_heal_script_total_outages():
+    s = FaultSchedule()
+    assert not s.is_down("membership.members")
+    s.fail_all("membership.*")
+    assert s.is_down("membership.members")
+    assert not s.is_down("placement.lookup")
+    _, fail, hang = s.decide("membership.push")
+    assert fail and not hang
+    s.heal()
+    assert not s.is_down("membership.members")
+    assert s.decide("membership.push") == (0.0, False, False)
+
+
+def test_outage_window_runs_on_injected_clock():
+    t = [0.0]
+    s = FaultSchedule(outages=[OutageWindow(start=1.0, end=2.0)], clock=lambda: t[0])
+    s.start()
+    assert not s.is_down("placement.lookup")
+    t[0] = 1.5
+    assert s.is_down("placement.lookup")
+    assert s.decide("placement.lookup")[1] is True
+    t[0] = 2.5
+    assert not s.is_down("placement.lookup")
+
+
+@pytest.mark.asyncio
+async def test_perturb_raises_and_counts():
+    s = FaultSchedule(rules=[FaultRule(op="x", error_rate=1.0)])
+    with pytest.raises(InjectedFault) as ei:
+        await s.perturb("x")
+    assert ei.value.op == "x"
+    assert s.injected_errors == 1
+    await s.perturb("unrelated")  # no rule -> no-op
+    assert s.injected_errors == 1
+
+
+@pytest.mark.asyncio
+async def test_hang_parks_until_heal():
+    s = FaultSchedule()
+    s.fail_all("*", hang=True)
+    parked = asyncio.ensure_future(s.perturb("membership.members"))
+    await asyncio.sleep(0.05)
+    assert not parked.done(), "hang did not park the call"
+    s.heal()
+    await asyncio.wait_for(parked, 1.0)
+    assert s.injected_hangs == 1
+
+
+def test_apply_sync_degrades_hang_to_error():
+    s = FaultSchedule()
+    s.fail_all("*", hang=True)
+    with pytest.raises(InjectedFault):
+        s.apply_sync("pg.execute")
+    s.heal()
+    s.apply_sync("pg.execute")  # healthy: no-op
+
+
+def test_disabled_schedule_is_a_noop():
+    s = FaultSchedule(rules=[FaultRule(error_rate=1.0)])
+    s.enabled = False
+    assert s.decide("anything") == (0.0, False, False)
+
+
+def test_schedule_journals_fault_edges():
+    j = Journal(capacity=16, node="t")
+    s = FaultSchedule(journal=j)
+    s.fail_all("membership.*")
+    s.heal()
+    kinds = [(ev.kind, ev.attrs.get("action")) for ev in j.events()]
+    assert (FAULT, "fail_all") in kinds
+    assert (FAULT, "heal") in kinds
+
+
+# ---------------------------------------------------------------------------
+# Storage wrappers
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_faulty_membership_wrapper_delegates_and_injects():
+    health = StorageHealth()
+    s = FaultSchedule()
+    storage = FaultyMembershipStorage(LocalStorage(), s, health)
+    await storage.push(Member.from_address("h1:1", active=True))
+    assert [m.address for m in await storage.active_members()] == ["h1:1"]
+    assert health.ops >= 2 and health.errors == 0
+
+    s.fail_all("membership.*")
+    with pytest.raises(InjectedFault):
+        await storage.members()
+    assert health.errors == 1 and health.injected == 1
+    s.heal()
+    assert len(await storage.members()) == 1
+
+
+@pytest.mark.asyncio
+async def test_faulty_placement_wrapper_full_surface():
+    s = FaultSchedule()
+    p = FaultyObjectPlacement(LocalObjectPlacement(), s)
+    oid = ObjectId("Svc", "a")
+    await p.update(ObjectPlacementItem(object_id=oid, server_address="h1:1"))
+    assert await p.lookup(oid) == "h1:1"
+    assert await p.lookup_batch([oid]) == ["h1:1"]
+    await p.set_standbys(oid, ["h2:2"])
+    assert await p.standbys(oid) == (["h2:2"], 0)
+    await p.clean_server("h1:1")
+    assert await p.lookup(oid) is None
+    # outage hits only the placement trait
+    s.fail_all("placement.*")
+    with pytest.raises(InjectedFault):
+        await p.items()
+
+
+@pytest.mark.asyncio
+async def test_faulty_reminder_wrapper_keeps_shard_surface():
+    s = FaultSchedule()
+    inner = LocalReminderStorage(num_shards=4)
+    r = FaultyReminderStorage(inner, s)
+    assert r.num_shards == 4
+    await r.upsert(
+        Reminder(
+            object_kind="Svc", object_id="a", reminder_name="tick",
+            period=1.0, next_due=0.0,
+        )
+    )
+    assert len(await r.due(r.shard_for("Svc", "a"), now=1.0)) == 1
+    s.fail_all("reminders.due")
+    with pytest.raises(InjectedFault):
+        await r.due(0, now=1.0)
+    await r.get_lease(0)  # other reminder ops unaffected
+
+
+@pytest.mark.asyncio
+async def test_wrapper_getattr_exposes_inner_extensions():
+    p = FaultyObjectPlacement(LocalObjectPlacement(), FaultSchedule())
+    # Duck-typed provider probes (hasattr in the service layer / daemons)
+    # must see exactly the inner object's surface.
+    assert not hasattr(p, "sync_members")
+    assert hasattr(p, "lookup_batch")
+
+
+@pytest.mark.asyncio
+async def test_real_backend_errors_count_without_injected_flag():
+    class Exploding(LocalStorage):
+        async def members(self):
+            raise RuntimeError("disk on fire")
+
+    health = StorageHealth()
+    storage = FaultyMembershipStorage(Exploding(), FaultSchedule(), health)
+    with pytest.raises(RuntimeError):
+        await storage.members()
+    assert health.errors == 1 and health.injected == 0
+    assert "disk on fire" in health.last_error
+
+
+def test_storage_health_degraded_edges():
+    h = StorageHealth()
+    # First error per source flips the edge; repeats do not.
+    assert h.note_error("m.x", RuntimeError("a"), source="gossip") is True
+    assert h.note_error("m.y", RuntimeError("b"), source="gossip") is False
+    assert h.degraded
+    assert h.note_error("p.z", RuntimeError("c"), source="service") is True
+    assert h.note_ok("gossip") is True
+    assert h.note_ok("gossip") is False
+    assert h.degraded  # service still down
+    assert h.note_ok("service") is True
+    assert not h.degraded
+    g = h.gauges()
+    assert g["rio.storage.errors"] == 3.0
+    assert g["rio.storage.degraded_sources"] == 0.0
+
+
+@pytest.mark.asyncio
+async def test_disabled_schedule_swaps_wrappers_to_passthrough():
+    """``enabled = False`` re-arms wrappers into zero-cost passthrough:
+    the inner backend's bound methods shadow the gated class methods, so
+    a disabled wrapper adds no coroutine and counts nothing. Re-enabling
+    restores the gates (and scripted outages fire again)."""
+    inner = LocalObjectPlacement()
+    s = FaultSchedule()
+    health = StorageHealth()
+    p = FaultyObjectPlacement(inner, s, health)
+    oid = ObjectId("Svc", "a")
+    await p.update(ObjectPlacementItem(object_id=oid, server_address="h1:1"))
+    assert health.ops == 1  # enabled (idle) wrappers count
+
+    s.enabled = False
+    assert p.__dict__["lookup"] == inner.lookup  # swap active
+    assert await p.lookup(oid) == "h1:1"
+    assert health.ops == 1, "disabled passthrough must not count ops"
+
+    s.enabled = True
+    assert "lookup" not in p.__dict__  # gates restored
+    s.fail_all("placement.*")
+    with pytest.raises(InjectedFault):
+        await p.lookup(oid)
+    s.heal()
+    assert await p.lookup(oid) == "h1:1"
+
+
+@pytest.mark.asyncio
+async def test_wrapper_built_on_disabled_schedule_starts_passthrough():
+    s = FaultSchedule()
+    s.enabled = False
+    m = FaultyMembershipStorage(LocalStorage(), s)
+    assert "members" in m.__dict__
+    await m.push(Member.from_address("h1:1", active=True))
+    assert [x.address for x in await m.active_members()] == ["h1:1"]
+
+
+# ---------------------------------------------------------------------------
+# Transport faults
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_partition_is_directional():
+    tf = TransportFaults()
+    tf.partition("a:1", "b:2")
+    with pytest.raises(OSError):
+        await tf.connect_gate("a:1", "b:2")
+    await tf.connect_gate("b:2", "a:1")  # reverse link flows
+    await tf.connect_gate("a:1", "c:3")  # unrelated link flows
+    assert tf.connects_blocked == 1
+    tf.heal()
+    await tf.connect_gate("a:1", "b:2")
+
+
+@pytest.mark.asyncio
+async def test_symmetric_partition_blocks_both_ways():
+    tf = TransportFaults()
+    tf.partition("a:1", "b:2", symmetric=True)
+    for src, dst in (("a:1", "b:2"), ("b:2", "a:1")):
+        with pytest.raises(OSError):
+            await tf.connect_gate(src, dst)
+
+
+@pytest.mark.asyncio
+async def test_heal_removes_only_matching_rules():
+    tf = TransportFaults()
+    tf.partition("a:1", "b:2")
+    tf.partition("a:1", "c:3")
+    tf.heal(src="a:1", dst="b:2")
+    await tf.connect_gate("a:1", "b:2")
+    with pytest.raises(OSError):
+        await tf.connect_gate("a:1", "c:3")
+
+
+class _StubConn:
+    def __init__(self):
+        self.closed = False
+        self.pending = 0
+        self.delivered = 0
+        self.frames: list[bytes] = []
+
+    async def roundtrip(self, frame: bytes) -> bytes:
+        self.frames.append(frame)
+        return b"ok:" + frame
+
+    def write(self, frame: bytes) -> None:
+        self.frames.append(frame)
+
+    def close(self) -> None:
+        self.closed = True
+
+
+@pytest.mark.asyncio
+async def test_faulty_conn_drop_closes_and_disconnects():
+    tf = TransportFaults()
+    inner = _StubConn()
+    conn = tf.wrap_conn(inner, "a:1", "b:2")
+    assert await conn.roundtrip(b"x") == b"ok:x"  # healthy passthrough
+    tf.add_rule(LinkRule(src="a:1", dst="b:2", drop=1.0))
+    with pytest.raises(Disconnect):
+        await conn.roundtrip(b"y")
+    assert inner.closed, "dropped frame must close the underlying conn"
+    assert tf.frames_dropped == 1
+    assert inner.frames == [b"x"], "the dropped frame must never reach the wire"
+
+
+@pytest.mark.asyncio
+async def test_faults_demo_smoke():
+    from rio_tpu.faults import _demo
+
+    gauges = await _demo()
+    assert gauges["rio.faults.errors"] >= 1.0
+    assert gauges["rio.transport_faults.connects_blocked"] >= 1.0

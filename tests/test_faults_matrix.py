@@ -1,0 +1,199 @@
+"""Backend chaos matrix: seeded fault sweeps over every storage backend.
+
+Satellite 2 glue test: the scriptable error/latency/outage modes added to
+``tests/fake_pg.py`` (DBAPI-level, ``pg.*`` ops) and ``tests/fake_redis.py``
+(wire-level, ``redis.*`` ops) plus the trait-level wrappers must all drive
+the same invariant on every backend: under a fixed-seed error rate, a
+retrying caller converges to exactly the acked state — injected failures
+are loud (the retry sees them) but never corrupting (a failed write either
+fully lands or fully doesn't).
+
+The tier-1 run covers one seed per backend; the ``slow`` sweep runs the
+full seed matrix (nightly chaos lane).
+"""
+
+import asyncio
+
+import pytest
+
+from rio_tpu.cluster.storage import Member, MembershipStorage
+from rio_tpu.cluster.storage.sqlite import SqliteMembershipStorage
+from rio_tpu.faults import FaultRule, FaultSchedule, FaultyMembershipStorage
+from rio_tpu.object_placement import ObjectId, ObjectPlacement, ObjectPlacementItem
+from rio_tpu.object_placement.sqlite import SqliteObjectPlacement
+
+FAST_SEEDS = (7,)
+FULL_SEEDS = (7, 23, 1999, 31337)
+
+
+async def _retry(coro_fn, attempts: int = 50):
+    """Drive one storage op to success through injected failures."""
+    last: BaseException | None = None
+    for _ in range(attempts):
+        try:
+            return await coro_fn()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — injected or backend error
+            last = e
+            await asyncio.sleep(0)
+    raise AssertionError(f"op never succeeded through retries: {last!r}")
+
+
+async def _chaos_workload(
+    members: MembershipStorage, placement: ObjectPlacement, keys: int = 12
+) -> None:
+    """Acked-state convergence under churn: every op is retried to ack,
+    then the final read must reflect exactly the acked writes."""
+    await _retry(members.prepare)
+    await _retry(placement.prepare)
+    for i in range(keys):
+        addr = f"10.0.0.{i}:5000"
+        await _retry(lambda a=addr: members.push(Member.from_address(a, active=True)))
+        oid = ObjectId("Svc", f"k{i}")
+        await _retry(
+            lambda o=oid, a=addr: placement.update(
+                ObjectPlacementItem(object_id=o, server_address=a)
+            )
+        )
+    # Interleave reads (they fail/retry too) with targeted mutations.
+    for i in range(0, keys, 3):
+        await _retry(lambda i=i: members.set_inactive("10.0.0.%d" % i, 5000))
+        await _retry(lambda i=i: placement.remove(ObjectId("Svc", f"k{i}")))
+
+    active = await _retry(members.active_members)
+    assert {m.address for m in active} == {
+        f"10.0.0.{i}:5000" for i in range(keys) if i % 3 != 0
+    }
+    for i in range(keys):
+        owner = await _retry(lambda i=i: placement.lookup(ObjectId("Svc", f"k{i}")))
+        assert owner == (None if i % 3 == 0 else f"10.0.0.{i}:5000")
+
+
+# ---------------------------------------------------------------------------
+# sqlite — trait-level wrappers
+# ---------------------------------------------------------------------------
+
+
+async def _sqlite_case(tmp_path, seed: int) -> None:
+    schedule = FaultSchedule(
+        seed=seed, rules=[FaultRule(op="*", error_rate=0.25)]
+    )
+    members = FaultyMembershipStorage(
+        SqliteMembershipStorage(str(tmp_path / f"m{seed}.db")), schedule
+    )
+    from rio_tpu.faults import FaultyObjectPlacement
+
+    placement = FaultyObjectPlacement(
+        SqliteObjectPlacement(str(tmp_path / f"p{seed}.db")), schedule
+    )
+    await _chaos_workload(members, placement)
+    assert schedule.injected_errors > 0, "the sweep injected nothing"
+
+
+@pytest.mark.asyncio
+async def test_sqlite_chaos_fixed_seed(tmp_path):
+    for seed in FAST_SEEDS:
+        await _sqlite_case(tmp_path, seed)
+
+
+@pytest.mark.slow
+@pytest.mark.asyncio
+async def test_sqlite_chaos_seed_sweep(tmp_path):
+    for seed in FULL_SEEDS:
+        await _sqlite_case(tmp_path, seed)
+
+
+# ---------------------------------------------------------------------------
+# fake-pg — DBAPI-level injection (pg.* ops through apply_sync)
+# ---------------------------------------------------------------------------
+
+
+async def _pg_case(seed: int) -> None:
+    from tests import fake_pg
+
+    fake_pg.install()
+    fake_pg.reset()
+    from rio_tpu.cluster.storage.postgres import PostgresMembershipStorage
+    from rio_tpu.object_placement.postgres import PostgresObjectPlacement
+
+    schedule = FaultSchedule(
+        seed=seed, rules=[FaultRule(op="pg.execute", error_rate=0.15)]
+    )
+    dsn = f"postgresql://fake-pg/chaos{seed}"
+    members = PostgresMembershipStorage(dsn)
+    placement = PostgresObjectPlacement(dsn)
+    # Prepare cleanly, then inject at the statement level underneath the
+    # REAL Postgres backends — their rollback/recovery paths execute.
+    await members.prepare()
+    await placement.prepare()
+    fake_pg.set_faults(schedule)
+    try:
+        await _chaos_workload(members, placement)
+        assert schedule.injected_errors > 0
+    finally:
+        fake_pg.set_faults(None)
+        fake_pg.reset()
+
+
+@pytest.mark.asyncio
+async def test_fake_pg_chaos_fixed_seed():
+    for seed in FAST_SEEDS:
+        await _pg_case(seed)
+
+
+@pytest.mark.slow
+@pytest.mark.asyncio
+async def test_fake_pg_chaos_seed_sweep():
+    for seed in FULL_SEEDS:
+        await _pg_case(seed)
+
+
+# ---------------------------------------------------------------------------
+# fake-redis — wire-level injection (redis.* ops, -ERR replies)
+# ---------------------------------------------------------------------------
+
+
+async def _redis_case(seed: int, *, reset_conn: bool = False) -> None:
+    from rio_tpu.cluster.storage.redis import RedisMembershipStorage
+    from rio_tpu.object_placement.redis import RedisObjectPlacement
+    from rio_tpu.utils.resp import RedisClient
+
+    from .fake_redis import FakeRedisServer
+
+    server = await FakeRedisServer().start()
+    try:
+        client = RedisClient("127.0.0.1", server.port)
+        members = RedisMembershipStorage(client, key_prefix=f"chaos{seed}_m")
+        placement = RedisObjectPlacement(client, key_prefix=f"chaos{seed}_p")
+        schedule = FaultSchedule(
+            seed=seed, rules=[FaultRule(op="redis.*", error_rate=0.1)]
+        )
+        server.set_faults(schedule, reset_conn=reset_conn)
+        await _chaos_workload(members, placement)
+        assert schedule.injected_errors > 0
+        server.set_faults(None)
+        client.close()
+    finally:
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_fake_redis_chaos_fixed_seed():
+    for seed in FAST_SEEDS:
+        await _redis_case(seed)
+
+
+@pytest.mark.slow
+@pytest.mark.asyncio
+async def test_fake_redis_chaos_seed_sweep():
+    for seed in FULL_SEEDS:
+        await _redis_case(seed)
+
+
+@pytest.mark.asyncio
+async def test_fake_redis_chaos_connection_resets():
+    """``reset_conn`` mode: injected faults close the socket instead of
+    replying -ERR — the client pool's reconnect path carries the load."""
+    for seed in FAST_SEEDS:
+        await _redis_case(seed, reset_conn=True)

@@ -1,0 +1,202 @@
+"""Gossip failure-detector resilience under storage outages and partitions.
+
+Satellite regressions for the fault-injection PR:
+
+* a transient ``members()`` failure must not stop the serve loop — pings
+  keep running off the last good view and the node keeps re-pushing its
+  own registration (the pre-fix loop died on the first storage exception);
+* an asymmetric partition (A cannot reach B, while B still reaches the
+  rendezvous) must converge to a growing failure ledger WITHOUT a flapping
+  activate/deactivate cycle — B's fresh heartbeat row vetoes the inactive
+  verdict.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from rio_tpu.cluster.membership_protocol.peer_to_peer import (
+    PeerToPeerClusterConfig,
+    PeerToPeerClusterProvider,
+)
+from rio_tpu.cluster.storage import LocalStorage, Member
+from rio_tpu.faults import (
+    FaultSchedule,
+    FaultyMembershipStorage,
+    StorageHealth,
+    TransportFaults,
+)
+from rio_tpu.journal import STORAGE, Journal
+
+A = "127.0.0.1:7101"
+B = "127.0.0.1:7102"
+
+
+def _fast_config(**overrides) -> PeerToPeerClusterConfig:
+    base = dict(
+        interval_secs=0.05,
+        num_failures_threshold=1,
+        interval_secs_threshold=2.0,
+        ping_timeout=0.1,
+    )
+    base.update(overrides)
+    return PeerToPeerClusterConfig(**base)
+
+
+async def _wait_for(predicate, timeout: float = 5.0, what: str = "condition"):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if predicate():
+            return
+        await asyncio.sleep(0.01)
+    raise TimeoutError(f"never reached: {what}")
+
+
+@pytest.mark.asyncio
+async def test_gossip_survives_transient_members_failure():
+    """The satellite-1 bugfix: one members() blip must not kill the loop."""
+    inner = LocalStorage()
+    schedule = FaultSchedule()
+    storage = FaultyMembershipStorage(inner, schedule)
+    journal = Journal(capacity=64, node=A)
+    provider = PeerToPeerClusterProvider(storage, _fast_config())
+    provider.set_observability(journal=journal, storage_health=StorageHealth())
+    # A peer that exists in the directory but listens nowhere: its pings
+    # fail fast, so ledger growth proves the prober is still running.
+    await inner.push(Member.from_address(B, active=True))
+
+    task = asyncio.ensure_future(provider.serve(A))
+    try:
+        await _wait_for(lambda: provider.stats.ticks >= 2, what="first ticks")
+
+        schedule.fail_all("membership.members")
+        ticks_at_outage = provider.stats.ticks
+        ip, port = B.rsplit(":", 1)
+        failures_at_outage = len(await inner.member_failures(ip, int(port)))
+        await _wait_for(
+            lambda: provider.stats.degraded_ticks >= 2,
+            what="degraded ticks under the outage",
+        )
+        # The loop is still ALIVE: ticking from the last good view, still
+        # probing the dead peer (the failure ledger keeps growing).
+        await _wait_for(
+            lambda: provider.stats.ticks > ticks_at_outage + 1,
+            what="ticks continuing through the outage",
+        )
+        failures_now = len(await inner.member_failures(ip, int(port)))
+        assert failures_now > failures_at_outage, "prober stopped during outage"
+
+        schedule.heal()
+        push_t0 = time.time()
+        await _wait_for(
+            lambda: provider.stats.ticks > 0 and not provider._storage_down,
+            what="recovery after heal",
+        )
+        # Re-push resumed: our own row's heartbeat is fresher than the heal.
+        await asyncio.sleep(0.15)
+        me = {m.address: m for m in await inner.members()}[A]
+        assert me.active and me.last_seen >= push_t0 - 0.001
+
+        kinds = [(ev.kind, ev.attrs.get("mode")) for ev in journal.events()]
+        assert (STORAGE, "degraded") in kinds
+        assert (STORAGE, "recovered") in kinds
+        # One event per edge, not one per failed call.
+        assert kinds.count((STORAGE, "degraded")) == 1
+    finally:
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+
+
+@pytest.mark.asyncio
+async def test_gossip_registration_retries_through_boot_outage():
+    """A rendezvous that is down at boot delays registration; it must not
+    kill the provider before its first tick."""
+    inner = LocalStorage()
+    schedule = FaultSchedule()
+    schedule.fail_all("membership.push")
+    storage = FaultyMembershipStorage(inner, schedule)
+    provider = PeerToPeerClusterProvider(storage, _fast_config())
+
+    task = asyncio.ensure_future(provider.serve(A))
+    try:
+        await asyncio.sleep(0.2)
+        assert await inner.members() == []  # still down: not registered
+        assert not task.done(), "provider died during the boot outage"
+        schedule.heal()
+        await _wait_for(
+            lambda: provider.stats.ticks >= 1, what="ticks after boot recovery"
+        )
+        assert [m.address for m in await inner.active_members()] == [A]
+    finally:
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+
+
+class _FlipCountingStorage(LocalStorage):
+    """LocalStorage that counts activity flips (the flap detector)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.deactivations: list[str] = []
+
+    async def set_is_active(self, ip: str, port: int, active: bool) -> None:
+        if not active:
+            self.deactivations.append(f"{ip}:{port}")
+        await super().set_is_active(ip, port, active)
+
+
+async def _run_partitioned(trust: bool) -> tuple[_FlipCountingStorage, PeerToPeerClusterProvider]:
+    """Drive A's prober against a one-way partitioned, heartbeat-fresh B
+    for ~1s; return the storage (flip counts) and provider (stats)."""
+    storage = _FlipCountingStorage()
+    faults = TransportFaults()
+    faults.partition(A, B)  # A cannot reach B; B reaches storage fine
+    provider = PeerToPeerClusterProvider(
+        storage,
+        _fast_config(trust_heartbeat_freshness=trust),
+        transport_faults=faults,
+    )
+    await storage.push(Member.from_address(B, active=True))
+
+    async def b_heartbeat():
+        while True:
+            await asyncio.sleep(0.03)
+            await storage.push(Member.from_address(B, active=True))
+
+    serve = asyncio.ensure_future(provider.serve(A))
+    beat = asyncio.ensure_future(b_heartbeat())
+    try:
+        await _wait_for(lambda: provider.stats.ticks >= 10, what="ticks")
+    finally:
+        for t in (serve, beat):
+            t.cancel()
+        await asyncio.gather(serve, beat, return_exceptions=True)
+    return storage, provider
+
+
+@pytest.mark.asyncio
+async def test_asymmetric_partition_converges_without_flapping():
+    """Satellite 3: the ledger records the one-way failure, but the fresh
+    heartbeat suppresses the inactive verdict — no activate/deactivate
+    churn against B's own re-push."""
+    storage, provider = await _run_partitioned(trust=True)
+    ip, port = B.rsplit(":", 1)
+    assert len(await storage.member_failures(ip, int(port))) > 0, (
+        "failure ledger did not converge on the unreachable link"
+    )
+    assert provider.stats.suppressed_verdicts > 0
+    assert storage.deactivations == [], "anti-flap rule failed: B was deactivated"
+    assert await storage.is_active(B), "heartbeat-fresh member flipped inactive"
+
+
+@pytest.mark.asyncio
+async def test_asymmetric_partition_flaps_without_freshness_rule():
+    """The contrast run: with the veto disabled the old behavior returns —
+    the prober deactivates a member that is provably still alive, and the
+    member's own heartbeat re-activates it (the flap this PR removes)."""
+    storage, provider = await _run_partitioned(trust=False)
+    assert provider.stats.suppressed_verdicts == 0
+    assert len(storage.deactivations) > 0, (
+        "expected the legacy flap when trust_heartbeat_freshness=False"
+    )

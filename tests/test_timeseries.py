@@ -309,10 +309,11 @@ def test_default_rules_cover_the_stock_alarm_set():
     names = {r.name for r in default_rules()}
     assert names == {
         "p99_rising", "loop_lag_rising", "journal_dropped", "shed_rate",
-        "residual_diverging", "solve_ms_drift",
+        "residual_diverging", "storage_errors", "solve_ms_drift",
     }
     kinds = {r.name: r.kind for r in default_rules()}
     assert kinds["journal_dropped"] == "delta"
+    assert kinds["storage_errors"] == "delta"
     assert kinds["solve_ms_drift"] == "drift"
 
 
