@@ -1889,6 +1889,32 @@ def series_overhead() -> dict:
     return out
 
 
+def spans_overhead() -> dict:
+    """RPC-loop cost of request-waterfall span retention, A/B'd in the
+    SAME session: servers with spans=False vs retention on with head
+    sampling off and tail capture armed at a 1 ms SLO (250x tighter than
+    the shipping default). The ISSUE 14 acceptance bar is ≤ ~2% at the
+    request level; median paired ratio is the stable artifact, stamped
+    with host provenance like every host stage."""
+    import asyncio
+
+    from rio_tpu.utils.spans_live import measure_spans_overhead
+
+    out = asyncio.run(measure_spans_overhead())
+    out["host"] = _host_provenance()
+    m = out["msgs_per_sec"]
+    print(
+        f"# spans overhead ({out['batches']} interleaved batches x "
+        f"{out['n_requests_per_batch']} reqs, 2 servers/mode, tail SLO "
+        f"{out['slo_ms']}ms, median paired ratio): off "
+        f"{m['off']:,.0f}/s, on {m['on']:,.0f}/s "
+        f"({out['spans_overhead_pct']:+}%, {out['retained_on']} retained, "
+        f"{out['tail_captured_on']} tail-captured)",
+        file=sys.stderr,
+    )
+    return out
+
+
 _TPU_PLATFORMS = os.environ.get("JAX_PLATFORMS")  # as the driver launched us
 
 
@@ -2254,6 +2280,10 @@ def main() -> None:
     except Exception as e:
         print(f"# series overhead failed: {e!r}", file=sys.stderr)
     try:
+        detail["spans"] = spans_overhead()
+    except Exception as e:
+        print(f"# spans overhead failed: {e!r}", file=sys.stderr)
+    try:
         detail["faults"] = faults_overhead()
     except Exception as e:
         print(f"# faults overhead failed: {e!r}", file=sys.stderr)
@@ -2415,6 +2445,9 @@ if __name__ == "__main__":
     # Run the gauge time-series sampling A/B alone and bank it into the
     # cpu sidecar (same CPU-safe in-process-cluster shape as --migration).
     parser.add_argument("--series", action="store_true")
+    # Run the request-waterfall span-retention A/B alone and bank it into
+    # the cpu sidecar (same CPU-safe in-process-cluster shape as --series).
+    parser.add_argument("--spans", action="store_true")
     # Run the sharded data-plane A/B battery alone and bank it into the
     # cpu sidecar (real worker processes on loopback; CPU-safe).
     parser.add_argument("--sharded", action="store_true")
@@ -2449,6 +2482,23 @@ if __name__ == "__main__":
         except (OSError, ValueError):
             detail = {}
         detail["series"] = out
+        _write_detail(detail, here)
+        print(json.dumps(out))
+    elif args.spans:
+        # Standalone --spans updates the banked cpu sidecar in place (the
+        # --series pattern): the A/B carries its own paired baseline, so
+        # it can refresh independently of the other host stages.
+        _pin_orchestrator_to_cpu()
+        out = spans_overhead()
+        here = os.path.dirname(os.path.abspath(__file__))
+        try:
+            with open(os.path.join(here, "BENCH_DETAIL.cpu.json")) as fh:
+                detail = json.load(fh)
+            if not isinstance(detail, dict):
+                detail = {}
+        except (OSError, ValueError):
+            detail = {}
+        detail["spans"] = out
         _write_detail(detail, here)
         print(json.dumps(out))
     elif args.sharded:

@@ -29,7 +29,14 @@ Operator CLI (see ``_cli_main``)::
     python -m rio_tpu.admin tail    --nodes host:p,host:p [--kind K] [--key K]
     python -m rio_tpu.admin explain --nodes host:p,host:p TYPE ID
     python -m rio_tpu.admin stats   --nodes host:p,host:p
-    python -m rio_tpu.admin --demo {tail|explain|stats}   # in-process 2-node demo
+    python -m rio_tpu.admin trace   --nodes host:p,host:p TRACE_ID
+    python -m rio_tpu.admin --demo {tail|explain|stats|watch|trace}
+
+A fourth wire pair serves the request-waterfall plane: :class:`DumpSpans`
+→ :class:`SpansSnapshot` returns the node's retained request spans
+(``rio_tpu/spans.py``); :func:`scrape_spans` + :func:`assemble_waterfall`
+merge every ring — servers and the calling process's client ring — into
+causally ordered per-trace hop trees rendered by the ``trace`` subcommand.
 """
 
 from __future__ import annotations
@@ -138,6 +145,41 @@ class SeriesSnapshot:
         from .timeseries import SeriesSample
 
         return [SeriesSample.from_row(r) for r in self.rows]
+
+
+@message(name="rio.DumpSpans")
+@dataclass
+class DumpSpans:
+    """Ask a node for retained request spans from its waterfall ring.
+
+    ``trace_id`` filters to one trace (empty = every retained span);
+    ``since_seq`` resumes a tail (only spans with ``seq > since_seq``
+    return); ``limit`` bounds the response to the NEWEST matches
+    (0 = ring capacity).
+    """
+
+    trace_id: str = ""
+    since_seq: int = 0
+    limit: int = 256
+
+
+@message(name="rio.SpansSnapshot")
+@dataclass
+class SpansSnapshot:
+    """One node's retained spans (merge with ``spans.merge_spans``)."""
+
+    address: str = ""
+    node_seq: int = 0  # the node's latest span seq (tail resume point)
+    dropped: int = 0  # ring-overwrite counter at scrape time
+    # SpanRecord wire rows: [seq, trace_id, span_id, parent_id, name,
+    # node, wall_start, duration_us, attrs] — decode with
+    # SpanRecord.from_row.
+    rows: list = field(default_factory=list)
+
+    def spans(self) -> list:
+        from .spans import SpanRecord
+
+        return [SpanRecord.from_row(r) for r in self.rows]
 
 
 @message(name="rio.AdminRequest")
@@ -252,6 +294,28 @@ class AdminControl(ServiceObject):
         )
 
     @handler
+    async def dump_spans(self, msg: DumpSpans, ctx: AppData) -> SpansSnapshot:
+        from .commands import ServerInfo
+        from .spans import SpanRing
+
+        info = ctx.try_get(ServerInfo)
+        address = info.address if info else ""
+        ring = ctx.try_get(SpanRing)
+        if ring is None:
+            return SpansSnapshot(address=address)
+        records = ring.spans(
+            trace_id=msg.trace_id or None,
+            since_seq=msg.since_seq,
+            limit=msg.limit if msg.limit > 0 else None,
+        )
+        return SpansSnapshot(
+            address=address,
+            node_seq=ring.retained,
+            dropped=ring.dropped,
+            rows=[r.to_row() for r in records],
+        )
+
+    @handler
     async def admin(self, msg: AdminRequest, ctx: AppData) -> AdminAck:
         sender = ctx.try_get(AdminSender)
         if sender is None:
@@ -328,6 +392,31 @@ async def scrape_series(
     return snapshots
 
 
+async def scrape_spans(
+    client: Any,
+    nodes: Any,
+    *,
+    trace_id: str = "",
+    since_seq: int = 0,
+    limit: int = 256,
+) -> list[SpansSnapshot]:
+    """One :class:`DumpSpans` round trip per live node; dead nodes skipped.
+
+    Nodes predating span retention answer the admin envelope with an
+    error (unknown message) — they are skipped like unreachable nodes, so
+    a mixed-version cluster still yields the survivors' spans.
+    """
+    msg = DumpSpans(trace_id=trace_id, since_seq=since_seq, limit=limit)
+    snapshots: list[SpansSnapshot] = []
+    for address in await _node_addresses(nodes):
+        try:
+            snap = await client.send(ADMIN_TYPE, address, msg, returns=SpansSnapshot)
+        except Exception:
+            continue
+        snapshots.append(snap)
+    return snapshots
+
+
 async def cluster_events(
     client: Any,
     nodes: Any,
@@ -365,7 +454,115 @@ async def explain(
     )
 
 
-# -- operator CLI: python -m rio_tpu.admin {tail|explain|stats|watch} --------
+# -- request waterfalls (the trace plane) ------------------------------------
+
+
+def assemble_waterfall(
+    records: Iterable[Any], events: Iterable[JournalEvent] = ()
+) -> dict[str, dict]:
+    """Group merged span records into per-trace waterfall trees.
+
+    Returns ``{trace_id: {"roots": [hop...], "hops": n, "events": [...]}}``
+    where each hop is ``{"record": SpanRecord, "children": [hop...]}``.
+    Roots are records whose ``parent_id`` is empty or names a span no ring
+    retained (e.g. a caller that never armed its client ring); siblings
+    order by wall-clock start, so a redirect hop on node A prints before
+    the re-dispatched hop on node B it caused. Journal events carrying the
+    trace id ride along, joining placement history to request timing.
+    """
+    from .spans import merge_spans
+
+    merged = merge_spans([records])
+    by_trace: dict[str, list] = {}
+    for rec in merged:
+        by_trace.setdefault(rec.trace_id, []).append(rec)
+    ev_by_trace: dict[str, list[JournalEvent]] = {}
+    for ev in events:
+        if ev.trace_id:
+            ev_by_trace.setdefault(ev.trace_id, []).append(ev)
+    out: dict[str, dict] = {}
+    for trace_id, recs in by_trace.items():
+        span_ids = {r.span_id for r in recs}
+        hops = [{"record": r, "children": []} for r in recs]
+        by_span = {h["record"].span_id: h for h in hops}
+        roots: list[dict] = []
+        for h in hops:  # recs are merge-ordered, so children/roots stay sorted
+            pid = h["record"].parent_id
+            if pid and pid in span_ids and pid != h["record"].span_id:
+                by_span[pid]["children"].append(h)
+            else:
+                roots.append(h)
+        out[trace_id] = {
+            "roots": roots,
+            "hops": len(recs),
+            "events": ev_by_trace.get(trace_id, []),
+        }
+    return out
+
+
+def _phase_str(attrs: dict) -> str:
+    """One-line phase decomposition for a hop (display order = pipeline)."""
+    from .spans import PHASE_KEYS
+
+    parts = [
+        f"{k[:-3]}={attrs[k]}us" for k in PHASE_KEYS if k in attrs
+    ]
+    for k in ("send_us", "await_us"):  # client-hop phases
+        if k in attrs:
+            parts.append(f"{k[:-3]}={attrs[k]}us")
+    return " ".join(parts)
+
+
+def format_waterfall(trace_id: str, tree: dict) -> str:
+    """Render one assembled trace as an indented per-hop waterfall."""
+    lines = [f"trace {trace_id}  ({tree['hops']} hop(s))"]
+
+    def walk(hop: dict, depth: int) -> None:
+        r = hop["record"]
+        attrs = r.attrs
+        flags = []
+        if attrs.get("status"):
+            flags.append(f"status={attrs['status']}")
+        if attrs.get("redirects"):
+            flags.append(f"redirects={attrs['redirects']}")
+        if attrs.get("error"):
+            flags.append(f"error={attrs['error']}")
+        if attrs.get("tail"):
+            flags.append("tail")
+        lines.append(
+            "  " * (depth + 1)
+            + f"{r.name} {attrs.get('handler', '?')} @{r.node or 'client'}"
+            + f"  {r.duration_us / 1000.0:.2f} ms"
+            + (f"  [{' '.join(flags)}]" if flags else "")
+        )
+        ph = _phase_str(attrs)
+        if ph:
+            lines.append("  " * (depth + 2) + ph)
+        for child in hop["children"]:
+            walk(child, depth + 1)
+
+    for root in tree["roots"]:
+        walk(root, 0)
+    for ev in tree["events"]:
+        lines.append("  * " + format_event(ev))
+    return "\n".join(lines)
+
+
+def _span_dict(r: Any) -> dict:
+    return {
+        "seq": r.seq,
+        "trace_id": r.trace_id,
+        "span_id": r.span_id,
+        "parent_id": r.parent_id,
+        "name": r.name,
+        "node": r.node,
+        "wall_start": r.wall_start,
+        "duration_us": r.duration_us,
+        "attrs": r.attrs,
+    }
+
+
+# -- operator CLI: python -m rio_tpu.admin {tail|explain|stats|watch|trace} --
 
 
 def _watch_rows(snapshots: Sequence[SeriesSnapshot]) -> list[dict]:
@@ -394,6 +591,10 @@ def _watch_rows(snapshots: Sequence[SeriesSnapshot]) -> list[dict]:
             "dropped": snap.dropped,
             "solver_mode": str(snap.meta.get("solver_mode", "") or "-"),
             "alerts": list(snap.meta.get("alerts", ())),
+            # Exemplar trace id per firing alert ("rule:gauge" -> trace_id):
+            # the slow request that tripped the rule, ready for
+            # `admin trace <id>`. Absent on pre-waterfall nodes.
+            "alert_traces": dict(snap.meta.get("alert_traces", {})),
             "p99_ms": p99s[-1] if p99s else 0.0,
             "p99_trend": trend_arrow(p99s),
         }
@@ -423,7 +624,18 @@ def _format_watch(rows: Sequence[dict]) -> str:
             f"{r['inflight']:>7.0f} {r['inflight_trend']} "
             f"{r['sheds']:>5.0f} {r['sheds_trend']}  "
             f"{r['solver_mode']:<12} "
-            + (",".join(r["alerts"]) or "-")
+            + (
+                ",".join(
+                    a
+                    + (
+                        f"[{r['alert_traces'][a][:8]}]"
+                        if r.get("alert_traces", {}).get(a)
+                        else ""
+                    )
+                    for a in r["alerts"]
+                )
+                or "-"
+            )
         )
     return "\n".join(lines)
 
@@ -454,6 +666,12 @@ async def _cli_cluster(args: Any):
         from .registry import type_id
 
         tracing.set_sample_rate(1.0)  # demo journal rows carry trace ids
+        if getattr(args, "cmd", "") == "trace":
+            # Record the demo driver's own client hops so the waterfall
+            # starts at the caller (send/await + redirect follows).
+            from .spans import arm_client_ring
+
+            arm_client_ring()
         members, placement, tasks, servers = await boot_echo_cluster(
             2,
             # Aggressive sampling so a one-shot demo scrape has a window.
@@ -486,10 +704,25 @@ async def _cli_cluster(args: Any):
             await asyncio.sleep(0.5)  # several sampler ticks → a trend window
         if not getattr(args, "subject", None):
             args.subject = (tname, "w0")
+        if getattr(args, "cmd", "") == "trace" and not getattr(args, "trace_id", ""):
+            # No trace id given: pick a demo request that crossed nodes
+            # (a redirect follow) so the waterfall shows several hops.
+            from .spans import client_ring
+
+            recs = client_ring().spans()
+            pick = next(
+                (r for r in recs if r.attrs.get("redirects")),
+                recs[-1] if recs else None,
+            )
+            args.trace_id = pick.trace_id if pick else ""
 
         async def cleanup() -> None:
             client.close()
             tracing.set_sample_rate(0.0)
+            if getattr(args, "cmd", "") == "trace":
+                from .spans import disarm_client_ring
+
+                disarm_client_ring()
             for t in tasks:
                 t.cancel()
             import asyncio
@@ -585,6 +818,19 @@ async def _cli_main(argv: Sequence[str] | None = None) -> int:
         "--window", type=int, default=64, help="samples scraped per node"
     )
 
+    trace_p = _common(
+        sub.add_parser(
+            "trace", help="assemble one request's cross-node waterfall"
+        )
+    )
+    trace_p.add_argument(
+        "trace_id", nargs="?", default="", help="128-bit hex trace id "
+        "(empty = every retained trace; demo picks a redirect-follow)"
+    )
+    trace_p.add_argument(
+        "--limit", type=int, default=256, help="spans scraped per node"
+    )
+
     args = parser.parse_args(argv)
     args.subject = (
         (args.type_name, args.object_id)
@@ -670,6 +916,53 @@ async def _cli_main(argv: Sequence[str] | None = None) -> int:
             if args.json:
                 print(json.dumps(out))
             return 0 if reached else 1
+        if args.cmd == "trace":
+            from .spans import client_ring
+
+            snapshots = await scrape_spans(
+                client, nodes, trace_id=args.trace_id, limit=args.limit
+            )
+            records = [r for s in snapshots for r in s.spans()]
+            ring = client_ring()
+            if ring is not None:
+                # Merge THIS process's client hops: the waterfall roots at
+                # the caller when it armed retention before sending.
+                records.extend(ring.spans(trace_id=args.trace_id or None))
+            if args.trace_id:
+                records = [r for r in records if r.trace_id == args.trace_id]
+            ev_snaps = await scrape_events(client, nodes, limit=512)
+            events = [
+                e
+                for e in merge_events(s.events() for s in ev_snaps)
+                if e.trace_id
+                and (not args.trace_id or e.trace_id == args.trace_id)
+            ]
+            trees = assemble_waterfall(records, events)
+            if args.json:
+                doc: dict[str, Any] = {}
+                for tid, tree in trees.items():
+                    flat: list[dict] = []
+
+                    def _flatten(hop: dict, depth: int) -> None:
+                        d = _span_dict(hop["record"])
+                        d["depth"] = depth
+                        flat.append(d)
+                        for c in hop["children"]:
+                            _flatten(c, depth + 1)
+
+                    for root in tree["roots"]:
+                        _flatten(root, 0)
+                    doc[tid] = {
+                        "hops": tree["hops"],
+                        "spans": flat,
+                        "events": [_event_dict(e) for e in tree["events"]],
+                    }
+                print(json.dumps(doc))
+            else:
+                for tid, tree in trees.items():
+                    print(format_waterfall(tid, tree))
+                print(f"[trace] {len(trees)} trace(s), {len(records)} span(s)")
+            return 0 if (snapshots or records) else 1
         # watch: the trend table (one shot with --once/--json, else looped).
         while True:
             snapshots = await scrape_series(client, nodes, limit=args.window)

@@ -28,11 +28,13 @@ import asyncio
 import logging
 import os
 from collections import deque
+from time import perf_counter as _perf
 from typing import TYPE_CHECKING, Callable
 
 from .codec import FrameReader
 from .errors import Disconnect, SerializationError
 from .message_router import MessageRouter
+from .spans import Phases, finish_request
 from .protocol import (
     RequestEnvelope,
     ResponseEnvelope,
@@ -70,6 +72,11 @@ class _BadFrame:
         self.detail = detail
 
 
+def _stamp_handler_end(task) -> None:
+    """Done-callback for pipelined dispatch tasks carrying a phase clock."""
+    task._rio_ph[0].handler_end = _perf()
+
+
 class ServerConnProtocol(asyncio.Protocol):
     """One accepted connection: framing + ordered-concurrent dispatch."""
 
@@ -96,6 +103,8 @@ class ServerConnProtocol(asyncio.Protocol):
         "_lost",
         "_out",
         "_flush_scheduled",
+        "_spans",
+        "_ph_tick",
     )
 
     def __init__(
@@ -106,6 +115,8 @@ class ServerConnProtocol(asyncio.Protocol):
         self._service_factory = service_factory
         self._on_task = on_task
         self._service: Service | None = None
+        self._spans = None  # SpanRing (resolved from the service at accept)
+        self._ph_tick = -1  # 1-in-8 phase-clock stride for untraced traffic
         self._frames = FrameReader()
         # Inbound work: decoded envelopes / _BadFrame markers (batch-decode
         # path) or raw frame payloads (RIO_TPU_BATCH_DECODE=0 fallback).
@@ -130,9 +141,31 @@ class ServerConnProtocol(asyncio.Protocol):
     def connection_made(self, transport: asyncio.BaseTransport) -> None:
         self._transport = transport  # type: ignore[assignment]
         self._service = self._service_factory()
+        self._spans = getattr(self._service, "spans", None)
         self._worker = asyncio.ensure_future(self._run())
         if self._on_task is not None:
             self._on_task(self._worker)
+
+    def _stamp_inbound(self, env, t_recv: float) -> None:
+        """Attach the per-request phase clock (span retention armed only).
+
+        Traced requests always carry one; untraced traffic is sampled on
+        the same 1-in-8 stride the RED histograms use, so the ring's
+        tail-based capture sees outliers without the hot path paying a
+        clock read per request.
+        """
+        if type(env) is not RequestEnvelope:
+            return
+        tc = env.trace_ctx
+        if tc is None:
+            self._ph_tick = tick = (self._ph_tick + 1) & 7
+            if tick:
+                return
+            ph = Phases(t_recv)
+        else:
+            ph = Phases(t_recv, tc)
+        ph.decode = _perf()
+        env._phases = ph
 
     def data_received(self, data: bytes) -> None:
         try:
@@ -149,11 +182,24 @@ class ServerConnProtocol(asyncio.Protocol):
                 # schemas stay hot and the worker loop receives ready
                 # envelopes. Decode failures become in-order error markers.
                 append = self._queue.append
-                for p in payloads:
-                    try:
-                        append(decode_inbound(p))
-                    except Exception as e:  # noqa: BLE001 — malformed frame
-                        append(_BadFrame(str(e)))
+                if self._spans is None:
+                    for p in payloads:
+                        try:
+                            append(decode_inbound(p))
+                        except Exception as e:  # noqa: BLE001 — malformed frame
+                            append(_BadFrame(str(e)))
+                else:
+                    # Span retention armed: one recv stamp per socket read
+                    # (shared by the burst), decode stamped per envelope.
+                    t_recv = _perf()
+                    for p in payloads:
+                        try:
+                            env = decode_inbound(p)
+                        except Exception as e:  # noqa: BLE001 — malformed frame
+                            append(_BadFrame(str(e)))
+                            continue
+                        self._stamp_inbound(env, t_recv)
+                        append(env)
             else:
                 self._queue.extend(payloads)
             self._wake()
@@ -220,18 +266,33 @@ class ServerConnProtocol(asyncio.Protocol):
         collapses dozens of per-response ``send``s into one.
         """
         q = self._resp_q
+        spans = self._spans
         while q and q[0].done() and not self._broken:
             fut = q.popleft()
             if fut.cancelled() or self._lost:
                 continue  # shutdown path / dead socket; nothing to write
             try:
-                self._write_soon(encode_response_frame(fut.result()))
+                resp = fut.result()
+                frame = encode_response_frame(resp)
             except Exception:
                 # An unencodable/failed response would desync every later
                 # FIFO match on this connection; drop the connection.
                 log.exception("response encode error; dropping connection")
                 self._break()
                 break
+            if spans is not None:
+                ctx = getattr(fut, "_rio_ph", None)
+                if ctx is not None:
+                    ph, env = ctx
+                    ph.encode = _perf()
+                    err = resp.error
+                    if err is not None:
+                        ph.attrs = {"status": int(err.kind)}
+                    self._write_soon(frame)
+                    ph.flush = _perf()
+                    finish_request(spans, ph, env)
+                    continue
+            self._write_soon(frame)
         self._wake_room()
         self._maybe_resume_reading()
 
@@ -324,11 +385,17 @@ class ServerConnProtocol(asyncio.Protocol):
                     return
                 if type(inbound) is bytes:
                     # Fallback path (batch decode off): the queue holds raw
-                    # frame payloads; decode them here as before.
+                    # frame payloads; decode them here as before. The phase
+                    # clock starts at decode (recv_us collapses to ~0 — the
+                    # batch path is the measured default).
+                    t_recv = _perf() if self._spans is not None else 0.0
                     try:
                         inbound = decode_inbound(inbound)
                     except Exception as e:  # malformed frame → error response
                         inbound = _BadFrame(str(e))
+                    else:
+                        if self._spans is not None:
+                            self._stamp_inbound(inbound, t_recv)
                 if type(inbound) is _BadFrame:
                     fut: asyncio.Future = loop.create_future()
                     fut.set_result(
@@ -339,6 +406,11 @@ class ServerConnProtocol(asyncio.Protocol):
                     self._push_response(fut)
                     continue
                 if type(inbound) is RequestEnvelope:
+                    ph = (
+                        inbound.__dict__.get("_phases")
+                        if self._spans is not None
+                        else None
+                    )
                     if not self._resp_q and not self._queue:
                         # Sole in-flight request on this connection: dispatch
                         # inline (no task) — the common non-pipelined case,
@@ -348,22 +420,45 @@ class ServerConnProtocol(asyncio.Protocol):
                         # head-of-line serialization is bounded to this one
                         # request (and FIFO response order delays delivery
                         # behind a slow head regardless of execution model).
+                        if ph is not None:
+                            ph.queue = ph.handler_start = _perf()
                         resp = await service.call(inbound)
+                        if ph is not None:
+                            ph.handler_end = _perf()
                         if not self._broken:
                             try:
-                                self._write_soon(encode_response_frame(resp))
+                                frame = encode_response_frame(resp)
                             except Exception:
                                 log.exception(
                                     "response encode error; dropping connection"
                                 )
                                 return
+                            if ph is None:
+                                self._write_soon(frame)
+                            else:
+                                ph.encode = _perf()
+                                err = resp.error
+                                if err is not None:
+                                    ph.attrs = {"status": int(err.kind)}
+                                self._write_soon(frame)
+                                ph.flush = _perf()
+                                finish_request(self._spans, ph, inbound)
                         if self._paused:
                             await self._flushed()
                         continue
                     while len(self._resp_q) >= self.MAX_CONCURRENT and not self._eof:
                         self._room = loop.create_future()
                         await self._room
-                    self._push_response(loop.create_task(service.call(inbound)))
+                    task = loop.create_task(service.call(inbound))
+                    if ph is not None:
+                        # Pipelined path: handler runs in its own task;
+                        # queue-exit/handler-start stamp here, handler-end in
+                        # the task's done-callback, encode/flush when the
+                        # FIFO head drains it (_flush_ready).
+                        ph.queue = ph.handler_start = _perf()
+                        task._rio_ph = (ph, inbound)
+                        task.add_done_callback(_stamp_handler_end)
+                    self._push_response(task)
                 else:
                     # Flush every pending response before switching the
                     # connection into subscription streaming mode.

@@ -13,7 +13,9 @@ import asyncio
 import contextlib
 import logging
 import random
+import time
 from dataclasses import dataclass
+from time import perf_counter as _perf
 from typing import Any, AsyncIterator, Awaitable, Callable
 
 from .. import codec
@@ -36,6 +38,7 @@ from ..protocol import (
     encode_subscribe_frame,
 )
 from ..registry import MESSAGE_TYPES, decode_error, is_readonly_message, type_id
+from ..spans import client_ring
 from ..tracing import (
     head_sampled,
     new_span_id,
@@ -205,6 +208,7 @@ class Client:
             raise ValueError(f"unknown transport {transport!r}")
         self.members_storage = members_storage
         self.stats = ClientStats()
+        self._ph_tick = -1  # 1-in-8 client-hop stride for untraced traffic
         # Fault-injection handle + source identity for (src, dst) link
         # rules (rio_tpu.faults.TransportFaults); None in production.
         self._transport_faults = transport_faults
@@ -430,6 +434,76 @@ class Client:
         payload: bytes,
         trace_ctx: tuple[str, str, bool] | None,
     ) -> bytes:
+        ring = client_ring()
+        if ring is None:
+            # Retention disarmed (the default): one module-global read, then
+            # the pre-waterfall request path unchanged.
+            return await self._send_attempts(
+                handler_type, handler_id, message_type, payload, trace_ctx
+            )
+        if trace_ctx is None:
+            # Untraced: sample the phase clock on the 1-in-8 stride so the
+            # ring's tail capture can still see slow outliers.
+            self._ph_tick = tick = (self._ph_tick + 1) & 7
+            if tick:
+                return await self._send_attempts(
+                    handler_type, handler_id, message_type, payload, trace_ctx
+                )
+        hop = {"await_us": 0}
+        t0 = _perf()
+        rt0, rd0 = self.stats.roundtrips, self.stats.redirects
+        status = ""
+        try:
+            return await self._send_attempts(
+                handler_type, handler_id, message_type, payload, trace_ctx, hop
+            )
+        except BaseException as e:
+            status = type(e).__name__
+            raise
+        finally:
+            total_us = int((_perf() - t0) * 1e6)
+            traced = trace_ctx is not None
+            if traced or (ring.slo_ms > 0.0 and total_us >= ring.slo_ms * 1000.0):
+                if traced:
+                    trace_id, span_id = trace_ctx[0], trace_ctx[1]
+                else:
+                    trace_id, span_id = new_trace_id(), new_span_id()
+                    ring.tail_captured += 1
+                attrs: dict[str, Any] = {
+                    "handler": f"{handler_type}/{handler_id}",
+                    "msg": message_type,
+                    # send/route time (pick + acquire + encode + backoff)
+                    # vs time spent awaiting server roundtrips.
+                    "send_us": max(0, total_us - hop["await_us"]),
+                    "await_us": hop["await_us"],
+                    "roundtrips": self.stats.roundtrips - rt0,
+                    "redirects": self.stats.redirects - rd0,
+                }
+                if status:
+                    attrs["error"] = status
+                if not traced:
+                    attrs["tail"] = 1
+                # The client hop's span id IS the wire parent id, so the
+                # server hops it fans out to nest under it in the waterfall.
+                ring.record(
+                    trace_id=trace_id,
+                    span_id=span_id,
+                    parent_id="",
+                    name="client_request",
+                    wall_start=time.time() - total_us / 1e6,
+                    duration_us=total_us,
+                    attrs=attrs,
+                )
+
+    async def _send_attempts(
+        self,
+        handler_type: str,
+        handler_id: str,
+        message_type: str,
+        payload: bytes,
+        trace_ctx: tuple[str, str, bool] | None,
+        hop: dict | None = None,
+    ) -> bytes:
         env = RequestEnvelope(
             handler_type, handler_id, message_type, payload, trace_ctx
         )
@@ -467,6 +541,8 @@ class Client:
                 pool = self._pool(address)
                 conn = await pool.acquire()
                 seen = conn.delivered
+                if hop is not None:
+                    t_send = _perf()
                 try:
                     raw = await conn.roundtrip(frame_bytes)
                 except asyncio.CancelledError:
@@ -484,6 +560,8 @@ class Client:
                     pool.release(conn, reuse=False)
                     raise
                 pool.release(conn, reuse=True)
+                if hop is not None:
+                    hop["await_us"] += int((_perf() - t_send) * 1e6)
                 self.stats.roundtrips += 1
             except (ServerNotAvailable, Disconnect, OSError) as e:
                 last = e

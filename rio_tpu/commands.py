@@ -65,6 +65,10 @@ class AdminCommandKind(Enum):
     # rio.Admin DumpSeries) this node's periodic gauge samples. Old servers
     # answer the wire form with the clean unknown-kind AdminAck.
     DUMP_SERIES = "dump_series"
+    # Request-waterfall span ring: log (in-process) or return (wire, via
+    # rio.Admin DumpSpans) this node's retained request spans. Old servers
+    # answer the wire form with the clean unknown-kind AdminAck.
+    DUMP_SPANS = "dump_spans"
 
 
 @dataclasses.dataclass
@@ -110,6 +114,12 @@ class AdminCommand:
         """Log this node's gauge time-series window (the in-process twin
         of the wire ``DumpSeries`` scrape served by ``rio.Admin``)."""
         return cls(AdminCommandKind.DUMP_SERIES)
+
+    @classmethod
+    def dump_spans(cls) -> "AdminCommand":
+        """Log this node's retained request spans (the in-process twin
+        of the wire ``DumpSpans`` scrape served by ``rio.Admin``)."""
+        return cls(AdminCommandKind.DUMP_SPANS)
 
     @classmethod
     def migrate(cls, type_name: str, object_id: str, target: str) -> "AdminCommand":

@@ -291,7 +291,21 @@ class HealthWatch:
         return out
 
     def meta(self) -> dict[str, Any]:
-        """``SeriesSnapshot.meta`` contribution: the active alarm labels."""
-        return {
+        """``SeriesSnapshot.meta`` contribution: the active alarm labels.
+
+        ``alert_traces`` (append-only key, present only when some alert
+        carries one) maps each label to its exemplar trace id — the
+        ``watch`` CLI prints it beside the alert so an operator can go
+        straight to ``admin trace <id>``.
+        """
+        out: dict[str, Any] = {
             "alerts": [f"{a.rule}:{a.gauge}" for a in self.active],
         }
+        traces = {
+            f"{a.rule}:{a.gauge}": a.trace_id
+            for a in self.active
+            if a.trace_id
+        }
+        if traces:
+            out["alert_traces"] = traces
+        return out

@@ -36,7 +36,10 @@ import asyncio
 import dataclasses
 import logging
 import math
+import sys
+import threading
 import time
+import traceback
 from typing import Any, Callable
 
 log = logging.getLogger("rio_tpu.load")
@@ -269,12 +272,62 @@ class LoadMonitorStats:
 
     samples: int = 0
     sheds: int = 0  # requests refused with ServerBusy
+    stalls: int = 0  # loop stalls caught with a stack by the watchdog
     loop_lag_ms: float = 0.0
     inflight: int = 0
     registry_objects: int = 0
     req_rate: float = 0.0
     state_bytes: float = 0.0
     view_members: int = 0  # entries in the last derived ClusterLoadView
+
+
+class _StallWatchdog(threading.Thread):
+    """Off-loop daemon thread that catches the event loop mid-stall.
+
+    Loop-lag EMAs say a stall HAPPENED; they cannot say what the loop was
+    doing. This thread watches the heartbeat timestamp :meth:`LoadMonitor.
+    run` refreshes each tick; when the beat goes quiet past the threshold
+    the loop thread is still stuck inside whatever blocked it — so
+    ``sys._current_frames()`` names the culprit. The captured stack is
+    parked on the monitor (this thread NEVER touches the journal — rings
+    are loop-thread-only) and journaled as a HEALTH event on the loop's
+    next tick, cooldown-limited so a grinding server logs one stack per
+    window, not one per poll.
+    """
+
+    def __init__(
+        self, monitor: "LoadMonitor", loop_thread_ident: int, interval: float
+    ) -> None:
+        super().__init__(name="rio-tpu-stall-watchdog", daemon=True)
+        self.monitor = monitor
+        self.loop_ident = loop_thread_ident
+        self.interval = interval
+        self.stop_event = threading.Event()
+
+    def run(self) -> None:
+        m = self.monitor
+        threshold_s = m.stall_threshold_ms / 1e3
+        last_fire = float("-inf")
+        while not self.stop_event.wait(max(0.05, threshold_s / 2)):
+            beat = m._heartbeat
+            if beat is None:
+                continue
+            # The loop owes us a beat every `interval`; anything past that
+            # plus the threshold is a stall in progress RIGHT NOW.
+            now = time.monotonic()
+            stall_s = now - beat - self.interval
+            if stall_s < threshold_s:
+                continue
+            if now - last_fire < m.stall_cooldown or m._pending_stall is not None:
+                continue
+            frame = sys._current_frames().get(self.loop_ident)
+            if frame is None:
+                continue
+            last_fire = now
+            m._pending_stall = {
+                "stall_ms": round(stall_s * 1e3, 1),
+                "stack": "".join(traceback.format_stack(frame, limit=24)),
+            }
 
 
 class LoadMonitor:
@@ -303,6 +356,9 @@ class LoadMonitor:
         view_interval: float = 2.0,
         max_staleness: float = DEFAULT_MAX_STALENESS,
         lag_ema: float = 0.3,
+        journal=None,
+        stall_threshold_ms: float = 500.0,
+        stall_cooldown: float = 30.0,
     ) -> None:
         self.registry = registry
         self.affinity_tracker = affinity_tracker
@@ -328,6 +384,14 @@ class LoadMonitor:
         # sampler and HealthWatch, wired by Server.run); each is isolated
         # like the hotness tick — a failing ticker must not stop sampling.
         self.tickers: list = []
+        # Loop-stall watchdog (``_StallWatchdog``): 0 disables. The
+        # heartbeat/pending handshake is two attribute stores — the
+        # watchdog thread only ever reads/writes these, never the journal.
+        self.journal = journal
+        self.stall_threshold_ms = float(stall_threshold_ms)
+        self.stall_cooldown = float(stall_cooldown)
+        self._heartbeat: float | None = None
+        self._pending_stall: dict | None = None
 
     # -- request-path hooks (sync, called per dispatch) ---------------------
 
@@ -420,10 +484,44 @@ class LoadMonitor:
         if placement is not None and hasattr(placement, "sync_load"):
             placement.sync_load(view)
 
+    def _drain_pending_stall(self) -> None:
+        """Journal a watchdog capture from the loop thread (ring discipline:
+        only the loop appends; the watchdog merely parks the evidence)."""
+        pending = self._pending_stall
+        if pending is None:
+            return
+        self._pending_stall = None
+        self.stats.stalls += 1
+        log.warning(
+            "event-loop stall %.0f ms; loop thread was at:\n%s",
+            pending["stall_ms"], pending["stack"],
+        )
+        if self.journal is not None:
+            from ..journal import HEALTH
+
+            self.journal.record(
+                HEALTH,
+                "loop_stall",
+                stall_ms=pending["stall_ms"],
+                stack=pending["stack"],
+            )
+
     async def run(self) -> None:
         """Sampling loop; runs until cancelled (a ``Server.run`` child)."""
         loop = asyncio.get_running_loop()
         last_view = float("-inf")
+        watchdog = None
+        if self.stall_threshold_ms > 0:
+            self._heartbeat = time.monotonic()
+            watchdog = _StallWatchdog(self, threading.get_ident(), self.interval)
+            watchdog.start()
+        try:
+            await self._run(loop, last_view)
+        finally:
+            if watchdog is not None:
+                watchdog.stop_event.set()
+
+    async def _run(self, loop, last_view: float) -> None:
         while True:
             t0 = loop.time()
             await asyncio.sleep(self.interval)
@@ -431,6 +529,8 @@ class LoadMonitor:
             # loop starved by slow callbacks wakes us late by that much.
             lag_ms = max(0.0, (loop.time() - t0 - self.interval)) * 1e3
             self._sample(lag_ms)
+            self._heartbeat = time.monotonic()
+            self._drain_pending_stall()
             detector = self.hotness_detector
             if detector is not None:
                 try:

@@ -19,9 +19,11 @@ import asyncio
 import ctypes
 import logging
 from collections import deque
+from time import perf_counter as _perf
 from typing import TYPE_CHECKING, Callable
 
 from ..message_router import MessageRouter
+from ..spans import Phases, finish_request
 from ..protocol import (
     RequestEnvelope,
     ResponseEnvelope,
@@ -297,13 +299,26 @@ class ClientEngine:
 
 
 class _ConnState:
-    __slots__ = ("queue", "waiter", "eof", "worker", "streaming", "resp_q", "room", "broken")
+    __slots__ = (
+        "queue",
+        "waiter",
+        "eof",
+        "worker",
+        "streaming",
+        "resp_q",
+        "room",
+        "broken",
+        "ph_tick",
+    )
 
     def __init__(self) -> None:
         # The worker drains ``queue`` and, at EOF, finishes in-flight
         # requests (FIFO) before exiting — matching the asyncio path where
         # a peer disconnect never cancels a running handler mid-mutation.
-        self.queue: deque[bytes] = deque()
+        # When span retention is armed the queue holds (payload, recv_ts)
+        # tuples instead of raw payloads — the engine decodes frames later
+        # in the worker, so receive time must ride along.
+        self.queue: deque = deque()
         self.waiter: asyncio.Future | None = None
         self.eof = False
         self.worker: asyncio.Task | None = None
@@ -311,6 +326,7 @@ class _ConnState:
         self.resp_q: deque[asyncio.Future] = deque()  # FIFO response slots
         self.room: asyncio.Future | None = None
         self.broken = False
+        self.ph_tick = -1  # 1-in-8 phase-clock stride for untraced traffic
 
     def wake(self) -> None:
         w = self.waiter
@@ -323,6 +339,11 @@ class _ConnState:
         if r is not None and not r.done():
             self.room = None
             r.set_result(None)
+
+
+def _stamp_handler_end(task) -> None:
+    """Done-callback for pipelined dispatch tasks carrying a phase clock."""
+    task._rio_ph[0].handler_end = _perf()
 
 
 class NativeServerTransport:
@@ -358,6 +379,11 @@ class NativeServerTransport:
                 host = socket.gethostbyname(host)
         self._engine = Engine(lib, host, port, reuse_port=reuse_port)
         self.port = self._engine.port
+        # SpanRing (node-wide; resolved from the first connection's service
+        # — the factory builds services lazily, and the event dispatcher
+        # needs the handle before any worker has run).
+        self._spans = None
+        self._spans_resolved = False
         self._conns: dict[int, _ConnState] = {}
         self._workers: set[asyncio.Task] = set()
         self._loop: asyncio.AbstractEventLoop | None = None
@@ -418,7 +444,12 @@ class NativeServerTransport:
                             state.wake()
                         self._engine.close_conn(conn)
                     else:
-                        state.queue.append(data)
+                        if self._spans is not None:
+                            # Frame-receive stamp; decode happens in the
+                            # worker (unlike the asyncio transport).
+                            state.queue.append((data, _perf()))
+                        else:
+                            state.queue.append(data)
                         state.wake()
             elif ev_type == EV_CLOSED:
                 state = self._conns.pop(conn, None)
@@ -450,12 +481,27 @@ class NativeServerTransport:
         out-of-order completions cost nothing until their turn.
         """
         q = state.resp_q
+        spans = self._spans
         while q and q[0].done() and not state.broken:
             fut = q.popleft()
             if fut.cancelled():
                 continue  # shutdown path; nothing to write
             try:
-                self._engine.send(conn, encode_response_frame(fut.result()))
+                resp = fut.result()
+                frame = encode_response_frame(resp)
+                if spans is not None:
+                    ctx = getattr(fut, "_rio_ph", None)
+                    if ctx is not None:
+                        ph, env = ctx
+                        ph.encode = _perf()
+                        err = resp.error
+                        if err is not None:
+                            ph.attrs = {"status": int(err.kind)}
+                        self._engine.send(conn, frame)
+                        ph.flush = _perf()
+                        finish_request(spans, ph, env)
+                        continue
+                self._engine.send(conn, frame)
             except Exception:
                 log.exception("response write error; dropping conn %d", conn)
                 state.broken = True
@@ -465,6 +511,27 @@ class NativeServerTransport:
                 self._engine.close_conn(conn)
                 break
         state.wake_room()
+
+    def _stamp_inbound(
+        self, state: _ConnState, env: RequestEnvelope, t_recv: float
+    ) -> "Phases | None":
+        """Attach the per-request phase clock (span retention armed only).
+
+        Traced requests always carry one; untraced traffic samples on the
+        same 1-in-8 stride the RED histograms use (per connection), so the
+        ring's tail capture sees outliers without a per-request clock read.
+        """
+        tc = env.trace_ctx
+        if tc is None:
+            state.ph_tick = tick = (state.ph_tick + 1) & 7
+            if tick:
+                return None
+            ph = Phases(t_recv)
+        else:
+            ph = Phases(t_recv, tc)
+        ph.decode = _perf()
+        env._phases = ph
+        return ph
 
     async def _next_payload(self, state: _ConnState) -> bytes | None:
         while not state.queue:
@@ -482,6 +549,9 @@ class NativeServerTransport:
         (service.rs:370-459 wire shape under pipelining).
         """
         service = self._service_factory()
+        if not self._spans_resolved:
+            self._spans_resolved = True
+            self._spans = getattr(service, "spans", None)
         loop = asyncio.get_running_loop()
         cancelled = False
         try:
@@ -495,6 +565,10 @@ class NativeServerTransport:
                         state.room = loop.create_future()
                         await state.room
                     return
+                if type(payload) is tuple:
+                    payload, t_recv = payload
+                else:
+                    t_recv = 0.0
                 try:
                     inbound = decode_inbound(payload)
                 except Exception as e:  # malformed frame → error response
@@ -504,20 +578,43 @@ class NativeServerTransport:
                     )
                     self._push_response(conn, state, fut)
                     continue
+                ph = None
+                if t_recv and type(inbound) is RequestEnvelope:
+                    ph = self._stamp_inbound(state, inbound, t_recv)
                 if type(inbound) is RequestEnvelope:
                     if not state.resp_q and not state.queue:
                         # Sole in-flight request on this connection:
                         # dispatch inline (no task), the common case.
+                        if ph is not None:
+                            ph.queue = ph.handler_start = _perf()
                         resp = await service.call(inbound)
+                        if ph is not None:
+                            ph.handler_end = _perf()
                         if not state.broken:
-                            self._engine.send(conn, encode_response_frame(resp))
+                            frame = encode_response_frame(resp)
+                            if ph is None:
+                                self._engine.send(conn, frame)
+                            else:
+                                ph.encode = _perf()
+                                err = resp.error
+                                if err is not None:
+                                    ph.attrs = {"status": int(err.kind)}
+                                self._engine.send(conn, frame)
+                                ph.flush = _perf()
+                                finish_request(self._spans, ph, inbound)
                         continue
                     while len(state.resp_q) >= _MAX_CONCURRENT and not state.eof:
                         state.room = loop.create_future()
                         await state.room
-                    self._push_response(
-                        conn, state, loop.create_task(service.call(inbound))
-                    )
+                    task = loop.create_task(service.call(inbound))
+                    if ph is not None:
+                        # Pipelined path: handler-end stamps in the task's
+                        # done-callback; encode/flush when the FIFO head
+                        # drains it (_flush_ready).
+                        ph.queue = ph.handler_start = _perf()
+                        task._rio_ph = (ph, inbound)
+                        task.add_done_callback(_stamp_handler_end)
+                    self._push_response(conn, state, task)
                 else:
                     if conn not in self._conns:
                         # Peer already disconnected (CLOSED was drained while

@@ -99,6 +99,10 @@ def server_gauges(server: Any) -> dict[str, float]:
     if journal is not None:
         # Control-plane flight recorder counters (rio.journal.*).
         gauges.update(journal.gauges())
+    spans = getattr(server, "spans", None)
+    if spans is not None:
+        # Request-waterfall span ring counters (rio.spans.*).
+        gauges.update(spans.gauges())
     solve_stats = getattr(placement, "stats", None)
     history_gauges = getattr(solve_stats, "history_gauges", None)
     if history_gauges is not None:

@@ -113,6 +113,9 @@ class Server:
         timeseries_interval: float = 1.0,
         health_watch: bool = True,
         health_rules=None,
+        spans: bool = True,
+        spans_capacity: int = 2048,
+        spans_slo_ms: float = 250.0,
     ) -> None:
         if transport not in ("asyncio", "native", "auto"):
             raise ValueError(f"unknown transport {transport!r}")
@@ -237,6 +240,8 @@ class Server:
                 placement=self.object_placement,
                 thresholds=load_thresholds,
                 interval=load_interval,
+                # Stall-watchdog captures become HEALTH journal events.
+                journal=self.journal,
             )
             self.app_data.set(self.load_monitor)
             # Heartbeat pushes carry this node's encoded vector from now on.
@@ -249,6 +254,18 @@ class Server:
         # bounded gauge-dict copy per ``timeseries_interval``. The node id
         # is stamped at bind(); the alarm set defaults to
         # ``health.default_rules()`` (``health_rules`` overrides).
+        # Request-waterfall span ring (rio_tpu/spans): on by default — the
+        # transports feed it only for traced requests plus a 1-in-8 stride
+        # of untraced ones (tail capture over ``spans_slo_ms``), so the
+        # null fast path stays untouched. ``spans=False`` removes even the
+        # per-request stride check (the transports see no ring). The node
+        # id is stamped at bind(); scraped via rio.Admin DumpSpans.
+        self.spans = None
+        if spans:
+            from .spans import SpanRing
+
+            self.spans = SpanRing(capacity=spans_capacity, slo_ms=spans_slo_ms)
+            self.app_data.set(self.spans)
         self.timeseries = None
         self.health_watch = None
         if timeseries and self.load_monitor is not None:
@@ -365,6 +382,9 @@ class Server:
             self.journal.node = self._local_addr
         if self.timeseries is not None:
             self.timeseries.node = self._local_addr
+        if self.spans is not None:
+            # Retained spans merged across nodes need the recorder's name.
+            self.spans.node = self._local_addr
         if self.migration_manager is None:
             # Wire the migration control plane: the coordinator in AppData
             # (service layer refusals + lifecycle restore find it there) and
@@ -562,6 +582,25 @@ class Server:
                         "\n".join(
                             f"#{s.seq} @{s.wall_ts:.3f} {len(s.gauges)} gauges"
                             for s in window
+                        ),
+                    )
+            if cmd.kind == AdminCommandKind.DUMP_SPANS:
+                # In-process twin of the rio.Admin DumpSpans wire scrape:
+                # dump the newest retained request spans to the log.
+                if self.spans is None:
+                    log.info("%s: AdminCommand::DumpSpans (spans off)",
+                             self._local_addr)
+                else:
+                    tail = self.spans.spans(limit=16)
+                    log.info(
+                        "%s: AdminCommand::DumpSpans (%d retained, %d dropped, "
+                        "%d tail-captured)\n%s",
+                        self._local_addr, self.spans.retained,
+                        self.spans.dropped, self.spans.tail_captured,
+                        "\n".join(
+                            f"#{r.seq} {r.trace_id[:8]} {r.name} "
+                            f"{r.attrs.get('handler', '?')} {r.duration_us}us"
+                            for r in tail
                         ),
                     )
             if cmd.kind == AdminCommandKind.MIGRATE_OBJECT:

@@ -98,6 +98,13 @@ class Service:
         # Recorded on TRANSITIONS only — assign/release/shed — never on the
         # per-request fast path.
         self._journal = app_data.try_get(Journal)
+        from .spans import SpanRing
+
+        # Request-waterfall span ring (None when span retention is off).
+        # Resolved here once so both transports share the same handle per
+        # connection; the transports own all phase stamping — the service
+        # request path is untouched (null fast path byte-identical).
+        self.spans = app_data.try_get(SpanRing)
         # Shard map of a multi-process sharded node (None on plain servers):
         # consulted only when seating an UNPLACED object — see the seam in
         # get_or_create_placement.

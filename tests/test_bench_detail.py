@@ -402,3 +402,42 @@ def test_committed_tpu_capture_carries_relay_health():
     assert "collapsed_tier.pull_ms" in health["sync_contaminated"]
     block = _tpu_banked_block()
     assert block is not None and block["relay"] == "degrading"
+
+
+def test_spans_overhead_banks_to_cpu_sidecar_and_never_carries(tmp_path):
+    """The span-retention A/B is a host stage: banked beside its own
+    session's host provenance, never carried into a later tpu bank."""
+    stage = {
+        "msgs_per_sec": {"off": 17976.9, "on": 17785.0},
+        "spans_overhead_pct": 1.36,
+        "retained_on": 792,
+        "tail_captured_on": 792,
+        "slo_ms": 1.0,
+        "host": {"cpu_count": 1, "sched_affinity": [0],
+                 "loadavg": [0.5, 0.4, 0.3]},
+    }
+    _write_detail(
+        {"solve_tier": {"platform": "cpu"}, "spans": stage},
+        here=str(tmp_path),
+    )
+    banked = _read(tmp_path, "BENCH_DETAIL.cpu.json")
+    assert banked["spans"] == stage
+    # A later tpu run must not inherit it.
+    _write_detail({"solve_tier": {"platform": "tpu"}}, here=str(tmp_path))
+    tpu = _read(tmp_path, "BENCH_DETAIL.tpu.json")
+    assert "spans" not in tpu and "spans_carried" not in tpu
+
+
+def test_committed_cpu_capture_banks_spans_with_provenance():
+    """The repo's banked cpu sidecar carries the measured waterfall A/B —
+    the ISSUE's ≤2% bar is evidence on disk, priced with tail capture
+    ARMED (tail_captured_on > 0: retention writes actually happened),
+    stamped with host conditions."""
+    committed = Path(__file__).resolve().parent.parent / "BENCH_DETAIL.cpu.json"
+    spans = json.loads(committed.read_text())["spans"]
+    assert spans["spans_overhead_pct"] <= 2.0
+    assert spans["tail_captured_on"] > 0
+    assert spans["retained_on"] >= spans["tail_captured_on"]
+    assert spans["slo_ms"] <= 250.0  # priced at/below the shipping default
+    assert set(spans["host"]) == {"cpu_count", "sched_affinity", "loadavg"}
+    assert set(spans["msgs_per_sec"]) == {"off", "on"}

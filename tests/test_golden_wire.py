@@ -370,3 +370,120 @@ def test_dump_series_frame_golden():
     # Tolerant decode: a short legacy row (no gauges) still parses.
     legacy = SeriesSample.from_row([1, FROZEN_TIME, 40.0])
     assert legacy.seq == 1 and legacy.node == "" and legacy.gauges == {}
+
+
+def test_dump_spans_frame_golden():
+    """Pin the rio.Admin span-scrape frames byte for byte.
+
+    DUMP_SPANS is the third operator-facing admin scrape (the ``trace``
+    CLI assembles cross-node waterfalls over it, against arbitrary-version
+    nodes); the request envelope and the SpansSnapshot response — including
+    the positional SpanRecord row shape — are a compatibility contract:
+    rows may only ever GROW by appending trailing fields
+    (SpanRecord.from_row tolerates short rows; see MIGRATING.md).
+    """
+    from rio_tpu import codec
+    from rio_tpu.admin import ADMIN_TYPE, DumpSpans, SpansSnapshot
+    from rio_tpu.protocol import (
+        RequestEnvelope,
+        ResponseEnvelope,
+        encode_request_frame,
+        encode_response_frame,
+    )
+    from rio_tpu.spans import SpanRecord
+
+    request = encode_request_frame(
+        RequestEnvelope(
+            handler_type=ADMIN_TYPE,
+            handler_id="10.0.0.1:5000",
+            message_type="rio.DumpSpans",
+            payload=codec.serialize(
+                DumpSpans(trace_id="ab" * 16, since_seq=7, limit=64)
+            ),
+        )
+    )
+    snapshot = SpansSnapshot(
+        address="10.0.0.1:5000",
+        node_seq=9,
+        dropped=1,
+        rows=[
+            SpanRecord(
+                seq=8,
+                trace_id="ab" * 16,
+                span_id="cd" * 8,
+                parent_id="ef" * 8,
+                name="request",
+                node="10.0.0.1:5000",
+                wall_start=FROZEN_TIME,
+                duration_us=1250,
+                attrs={
+                    "handler": "Svc/g1",
+                    "msg": "Get",
+                    "recv_us": 0,
+                    "decode_us": 40,
+                    "queue_us": 15,
+                    "handler_us": 1100,
+                    "encode_us": 30,
+                    "flush_us": 65,
+                },
+            ).to_row(),
+            SpanRecord(
+                seq=9,
+                trace_id="ab" * 16,
+                span_id="0a" * 8,
+                parent_id="cd" * 8,
+                name="request",
+                node="10.0.0.2:5000",
+                wall_start=FROZEN_TIME + 0.5,
+                duration_us=310,
+                attrs={"handler": "Svc/g1", "msg": "Get", "status": 1},
+            ).to_row(),
+        ],
+    )
+    response = encode_response_frame(
+        ResponseEnvelope(body=codec.serialize(snapshot))
+    )
+
+    def hexdump(label: str, frame: bytes) -> list[str]:
+        lines = [f"== {label} ({len(frame)} bytes)"]
+        for off in range(0, len(frame), 16):
+            chunk = frame[off : off + 16]
+            lines.append(f"{off:04x}  {chunk.hex(' ')}")
+        return lines
+
+    text = "\n".join(hexdump("dump_spans.request", request)
+                     + hexdump("dump_spans.response", response)) + "\n"
+    _assert_golden("dump_spans_frames.txt", text)
+
+    back = codec.deserialize(codec.serialize(snapshot), SpansSnapshot)
+    assert [r.seq for r in back.spans()] == [8, 9]
+    assert back.spans()[0].attrs["handler_us"] == 1100
+    assert back.spans()[1].parent_id == "cd" * 8  # hop nesting survives
+    # Tolerant decode: a short legacy row still parses with defaults.
+    legacy = SpanRecord.from_row([1, "t", "s"])
+    assert legacy.seq == 1 and legacy.node == "" and legacy.attrs == {}
+
+
+def test_admin_unknown_kind_acked_not_crashed():
+    """Mixed-version clusters: an AdminRequest kind this server doesn't
+    know (a NEWER tool speaking to an OLDER node) must answer a clean
+    ``AdminAck(ok=False)`` on the wire — never an exception frame — so the
+    scraping side can skip the node and continue over the survivors."""
+    import asyncio
+
+    from rio_tpu.admin import AdminAck, AdminControl, AdminRequest, AdminSender
+
+    class _Sender:
+        def send(self, cmd):  # pragma: no cover - unknown kinds never reach it
+            raise AssertionError("unknown kind must not enqueue")
+
+    class _Ctx:
+        def try_get(self, t):
+            return _Sender() if t is AdminSender else None
+
+    ack = asyncio.run(
+        AdminControl().admin(AdminRequest(kind="dump_holograms"), _Ctx())
+    )
+    assert isinstance(ack, AdminAck)
+    assert ack.ok is False
+    assert "dump_holograms" in ack.detail

@@ -1,0 +1,209 @@
+"""Span ring retention semantics: overflow accounting, tail capture,
+tolerant wire rows, and the waterfall assembly over merged records."""
+
+import time
+from types import SimpleNamespace
+
+from rio_tpu.spans import (
+    PHASE_KEYS,
+    Phases,
+    SpanRecord,
+    SpanRing,
+    arm_client_ring,
+    client_ring,
+    disarm_client_ring,
+    finish_request,
+    merge_spans,
+)
+
+
+def _record(ring, i, trace_id="t", **attrs):
+    return ring.record(
+        trace_id=trace_id,
+        span_id=f"s{i}",
+        parent_id="",
+        name="request",
+        wall_start=1000.0 + i,
+        duration_us=10 * i,
+        attrs=attrs,
+    )
+
+
+def test_ring_overflow_dropped_accounting():
+    """Overwrite-oldest with gap-free seqs: a full ring never blocks or
+    fails, every overwritten record counts in ``dropped``, and snapshots
+    return the surviving window oldest → newest."""
+    ring = SpanRing(capacity=4, node="n1")
+    for i in range(10):
+        _record(ring, i)
+    assert ring.retained == 10
+    assert ring.dropped == 6
+    assert len(ring) == 4
+    assert [r.seq for r in ring.spans()] == [7, 8, 9, 10]
+    # limit keeps the NEWEST matches (a tail, not a head).
+    assert [r.seq for r in ring.spans(limit=2)] == [9, 10]
+    # since_seq resumes a tail.
+    assert [r.seq for r in ring.spans(since_seq=8)] == [9, 10]
+    g = ring.gauges()
+    assert g["rio.spans.retained"] == 10.0
+    assert g["rio.spans.dropped"] == 6.0
+    assert g["rio.spans.ring_occupancy"] == 4.0
+    assert g["rio.spans.ring_capacity"] == 4.0
+
+
+def test_ring_trace_filter():
+    ring = SpanRing(capacity=8, node="n1")
+    for i in range(6):
+        _record(ring, i, trace_id="a" if i % 2 else "b")
+    assert [r.seq for r in ring.spans(trace_id="a")] == [2, 4, 6]
+    assert ring.spans(trace_id="nope") == []
+
+
+def _env():
+    return SimpleNamespace(
+        handler_type="Svc", handler_id="g1", message_type="Get"
+    )
+
+
+def _phases(total_s: float, trace_ctx=None) -> Phases:
+    t0 = 100.0
+    ph = Phases(t0, trace_ctx)
+    ph.decode = t0 + total_s * 0.1
+    ph.queue = t0 + total_s * 0.2
+    ph.handler_start = ph.queue
+    ph.handler_end = t0 + total_s * 0.8
+    ph.encode = t0 + total_s * 0.9
+    ph.flush = t0 + total_s
+    return ph
+
+
+def test_tail_capture_over_slo():
+    """Untraced requests are retained only past the SLO — with a fresh
+    trace id, a ``tail=1`` attr, and the counter bumped; under the SLO
+    nothing is recorded; traced requests always retain."""
+    ring = SpanRing(capacity=8, node="n1", slo_ms=5.0)
+    # 1 ms untraced: below the SLO, dropped on the floor.
+    assert finish_request(ring, _phases(0.001), _env()) is None
+    assert ring.retained == 0 and ring.tail_captured == 0
+    # 10 ms untraced: tail-captured with a synthesized trace id.
+    rec = finish_request(ring, _phases(0.010), _env())
+    assert rec is not None
+    assert ring.tail_captured == 1
+    assert rec.attrs["tail"] == 1
+    assert len(rec.trace_id) == 32 and rec.parent_id == ""
+    assert rec.duration_us == 10_000
+    # Fast but traced: the caller decided, always retained, no tail attr.
+    rec2 = finish_request(ring, _phases(0.001, ("ab" * 16, "cd" * 8, True)), _env())
+    assert rec2 is not None and ring.tail_captured == 1
+    assert rec2.trace_id == "ab" * 16 and rec2.parent_id == "cd" * 8
+    assert "tail" not in rec2.attrs
+    # Phase decomposition covers the whole request, in pipeline order.
+    for key in PHASE_KEYS:
+        assert key in rec2.attrs and rec2.attrs[key] >= 0
+    assert rec2.attrs["handler"] == "Svc/g1" and rec2.attrs["msg"] == "Get"
+    assert sum(rec2.attrs[k] for k in PHASE_KEYS) <= rec2.duration_us
+
+
+def test_tail_capture_disarmed_at_zero_slo():
+    ring = SpanRing(capacity=8, node="n1", slo_ms=0.0)
+    assert finish_request(ring, _phases(10.0), _env()) is None
+    assert ring.retained == 0
+
+
+def test_span_row_tolerant_decode():
+    """Positional rows: short legacy rows pad with defaults, extra
+    trailing fields from a newer sender are ignored (append-only growth)."""
+    rec = SpanRecord(
+        seq=3, trace_id="t", span_id="s", parent_id="p", name="request",
+        node="n", wall_start=1234.5, duration_us=42, attrs={"handler": "S/x"},
+    )
+    row = rec.to_row()
+    assert SpanRecord.from_row(row) == rec
+    # A newer sender appended two fields: ignored, not an error.
+    assert SpanRecord.from_row(row + ["future", 7]) == rec
+    # A short legacy row decodes with defaults.
+    legacy = SpanRecord.from_row([1, "t2", "s2"])
+    assert legacy.seq == 1 and legacy.trace_id == "t2"
+    assert legacy.node == "" and legacy.duration_us == 0 and legacy.attrs == {}
+
+
+def test_merge_spans_orders_across_nodes():
+    a, b = SpanRing(capacity=4, node="a"), SpanRing(capacity=4, node="b")
+    _record(a, 5)  # wall_start 1005
+    _record(b, 3)  # wall_start 1003
+    _record(a, 7)  # wall_start 1007
+    merged = merge_spans([a.spans(), b.spans()])
+    assert [(r.node, r.seq) for r in merged] == [("b", 1), ("a", 1), ("a", 2)]
+
+
+def test_assemble_waterfall_tree_and_events():
+    """Hops nest under their wire parent; parentless hops root; journal
+    events carrying the trace id join their trace's tree."""
+    from rio_tpu.admin import assemble_waterfall, format_waterfall
+    from rio_tpu.journal import JournalEvent
+
+    ring = SpanRing(capacity=8, node="srv")
+    client = SpanRing(capacity=8, node="")
+    client.record(
+        trace_id="T", span_id="root", parent_id="", name="client_request",
+        wall_start=1000.0, duration_us=900,
+        attrs={"handler": "Svc/g1", "send_us": 100, "await_us": 800,
+               "redirects": 1},
+    )
+    ring.record(
+        trace_id="T", span_id="h1", parent_id="root", name="request",
+        wall_start=1000.1, duration_us=200,
+        attrs={"handler": "Svc/g1", "status": 1, "decode_us": 5},
+    )
+    ring.record(
+        trace_id="T", span_id="h2", parent_id="root", name="request",
+        wall_start=1000.2, duration_us=300,
+        attrs={"handler": "Svc/g1", "decode_us": 4},
+    )
+    ev = JournalEvent(
+        seq=1, wall_ts=1000.05, mono_ts=1.0, node="srv", epoch=0,
+        kind="place_assign", key="Svc/g1", attrs={}, trace_id="T",
+    )
+    trees = assemble_waterfall(
+        merge_spans([ring.spans(), client.spans()]), [ev]
+    )
+    assert set(trees) == {"T"}
+    tree = trees["T"]
+    assert tree["hops"] == 3
+    assert len(tree["roots"]) == 1
+    root = tree["roots"][0]
+    assert root["record"].span_id == "root"
+    # Children in wall order: the redirect hop first.
+    assert [c["record"].span_id for c in root["children"]] == ["h1", "h2"]
+    assert tree["events"] == [ev]
+    text = format_waterfall("T", tree)
+    assert "client_request" in text and "status=1" in text
+    assert "place_assign" in text
+    # A hop whose parent no ring retained becomes a root, not an orphan.
+    lone = SpanRing(capacity=2, node="x")
+    lone.record(
+        trace_id="U", span_id="u1", parent_id="gone", name="request",
+        wall_start=1.0, duration_us=1, attrs={},
+    )
+    u = assemble_waterfall(lone.spans())["U"]
+    assert len(u["roots"]) == 1 and u["roots"][0]["record"].span_id == "u1"
+
+
+def test_client_ring_arm_disarm():
+    assert client_ring() is None
+    try:
+        ring = arm_client_ring(capacity=16, slo_ms=1.5)
+        assert client_ring() is ring
+        assert ring.capacity == 16 and ring.slo_ms == 1.5 and ring.node == ""
+    finally:
+        disarm_client_ring()
+    assert client_ring() is None
+
+
+def test_phases_defaults_to_recv():
+    t0 = time.monotonic()
+    ph = Phases(t0)
+    assert ph.decode == ph.queue == ph.handler_end == ph.flush == t0
+    assert ph.trace_id == "" and ph.parent_id == "" and ph.attrs is None
+    ph2 = Phases(t0, ("tid", "sid", True))
+    assert ph2.trace_id == "tid" and ph2.parent_id == "sid"

@@ -150,6 +150,157 @@ def test_one_trace_id_across_processes_and_redirect(tmp_path):
             p.communicate(timeout=30)
 
 
+def test_cross_process_waterfall_assembly(tmp_path, capsys):
+    """The waterfall acceptance test: client→A(redirect)→B across two OS
+    processes assembles into ONE trace tree via `admin trace <id>` — the
+    client hop rooting two server hops, each hop decomposed into
+    recv/decode/queue/handler/encode/flush, and the seating trace's tree
+    joined to its place_assign journal event."""
+    import json
+    import socket
+    import subprocess
+    import sys as _sys
+
+    from rio_tpu.spans import PHASE_KEYS, arm_client_ring, disarm_client_ring
+
+    ports = []
+    socks = []
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    repo = str(Path(__file__).resolve().parent.parent)
+    child = str(Path(__file__).resolve().parent / "tracing_server_child.py")
+    env = {
+        "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+        "HOME": os.environ.get("HOME", "/tmp"),
+        "PYTHONPATH": repo,
+    }
+    procs = [
+        subprocess.Popen(
+            [_sys.executable, child, str(port), str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        )
+        for port in ports
+    ]
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+
+    async def drive():
+        from rio_tpu import Client
+        from rio_tpu.admin import _cli_main, assemble_waterfall, scrape_events, scrape_spans
+        from rio_tpu.cluster.storage.sqlite import SqliteMembershipStorage
+        from rio_tpu.journal import merge_events
+        from rio_tpu.registry import type_id
+
+        members = SqliteMembershipStorage(str(tmp_path / "members.db"))
+        try:
+            deadline = asyncio.get_event_loop().time() + 60.0
+            while asyncio.get_event_loop().time() < deadline:
+                if any(p.poll() is not None for p in procs):
+                    raise AssertionError("a server child exited early")
+                try:
+                    active = {m.address for m in await members.active_members()}
+                except Exception:
+                    active = set()
+                if set(addrs) <= active:
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                raise TimeoutError("children never became active members")
+
+            rooted: list[str] = []
+            tracing.set_sample_rate(1.0)
+            tracing.add_sink(lambda s: rooted.append(s.trace_id))
+            arm_client_ring()
+
+            client = Client(members)
+            try:
+                # Seat the object; its activation journals place_assign
+                # under the seating request's trace id.
+                out = await client.send(TrEcho, "t1", Probe(), returns=Seen)
+                owner = out.address
+                wrong = next(a for a in addrs if a != owner)
+                seating = rooted[-1]
+
+                from rio_tpu.registry import type_id as _tid
+
+                client._placement.put((_tid(TrEcho), "t1"), wrong)
+                out = await client.send(TrEcho, "t1", Probe(), returns=Seen)
+                assert out.address == owner
+                traced = out.trace_id
+                assert traced == rooted[-1]
+
+                # Journal join on the SEATING trace: its waterfall carries
+                # the place_assign event beside the request spans.
+                span_snaps = await scrape_spans(
+                    client, members, trace_id=seating
+                )
+                ev_snaps = await scrape_events(client, members, limit=512)
+                seat_tree = assemble_waterfall(
+                    [r for s in span_snaps for r in s.spans()],
+                    [
+                        e
+                        for e in merge_events(s.events() for s in ev_snaps)
+                        if e.trace_id == seating
+                    ],
+                )[seating]
+                assert any(
+                    e.kind == "place_assign" for e in seat_tree["events"]
+                ), "seating trace must join its place_assign journal event"
+
+                # The operator path end-to-end: `admin trace <id> --json`
+                # against the live cluster, client ring still armed so the
+                # caller's hop roots the tree.
+                rc = await _cli_main(
+                    ["--nodes", ",".join(addrs), "--json", "trace", traced]
+                )
+                assert rc == 0
+                return traced
+            finally:
+                client.close()
+        finally:
+            members.close()
+
+    try:
+        traced = asyncio.run(drive())
+    finally:
+        disarm_client_ring()
+        for p in procs:
+            p.kill()
+            p.communicate(timeout=30)
+
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert set(doc) == {traced}
+    tree = doc[traced]
+    assert tree["hops"] == 3
+    spans = tree["spans"]
+    # Depth 0: the caller's hop, rooted in THIS process's client ring.
+    root = spans[0]
+    assert root["depth"] == 0 and root["name"] == "client_request"
+    assert root["node"] == ""  # client hops carry no server address
+    assert root["attrs"]["send_us"] >= 0 and root["attrs"]["await_us"] > 0
+    assert root["attrs"]["roundtrips"] == 2  # redirect follow = two trips
+    assert root["attrs"]["redirects"] == 1
+    # Depth 1: one server hop per process, nested under the client hop.
+    server_hops = [s for s in spans if s["depth"] == 1]
+    assert len(server_hops) == 2
+    assert all(s["name"] == "request" for s in server_hops)
+    assert {s["node"] for s in server_hops} == set(addrs)
+    redirected = [s for s in server_hops if s["attrs"].get("status")]
+    dispatched = [s for s in server_hops if not s["attrs"].get("status")]
+    assert len(redirected) == 1 and len(dispatched) == 1
+    # The redirect came first: hop order inside the tree is causal.
+    assert server_hops[0] is redirected[0]
+    # Every server hop decomposes into the full phase chain.
+    for hop in server_hops:
+        for key in PHASE_KEYS:
+            assert isinstance(hop["attrs"][key], int), (hop["node"], key)
+            assert hop["attrs"][key] >= 0
+
+
 def test_readscale_proxied_read_carries_trace(tmp_path):
     """A stale standby transparently proxies a readonly request to the
     primary; the forwarded frame must carry the caller's trace_ctx so the
