@@ -1533,6 +1533,84 @@ def rpc_throughput(baseline: float | None = None) -> dict:
     return rates
 
 
+def rpc_egress(baseline: float | None = None) -> dict:
+    """Egress-coalescing A/B (``RIO_TPU_EGRESS_COALESCE``), paired in-session.
+
+    The load is the standard pipelined echo shape: 64 concurrent senders
+    share one client's pooled connections, so completed HEAD responses
+    flush from done-callback waves on the server. Coalesced (the default)
+    joins each wave into ONE buffer per connection — one write syscall in
+    the asyncio transport, one engine handoff + sendmsg gather in the
+    native one; per-frame is the pre-coalescing egress (one syscall per
+    response). Interleaved batches, median per-batch ratio — only the
+    ratio is comparable across artifacts (host absolute rates drift
+    ±30-40%; PROFILE_RPC.md). The knob gates the same seam in BOTH
+    transports (rio_tpu/aio.py + rio_tpu/native/transport.py), so both are
+    measured when the native library is available.
+    """
+    import asyncio
+    import statistics
+
+    from rio_tpu import aio, native
+    from rio_tpu.utils.routing_live import measure_rpc_throughput
+
+    if baseline is None:
+        baseline = sqlite_baseline_rate()
+    try:
+        from rio_tpu.native import transport as native_transport
+    except Exception:  # pragma: no cover - native build unavailable
+        native_transport = None
+
+    def set_coalesce(enabled: bool) -> None:
+        aio._EGRESS_COALESCE = enabled
+        if native_transport is not None:
+            native_transport._EGRESS_COALESCE = enabled
+
+    env_default = os.environ.get("RIO_TPU_EGRESS_COALESCE", "1") != "0"
+    out: dict = {
+        "sqlite_baseline_in_session": round(baseline),
+        "host": _host_provenance(),
+    }
+    transports = ["asyncio"] + (["native"] if native.get() is not None else [])
+    try:
+        for transport in transports:
+            # 5 batches, like the batch-decode A/B: a syscall-count delta
+            # is a few percent on loopback and needs the extra pairs to
+            # resolve out of scheduler noise.
+            per_frame, coalesced = [], []
+            for _ in range(5):
+                set_coalesce(False)
+                per_frame.append(asyncio.run(
+                    measure_rpc_throughput(
+                        transport=transport, requests_per_worker=600
+                    )
+                ))
+                set_coalesce(True)
+                coalesced.append(asyncio.run(
+                    measure_rpc_throughput(
+                        transport=transport, requests_per_worker=600
+                    )
+                ))
+            ratio = statistics.median(
+                c / p for p, c in zip(per_frame, coalesced)
+            )
+            out[transport] = {
+                "per_frame": [round(r) for r in per_frame],
+                "coalesced": [round(r) for r in coalesced],
+                "coalesced_vs_per_frame": round(ratio, 3),
+                "vs_sqlite": round(coalesced[-1] / baseline, 3),
+            }
+            print(
+                f"# rpc egress ({transport}, coalesced vs per-frame flush, "
+                f"paired): {coalesced[-1]:,.0f} vs {per_frame[-1]:,.0f} "
+                f"msgs/sec = {ratio:.3f}x",
+                file=sys.stderr,
+            )
+    finally:
+        set_coalesce(env_default)
+    return out
+
+
 def rpc_sharded(baseline: float | None = None) -> dict:
     """Sharded data-plane A/B battery (real worker processes, loopback).
 
@@ -1548,6 +1626,10 @@ def rpc_sharded(baseline: float | None = None) -> dict:
       (``RIO_TPU_BATCH_DECODE``), same topology otherwise.
     * ``n_workers`` — aggregate msgs/s through N workers, driven by
       ``--loadgen`` children (WARM/GO-coordinated concurrent windows).
+    * ``shard_aware`` — same N-worker loadgen shape, clients computing
+      crc32 % N locally (``Client(shard_aware=True)``) vs redirect-
+      following, plus the redirect-elimination audit (shard-aware clients
+      must pay ZERO redirects for unplaced traffic).
     * ``engine`` — N workers on the native transport vs asyncio (identity
       ports only: the front-door listener is asyncio's), plus the
       ``engine_profitable`` verdict the dispatch rule would apply.
@@ -1609,7 +1691,7 @@ def rpc_sharded(baseline: float | None = None) -> dict:
         ratio = statistics.median(b / a for a, b in zip(ra, rb))
         return [round(r) for r in ra], [round(r) for r in rb], round(ratio, 3)
 
-    def loadgen_aggregate(node, n_gens=2):
+    def loadgen_aggregate(node, n_gens=2, shard_aware=False, tag="lg"):
         """Concurrent measured windows from separate loadgen processes."""
         procs = []
         for g in range(n_gens):
@@ -1621,7 +1703,8 @@ def rpc_sharded(baseline: float | None = None) -> dict:
             spec = {
                 "members": node.members_spec, "data_dir": node.data_dir,
                 "n_objects": 128, "n_workers": 16,
-                "requests_per_worker": 200, "prefix": f"lg{g}",
+                "requests_per_worker": 200, "prefix": f"{tag}{g}",
+                "shard_aware": shard_aware,
             }
             p.stdin.write(json.dumps(spec) + "\n")
             p.stdin.flush()
@@ -1645,6 +1728,8 @@ def rpc_sharded(baseline: float | None = None) -> dict:
                     p.kill()
         return {
             "aggregate_rate": round(sum(g["rate"] for g in gens)),
+            "redirects": sum(g.get("redirects", 0) for g in gens),
+            "shard_routes": sum(g.get("shard_routes", 0) for g in gens),
             "generators": gens,
         }
 
@@ -1694,6 +1779,46 @@ def rpc_sharded(baseline: float | None = None) -> dict:
             f"# rpc sharded ({n} workers, {len(agg['generators'])} loadgen "
             f"procs): {agg['aggregate_rate']:,.0f} msgs/sec aggregate "
             f"({agg['vs_sqlite']:.2f}x in-session sqlite baseline)",
+            file=sys.stderr,
+        )
+
+        # Shard-aware front door A/B: identical topology and loadgen
+        # shape, the only variable being Client(shard_aware=) — crc32 % N
+        # computed client-side with direct identity dials vs the reference
+        # redirect-follow policy. Fresh object prefixes per batch keep the
+        # traffic genuinely unplaced, so the redirect audit measures the
+        # claim exactly: shard-aware clients pay ZERO redirects for
+        # unplaced traffic while redirect-routed clients pay one per
+        # mis-picked first touch.
+        rr_rates, sa_rates = [], []
+        rr_redirects = sa_redirects = sa_routes = 0
+        for b in range(3):
+            a = loadgen_aggregate(node_n, shard_aware=False, tag=f"rd{b}g")
+            s = loadgen_aggregate(node_n, shard_aware=True, tag=f"sa{b}g")
+            rr_rates.append(a["aggregate_rate"])
+            sa_rates.append(s["aggregate_rate"])
+            rr_redirects += a["redirects"]
+            sa_redirects += s["redirects"]
+            sa_routes += s["shard_routes"]
+        sa_ratio = statistics.median(
+            s / a for a, s in zip(rr_rates, sa_rates)
+        )
+        out["shard_aware"] = {
+            "n_workers": n,
+            "redirect_routed": rr_rates,
+            "shard_aware": sa_rates,
+            "shard_aware_vs_redirect": round(sa_ratio, 3),
+            "redirects": {
+                "redirect_routed": rr_redirects, "shard_aware": sa_redirects,
+            },
+            "shard_routes": sa_routes,
+        }
+        print(
+            f"# rpc sharded ({n} workers, shard-aware vs redirect-routed "
+            f"clients, paired): {sa_rates[-1]:,.0f} vs {rr_rates[-1]:,.0f} "
+            f"msgs/sec aggregate = {sa_ratio:.3f}x; redirects "
+            f"{sa_redirects} vs {rr_redirects}, {sa_routes} direct shard "
+            f"dials",
             file=sys.stderr,
         )
 
@@ -2256,6 +2381,10 @@ def main() -> None:
     except Exception as e:
         print(f"# rpc throughput failed: {e!r}", file=sys.stderr)
     try:
+        detail["rpc_egress"] = rpc_egress(baseline)
+    except Exception as e:
+        print(f"# rpc egress failed: {e!r}", file=sys.stderr)
+    try:
         detail["rpc_sharded"] = rpc_sharded(baseline)
     except Exception as e:
         print(f"# rpc sharded failed: {e!r}", file=sys.stderr)
@@ -2451,6 +2580,9 @@ if __name__ == "__main__":
     # Run the sharded data-plane A/B battery alone and bank it into the
     # cpu sidecar (real worker processes on loopback; CPU-safe).
     parser.add_argument("--sharded", action="store_true")
+    # Run the egress-coalescing A/B alone and bank it into the cpu sidecar
+    # (in-process live cluster, both transports; CPU-safe).
+    parser.add_argument("--egress", action="store_true")
     # Run the fault-injection disabled-overhead A/B alone and bank it into
     # the cpu sidecar (same CPU-safe in-process-cluster shape as --series).
     parser.add_argument("--faults", action="store_true")
@@ -2517,6 +2649,23 @@ if __name__ == "__main__":
         except (OSError, ValueError):
             detail = {}
         detail["rpc_sharded"] = out
+        _write_detail(detail, here)
+        print(json.dumps(out))
+    elif args.egress:
+        # Standalone --egress updates the banked cpu sidecar in place (the
+        # --sharded pattern): the A/B carries its own paired baseline, so
+        # it can refresh independently of the other host stages.
+        _pin_orchestrator_to_cpu()
+        out = rpc_egress()
+        here = os.path.dirname(os.path.abspath(__file__))
+        try:
+            with open(os.path.join(here, "BENCH_DETAIL.cpu.json")) as fh:
+                detail = json.load(fh)
+            if not isinstance(detail, dict):
+                detail = {}
+        except (OSError, ValueError):
+            detail = {}
+        detail["rpc_egress"] = out
         _write_detail(detail, here)
         print(json.dumps(out))
     elif args.faults:

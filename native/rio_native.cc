@@ -42,6 +42,7 @@
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 namespace {
@@ -592,23 +593,44 @@ void engine_close_conn(Engine* e, uint64_t id, bool emit) {
 }
 
 // Flush as much of conn's write queue as the socket accepts; manage EPOLLOUT
-// interest. Returns false if the connection died (or was finally closed).
+// interest. Gathers up to kFlushIov queued buffers into one sendmsg so a
+// pipelined response wave (or a burst of subscription frames) leaves in one
+// syscall instead of one per buffer. Returns false if the connection died
+// (or was finally closed).
 bool engine_flush(Engine* e, uint64_t id, Conn& c) {
+  constexpr size_t kFlushIov = 64;  // well under Linux's IOV_MAX (1024)
   while (!c.wq.empty()) {
-    auto& front = c.wq.front();
-    ssize_t n = send(c.fd, front.data() + c.woff, front.size() - c.woff,
-                     MSG_NOSIGNAL);
+    struct iovec iov[kFlushIov];
+    size_t niov = 0;
+    for (auto it = c.wq.begin(); it != c.wq.end() && niov < kFlushIov; ++it) {
+      size_t off = (niov == 0) ? c.woff : 0;
+      iov[niov].iov_base = const_cast<uint8_t*>(it->data() + off);
+      iov[niov].iov_len = it->size() - off;
+      ++niov;
+    }
+    struct msghdr mh {};
+    mh.msg_iov = iov;
+    mh.msg_iovlen = niov;
+    ssize_t n = sendmsg(c.fd, &mh, MSG_NOSIGNAL);
     if (n > 0) {
-      c.woff += static_cast<size_t>(n);
       {
         std::lock_guard<std::mutex> lk(e->mu);
         auto b = e->backlog.find(id);
         if (b != e->backlog.end() && (b->second -= n) <= 0)
           e->backlog.erase(b);
       }
-      if (c.woff == front.size()) {
-        c.wq.pop_front();
-        c.woff = 0;
+      size_t left = static_cast<size_t>(n);
+      while (left > 0) {
+        auto& front = c.wq.front();
+        size_t avail = front.size() - c.woff;
+        if (left >= avail) {
+          left -= avail;
+          c.wq.pop_front();
+          c.woff = 0;
+        } else {
+          c.woff += left;
+          left = 0;
+        }
       }
       continue;
     }

@@ -23,6 +23,7 @@ from .commands import (
     AdminSender,
     InternalClientSender,
     ServerInfo,
+    ShardMap,
     ShardRouter,
     shard_of,
 )
@@ -148,6 +149,7 @@ __all__ = [
     "ServerBusy",
     "ServerInfo",
     "ServiceObject",
+    "ShardMap",
     "ShardRouter",
     "ShardedServer",
     "shard_of",

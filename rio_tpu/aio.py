@@ -58,6 +58,14 @@ log = logging.getLogger("rio_tpu.aio")
 # in-session; measured +4-6% under pipelining on the r6 capture.
 _BATCH_DECODE = os.environ.get("RIO_TPU_BATCH_DECODE", "1") != "0"
 
+# Egress coalescing (the outbound mirror of batch decode): frames produced
+# in one loop tick — e.g. every completed HEAD response of a done-callback
+# wave — are corked and written as ONE buffer instead of one syscall per
+# frame. Concatenating complete length-prefixed frames is byte-identical on
+# the wire, so the FIFO-per-connection contract is untouched. =0 restores
+# the per-frame write, which is the baseline leg of `bench.py --egress`.
+_EGRESS_COALESCE = os.environ.get("RIO_TPU_EGRESS_COALESCE", "1") != "0"
+
 
 class _BadFrame:
     """Queued marker for a frame that failed to decode (batch-decode path).
@@ -305,6 +313,17 @@ class ServerConnProtocol(asyncio.Protocol):
         self._transport.close()
 
     def _write_soon(self, data: bytes) -> None:
+        if not _EGRESS_COALESCE:
+            # Per-frame baseline (bench A/B): one transport.write per frame.
+            if self._lost or self._broken:
+                return
+            try:
+                assert self._transport is not None
+                self._transport.write(data)
+            except Exception:
+                log.exception("response write error; dropping connection")
+                self._break()
+            return
         self._out.append(data)
         if not self._flush_scheduled:
             self._flush_scheduled = True
@@ -585,6 +604,16 @@ class ClientConnProtocol(asyncio.Protocol):
         order, and the server cannot answer a frame before it is written,
         so FIFO matching is unaffected.
         """
+        if not _EGRESS_COALESCE:
+            # Per-frame baseline (bench A/B), mirroring the server side.
+            if self.closed or self._transport is None:
+                return
+            try:
+                self._transport.write(frame_bytes)
+            except Exception:
+                log.exception("request write error; dropping connection")
+                self.close()
+            return
         self._out.append(frame_bytes)
         if not self._flush_scheduled:
             self._flush_scheduled = True

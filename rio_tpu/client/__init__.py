@@ -173,6 +173,7 @@ class ClientStats:
     dial_failures: int = 0  # attempts that died before a response (dead addr)
     busy_retries: int = 0  # SERVER_BUSY sheds answered with backoff + re-route
     standby_routes: int = 0  # read attempts sent to a standby seat (readscale)
+    shard_routes: int = 0  # attempts direct-dialed via the adopted shard map
 
 
 class Client:
@@ -203,11 +204,20 @@ class Client:
         standby_resolver: Callable[[str, str], Awaitable[list[str]]] | None = None,
         transport_faults: Any | None = None,
         identity: str = "",
+        shard_aware: bool = False,
     ) -> None:
         if transport not in ("asyncio", "native", "auto"):
             raise ValueError(f"unknown transport {transport!r}")
         self.members_storage = members_storage
         self.stats = ClientStats()
+        # Shard-aware routing: adopt the ShardMap a sharded node publishes
+        # through its membership rows (rio_tpu/sharded.py) and compute
+        # crc32 % N locally on a cache miss — the owning worker's identity
+        # address is dialed directly, zero redirects for unplaced traffic.
+        # Cached placements / seat hints still override the hash map,
+        # mirroring the server-side ShardRouter precedence.
+        self._shard_aware = shard_aware
+        self._shard_map: Any | None = None  # rio_tpu.commands.ShardMap
         self._ph_tick = -1  # 1-in-8 client-hop stride for untraced traffic
         # Fault-injection handle + source identity for (src, dst) link
         # rules (rio_tpu.faults.TransportFaults); None in production.
@@ -275,7 +285,35 @@ class Client:
                 ) from e
             self._active_servers = [m.address for m in members]
             self._view_ts = loop.time()
+            if self._shard_aware:
+                self._adopt_shard_map(members)
         return self._active_servers
+
+    def _adopt_shard_map(self, members: list) -> None:
+        """Adopt the freshest published shard map from the active view.
+
+        Highest epoch wins across rows (every worker of one node publishes
+        the same map, but mid-reseat rows can mix epochs). On an epoch/slot
+        change the previous map's derived state — seat hints and cached
+        placements — is dropped: a SIGKILLed worker's reseated slice must
+        not keep being direct-dialed off the stale map (the client falls
+        back to redirect-follow until the new rows converge, then re-adopts).
+        """
+        from ..commands import ShardMap
+
+        best: Any | None = None
+        for m in members:
+            decoded = ShardMap.decode(getattr(m, "shard_map", ""))
+            if decoded is not None and (best is None or decoded.epoch > best.epoch):
+                best = decoded
+        if best is None or best == self._shard_map:
+            return
+        if self._shard_map is not None:
+            # Map CHANGED (not first adoption): everything derived under
+            # the old map is suspect.
+            self._read_seats.clear()
+            self._placement.clear()
+        self._shard_map = best
 
     def _pool(self, address: str) -> _ServerConns:
         pool = self._conns.get(address)
@@ -321,6 +359,16 @@ class Client:
             servers = await self.fetch_active_servers(refresh=True)
         if not servers:
             raise ServerNotAvailable("no active servers in membership view")
+        if self._shard_map is not None:
+            # Shard-aware direct dial: crc32 % N against the adopted map
+            # (refreshed by the fetch above), but ONLY while the owner is an
+            # active member that hasn't already failed this request — a dead
+            # worker's slice degrades to the redirect-follow path below,
+            # exactly like the server-side ShardRouter's dead-owner branch.
+            owner = self._shard_map.owner(handler_type, handler_id)
+            if owner in servers and (avoid is None or owner not in avoid):
+                self.stats.shard_routes += 1
+                return owner
         if avoid:
             alive = [s for s in servers if s not in avoid]
             if alive:
@@ -818,6 +866,12 @@ class ClientBuilder:
         self._standby_resolver_fn = resolver
         return self
 
+    def shard_aware(self, enabled: bool = True) -> "ClientBuilder":
+        """Adopt published shard maps and direct-dial the owning worker
+        (see :class:`Client`)."""
+        self._shard_aware_flag = enabled
+        return self
+
     def build(self) -> Client:
         if self._storage is None:
             raise ClientBuilderError("members_storage is required")
@@ -832,4 +886,5 @@ class ClientBuilder:
             membership_view_ttl=getattr(self, "_view_ttl_value", 1.0),
             read_scale=getattr(self, "_read_scale_config", None),
             standby_resolver=getattr(self, "_standby_resolver_fn", None),
+            shard_aware=getattr(self, "_shard_aware_flag", False),
         )

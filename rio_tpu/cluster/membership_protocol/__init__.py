@@ -32,6 +32,15 @@ class ClusterProvider(abc.ABC):
     def set_load_source(self, source: Callable[[], str] | None) -> None:
         self._load_source = source
 
+    # Encoded rio_tpu.commands.ShardMap this node advertises ('' for
+    # non-sharded nodes). Like the load vector it piggybacks on every
+    # heartbeat push, so shard-aware clients learn the worker slot map from
+    # the membership view with no new RPCs.
+    _shard_map: str = ""
+
+    def set_shard_map(self, encoded: str) -> None:
+        self._shard_map = encoded or ""
+
     # Optional observability hooks, wired by the server the same way as the
     # load source: a Journal for STORAGE outage/recovery events and a
     # StorageHealth for rio.storage.* gauges. Both default to None — a bare
@@ -74,7 +83,8 @@ class LocalClusterProvider(ClusterProvider):
             try:
                 await self._storage.push(
                     Member.from_address(
-                        address, active=True, load=self._load_snapshot()
+                        address, active=True, load=self._load_snapshot(),
+                        shard_map=self._shard_map,
                     )
                 )
                 break
@@ -83,14 +93,15 @@ class LocalClusterProvider(ClusterProvider):
             except Exception:  # noqa: BLE001 — storage outage at boot
                 await asyncio.sleep(0.1)
         while True:
-            if self._load_source is None:
+            if self._load_source is None and not self._shard_map:
                 await asyncio.sleep(3600)
                 continue
             await asyncio.sleep(0.2)
             try:
                 await self._storage.push(
                     Member.from_address(
-                        address, active=True, load=self._load_snapshot()
+                        address, active=True, load=self._load_snapshot(),
+                        shard_map=self._shard_map,
                     )
                 )
             except asyncio.CancelledError:

@@ -183,7 +183,10 @@ class PeerToPeerClusterProvider(ClusterProvider):
             # retry with jitter instead of dying before the first tick.
             try:
                 await self._storage.push(
-                    Member.from_address(address, active=True, load=self._load_snapshot())
+                    Member.from_address(
+                        address, active=True, load=self._load_snapshot(),
+                        shard_map=self._shard_map,
+                    )
                 )
                 self._note_storage_ok()
                 break
@@ -239,7 +242,8 @@ class PeerToPeerClusterProvider(ClusterProvider):
                 try:
                     await self._storage.push(
                         Member.from_address(
-                            address, active=True, load=self._load_snapshot()
+                            address, active=True, load=self._load_snapshot(),
+                            shard_map=self._shard_map,
                         )
                     )
                 except asyncio.CancelledError:

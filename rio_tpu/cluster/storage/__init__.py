@@ -27,6 +27,11 @@ class Member:
     # heartbeat row is what lets every peer derive a ClusterLoadView from the
     # storage it already polls — no new RPCs.
     load: str = ""
+    # Encoded rio_tpu.commands.ShardMap ("epoch|addr,addr,..."); empty for
+    # non-sharded nodes and legacy rows. Same appended-column contract as
+    # ``load``: rides the heartbeat so shard-aware clients learn the worker
+    # slot map from the membership view they already poll.
+    shard_map: str = ""
 
     @property
     def address(self) -> str:
@@ -34,11 +39,16 @@ class Member:
 
     @classmethod
     def from_address(
-        cls, address: str, active: bool = False, load: str = ""
+        cls, address: str, active: bool = False, load: str = "", shard_map: str = ""
     ) -> "Member":
         ip, _, port = address.rpartition(":")
         return cls(
-            ip=ip, port=int(port), active=active, last_seen=time.time(), load=load
+            ip=ip,
+            port=int(port),
+            active=active,
+            last_seen=time.time(),
+            load=load,
+            shard_map=shard_map,
         )
 
 

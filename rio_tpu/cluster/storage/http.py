@@ -27,6 +27,7 @@ def _member_json(m: Member) -> dict:
         "active": m.active,
         "last_seen": m.last_seen,
         "load": m.load,
+        "shard_map": m.shard_map,
     }
 
 
@@ -89,7 +90,8 @@ class HttpMembershipStorage(MembershipStorage):
         rows = await self._get("/members") or []
         return [
             Member(ip=r["ip"], port=r["port"], active=r["active"],
-                   last_seen=r["last_seen"], load=r.get("load", ""))
+                   last_seen=r["last_seen"], load=r.get("load", ""),
+                   shard_map=r.get("shard_map", ""))
             for r in rows
         ]
 

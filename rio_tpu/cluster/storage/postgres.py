@@ -21,6 +21,7 @@ MIGRATIONS = [
         active    INTEGER NOT NULL DEFAULT 0,
         last_seen DOUBLE PRECISION NOT NULL DEFAULT 0,
         load_vec  TEXT NOT NULL DEFAULT '',
+        shard_map TEXT NOT NULL DEFAULT '',
         PRIMARY KEY (ip, port)
     );
     CREATE TABLE IF NOT EXISTS cluster_provider_member_failures (

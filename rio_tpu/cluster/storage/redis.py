@@ -35,18 +35,24 @@ class RedisMembershipStorage(MembershipStorage):
     @staticmethod
     def _encode(member: Member, last_seen: float | None = None) -> str:
         ts = member.last_seen if last_seen is None else last_seen
-        # The load vector is comma-joined floats (LoadVector.encode), so it
-        # can never collide with this value's own ';' separator.
-        return f"{member.ip};{member.port};{int(member.active)};{ts};{member.load}"
+        # The load vector is comma-joined floats (LoadVector.encode) and the
+        # shard map is "epoch|addr,addr" (ShardMap.encode), so neither can
+        # collide with this value's own ';' separator.
+        return (
+            f"{member.ip};{member.port};{int(member.active)};{ts};"
+            f"{member.load};{member.shard_map}"
+        )
 
     @staticmethod
     def _decode(raw: bytes) -> Member:
-        # Tolerate 4-field values written before the load column existed.
+        # Tolerate short values written before the load / shard_map columns
+        # existed (4- and 5-field legacies respectively).
         parts = raw.decode().split(";")
         ip, port, active, last_seen = parts[:4]
         load = parts[4] if len(parts) > 4 else ""
+        shard_map = parts[5] if len(parts) > 5 else ""
         return Member(ip=ip, port=int(port), active=active == "1",
-                      last_seen=float(last_seen), load=load)
+                      last_seen=float(last_seen), load=load, shard_map=shard_map)
 
     async def push(self, member: Member) -> None:
         # Timestamp goes into the stored value only — the caller's Member is

@@ -21,6 +21,7 @@ MIGRATIONS = [
         active    INTEGER NOT NULL DEFAULT 0,
         last_seen REAL NOT NULL DEFAULT 0,
         load_vec  TEXT NOT NULL DEFAULT '',
+        shard_map TEXT NOT NULL DEFAULT '',
         PRIMARY KEY (ip, port)
     );
     CREATE TABLE IF NOT EXISTS cluster_provider_member_failures (
@@ -43,26 +44,30 @@ class SqliteMembershipStorage(MembershipStorage):
         await self._ensure_load_column()
 
     async def _ensure_load_column(self) -> None:
-        """Add ``load_vec`` to member tables created before the load
-        subsystem existed. ``migrate()`` keeps no applied-ledger (it re-runs
-        every statement each call) and sqlite has no ``ADD COLUMN IF NOT
-        EXISTS`` — so the upgrade is a guarded ALTER: the duplicate-column
-        error on an already-upgraded table is the expected no-op."""
-        try:
-            await self.db.execute(
-                "ALTER TABLE cluster_provider_members "
-                "ADD COLUMN load_vec TEXT NOT NULL DEFAULT ''"
-            )
-        except Exception:
-            pass
+        """Add the appended columns (``load_vec``, ``shard_map``) to member
+        tables created before those subsystems existed. ``migrate()`` keeps
+        no applied-ledger (it re-runs every statement each call) and sqlite
+        has no ``ADD COLUMN IF NOT EXISTS`` — so each upgrade is a guarded
+        ALTER: the duplicate-column error on an already-upgraded table is
+        the expected no-op."""
+        for col in ("load_vec", "shard_map"):
+            try:
+                await self.db.execute(
+                    "ALTER TABLE cluster_provider_members "
+                    f"ADD COLUMN {col} TEXT NOT NULL DEFAULT ''"
+                )
+            except Exception:
+                pass
 
     async def push(self, member: Member) -> None:
         await self.db.execute(
-            "INSERT INTO cluster_provider_members (ip, port, active, last_seen, load_vec) "
-            "VALUES (?,?,?,?,?) ON CONFLICT(ip, port) DO UPDATE SET "
+            "INSERT INTO cluster_provider_members "
+            "(ip, port, active, last_seen, load_vec, shard_map) "
+            "VALUES (?,?,?,?,?,?) ON CONFLICT(ip, port) DO UPDATE SET "
             "active=excluded.active, last_seen=excluded.last_seen, "
-            "load_vec=excluded.load_vec",
+            "load_vec=excluded.load_vec, shard_map=excluded.shard_map",
             member.ip, member.port, int(member.active), time.time(), member.load,
+            member.shard_map,
         )
 
     async def remove(self, ip: str, port: int) -> None:
@@ -88,12 +93,12 @@ class SqliteMembershipStorage(MembershipStorage):
 
     async def members(self) -> list[Member]:
         rows = await self.db.execute(
-            "SELECT ip, port, active, last_seen, load_vec "
+            "SELECT ip, port, active, last_seen, load_vec, shard_map "
             "FROM cluster_provider_members"
         )
         return [
             Member(ip=r[0], port=r[1], active=bool(r[2]), last_seen=r[3],
-                   load=r[4] or "")
+                   load=r[4] or "", shard_map=r[5] or "")
             for r in rows
         ]
 

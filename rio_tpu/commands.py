@@ -48,6 +48,49 @@ class ShardRouter:
         return self.slots[shard_of(type_name, object_id, len(self.slots))]
 
 
+@dataclasses.dataclass(frozen=True)
+class ShardMap:
+    """The shard map a sharded node publishes through its membership rows.
+
+    ``slots`` mirrors :class:`ShardRouter.slots` (worker identity
+    addresses, index == shard); ``epoch`` increments every time the
+    supervisor (re)builds the map, so a client can tell a *reseated* map
+    apart from the one it adopted and drop stale direct-dial state.
+
+    The encoded form rides the membership heartbeat as an appended column
+    (the ``Member.load`` precedent) so legacy rows — and legacy readers —
+    are untouched. Encoding constraint: the Redis backend joins member
+    fields with ``;``, so the text must never contain one; ``epoch|a,b,c``
+    uses only ``|`` and ``,``, both impossible in a ``host:port`` address.
+    """
+
+    epoch: int
+    slots: tuple  # worker identity addresses, index == shard
+
+    def encode(self) -> str:
+        return f"{self.epoch}|{','.join(self.slots)}"
+
+    @classmethod
+    def decode(cls, text: str) -> "ShardMap | None":
+        """Parse an encoded map; garbage (or empty) decodes to ``None`` —
+        a client must treat an unparseable column exactly like a legacy
+        row with no map at all."""
+        if not text or "|" not in text:
+            return None
+        head, _, body = text.partition("|")
+        try:
+            epoch = int(head)
+        except ValueError:
+            return None
+        slots = tuple(s for s in body.split(",") if s)
+        if not slots or any(":" not in s or ";" in s for s in slots):
+            return None
+        return cls(epoch=epoch, slots=slots)
+
+    def owner(self, type_name: str, object_id: str) -> str:
+        return self.slots[shard_of(type_name, object_id, len(self.slots))]
+
+
 class AdminCommandKind(Enum):
     SERVER_EXIT = "server_exit"
     SHUTDOWN_OBJECT = "shutdown_object"

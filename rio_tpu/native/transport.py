@@ -18,6 +18,7 @@ from __future__ import annotations
 import asyncio
 import ctypes
 import logging
+import os
 from collections import deque
 from time import perf_counter as _perf
 from typing import TYPE_CHECKING, Callable
@@ -44,6 +45,12 @@ _DRAIN_BATCH = 256
 _MAX_PENDING_FRAMES = 1024  # per-conn cap (reference relies on TCP backpressure)
 _MAX_CONCURRENT = 64  # per-conn in-flight handler cap (matches aio transport)
 _MAX_WRITE_BACKLOG = 1 << 20  # pause subscription pumps past 1 MiB unsent
+
+# Same knob as rio_tpu.aio: join a done-callback wave of completed HEAD
+# responses into one engine.send (one mutex grab + eventfd kick, one write
+# syscall) instead of one per frame. Concatenated length-prefixed frames
+# are byte-identical on the wire.
+_EGRESS_COALESCE = os.environ.get("RIO_TPU_EGRESS_COALESCE", "1") != "0"
 
 
 class Engine:
@@ -478,15 +485,20 @@ class NativeServerTransport:
 
         Runs synchronously from the handler task's done-callback (the same
         FIFO-flush design as :class:`rio_tpu.aio.ServerConnProtocol`), so
-        out-of-order completions cost nothing until their turn.
+        out-of-order completions cost nothing until their turn. With egress
+        coalescing on (default) the whole wave leaves as ONE joined
+        ``engine.send`` — one mutex grab + eventfd kick + write syscall
+        instead of one per frame; wire bytes are identical.
         """
         q = state.resp_q
         spans = self._spans
-        while q and q[0].done() and not state.broken:
-            fut = q.popleft()
-            if fut.cancelled():
-                continue  # shutdown path; nothing to write
-            try:
+        wave: list[bytes] = []  # coalesced frames awaiting one engine.send
+        stamped: list = []  # (ph, env) pairs whose flush stamp awaits that send
+        try:
+            while q and q[0].done() and not state.broken:
+                fut = q.popleft()
+                if fut.cancelled():
+                    continue  # shutdown path; nothing to write
                 resp = fut.result()
                 frame = encode_response_frame(resp)
                 if spans is not None:
@@ -497,19 +509,42 @@ class NativeServerTransport:
                         err = resp.error
                         if err is not None:
                             ph.attrs = {"status": int(err.kind)}
+                        if _EGRESS_COALESCE:
+                            wave.append(frame)
+                            stamped.append((ph, env))
+                            continue
                         self._engine.send(conn, frame)
                         ph.flush = _perf()
                         finish_request(spans, ph, env)
                         continue
-                self._engine.send(conn, frame)
-            except Exception:
-                log.exception("response write error; dropping conn %d", conn)
-                state.broken = True
-                state.eof = True
-                state.wake()
-                self._conns.pop(conn, None)
-                self._engine.close_conn(conn)
-                break
+                if _EGRESS_COALESCE:
+                    wave.append(frame)
+                else:
+                    self._engine.send(conn, frame)
+            if wave:
+                self._engine.send(
+                    conn, wave[0] if len(wave) == 1 else b"".join(wave)
+                )
+                if stamped:
+                    t = _perf()
+                    for ph, env in stamped:
+                        ph.flush = t
+                        finish_request(spans, ph, env)
+        except Exception:
+            log.exception("response write error; dropping conn %d", conn)
+            # Best-effort: frames collected before the failure are complete
+            # responses in FIFO order — hand them to the engine (which
+            # flushes its queue before close) like the per-frame path did.
+            if wave:
+                try:
+                    self._engine.send(conn, b"".join(wave))
+                except Exception:  # noqa: BLE001 — conn is done either way
+                    pass
+            state.broken = True
+            state.eof = True
+            state.wake()
+            self._conns.pop(conn, None)
+            self._engine.close_conn(conn)
         state.wake_room()
 
     def _stamp_inbound(

@@ -50,10 +50,10 @@ import sys
 import threading
 import time
 
-from .commands import ShardRouter, shard_of  # re-exported: the shard map
+from .commands import ShardMap, ShardRouter, shard_of  # re-exported: the shard map
 
-__all__ = ["ShardedServer", "ShardRouter", "shard_of", "sqlite_members",
-           "sqlite_placement"]
+__all__ = ["ShardedServer", "ShardMap", "ShardRouter", "shard_of",
+           "sqlite_members", "sqlite_placement"]
 
 _HAS_REUSEPORT = hasattr(socket, "SO_REUSEPORT")
 
@@ -169,6 +169,7 @@ class ShardedServer:
 
         self.procs: list[subprocess.Popen] = []
         self.worker_addresses: list[str] = []
+        self.map_epoch: int = 0
         self.front_address: str | None = None
         self._front_sock: socket.socket | None = None  # fd-fallback listener
         self._reservations: list[socket.socket] = []
@@ -220,6 +221,18 @@ class ShardedServer:
             ports.append(p)
         self.worker_addresses = [f"{adv_host}:{p}" for p in ports]
 
+        # Map epoch: a persisted per-data_dir counter bumped every start, so
+        # a supervisor restart (new worker ports, reseated slices) publishes
+        # a map shard-aware clients can tell apart from the one they adopted
+        # — the signal that drops their stale direct-dial state.
+        use_router = self.router and self.workers > 1
+        shard_map = ""
+        if use_router:
+            self.map_epoch = self._next_epoch()
+            shard_map = ShardMap(
+                epoch=self.map_epoch, slots=tuple(self.worker_addresses)
+            ).encode()
+
         env = self._child_env()
         for i in range(self.workers):
             spec = {
@@ -234,7 +247,8 @@ class ShardedServer:
                 "members": self.members_spec,
                 "placement": self.placement_spec,
                 "data_dir": self.data_dir,
-                "router": self.router and self.workers > 1,
+                "router": use_router,
+                "shard_map": shard_map,
                 "server_kwargs": self.server_kwargs,
             }
             log_f = open(os.path.join(self.data_dir, f"worker{i}.log"), "wb")
@@ -259,6 +273,19 @@ class ShardedServer:
             t.start()
             self._monitors.append(t)
         return self
+
+    def _next_epoch(self) -> int:
+        """Increment the persisted map epoch for this data_dir."""
+        path = os.path.join(self.data_dir, "shard_epoch")
+        try:
+            with open(path) as f:
+                epoch = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            epoch = 0
+        epoch += 1
+        with open(path, "w") as f:
+            f.write(str(epoch))
+        return epoch
 
     def _reserve_front(
         self, host: str, port: int
@@ -447,6 +474,11 @@ async def _run_worker(spec: dict) -> None:
                 self_address=spec["advertise"], slots=tuple(spec["slots"])
             )
         )
+    if spec.get("shard_map"):
+        # Publish the supervisor's map (epoch + slots) on every heartbeat
+        # row, so shard-aware clients can compute crc32 % N locally and
+        # dial this worker's identity address with zero redirects.
+        server.cluster_provider.set_shard_map(spec["shard_map"])
     await server.prepare()
     await server.bind()
 
@@ -486,7 +518,7 @@ async def _run_loadgen(spec: dict) -> dict:
     from .utils.routing_live import Echo, EchoActor
 
     members = _load_factory(spec["members"])(spec["data_dir"])
-    client = Client(members)
+    client = Client(members, shard_aware=bool(spec.get("shard_aware")))
     try:
         n_objects = spec.get("n_objects", 256)
         n_workers = spec.get("n_workers", 32)
@@ -514,6 +546,7 @@ async def _run_loadgen(spec: dict) -> dict:
             "total": total,
             "secs": dt,
             "redirects": client.stats.redirects,
+            "shard_routes": client.stats.shard_routes,
         }
     finally:
         client.close()
@@ -533,8 +566,14 @@ def _loadgen_main() -> int:
 # CLI
 # ----------------------------------------------------------------------
 
-def _smoke_main() -> int:
-    """2-worker loopback self-test (the CI tier-1 sharded smoke)."""
+def _smoke_main(shard_aware: bool = False) -> int:
+    """2-worker loopback self-test (the CI tier-1 sharded smoke).
+
+    With ``shard_aware`` the client adopts the published shard map and the
+    smoke additionally asserts the audit counters: every unplaced send was
+    direct-dialed (``shard_routes > 0``) and none paid a redirect hop
+    (``redirects == 0``).
+    """
     import tempfile
 
     async def drive(node: ShardedServer) -> dict:
@@ -545,7 +584,7 @@ def _smoke_main() -> int:
         await node.wait_ready(45.0)
         members = _load_factory(node.members_spec)(node.data_dir)
         placement = _load_factory(node.placement_spec)(node.data_dir)
-        client = Client(members)
+        client = Client(members, shard_aware=shard_aware)
         try:
             tname = type_id(EchoActor)
             n = 16
@@ -563,7 +602,13 @@ def _smoke_main() -> int:
                 ]
                 assert row == expect, (row, expect)
                 owners[row] = owners.get(row, 0) + 1
-            return {"ok": True, "n": n, "spread": owners}
+            result = {"ok": True, "n": n, "spread": owners}
+            if shard_aware:
+                assert client.stats.redirects == 0, client.stats
+                assert client.stats.shard_routes > 0, client.stats
+                result["redirects"] = client.stats.redirects
+                result["shard_routes"] = client.stats.shard_routes
+            return result
         finally:
             client.close()
             with contextlib.suppress(Exception):
@@ -636,7 +681,7 @@ def _main() -> int:
     if argv[:1] == ["--loadgen"]:
         return _loadgen_main()
     if argv[:1] == ["--smoke"]:
-        return _smoke_main()
+        return _smoke_main(shard_aware="--shard-aware" in argv[1:])
     return _supervise_main(argv)
 
 

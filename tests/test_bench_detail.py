@@ -359,6 +359,32 @@ def test_rpc_sharded_banks_to_cpu_sidecar_and_never_carries(tmp_path):
     assert "rpc_sharded" not in _read(tmp_path, "BENCH_DETAIL.tpu.json")
 
 
+def test_rpc_egress_banks_to_cpu_sidecar_and_never_carries(tmp_path):
+    """The egress-coalescing A/B is a host stage: banked with its paired
+    in-session numbers and host provenance, never carried into a later tpu
+    bank (absolute host rates drift ±30-40% between sessions; only the
+    paired off/on ratio under that run's box weather means anything)."""
+    stage = {
+        "asyncio": {
+            "per_frame": [17000.0, 17100.0],
+            "coalesced": [17900.0, 18000.0],
+            "coalesced_vs_per_frame": 1.05,
+        },
+        "sqlite_baseline_in_session": 40000,
+        "host": {"cpu_count": 1, "sched_affinity": [0], "loadavg": [0, 0, 0]},
+    }
+    _write_detail(
+        {"solve_tier": {"platform": "cpu"}, "rpc_egress": stage},
+        here=str(tmp_path),
+    )
+    banked = _read(tmp_path, "BENCH_DETAIL.cpu.json")
+    assert banked["rpc_egress"] == stage
+    # A later tpu run must not inherit it.
+    _write_detail({"solve_tier": {"platform": "tpu"}}, here=str(tmp_path))
+    tpu = _read(tmp_path, "BENCH_DETAIL.tpu.json")
+    assert "rpc_egress" not in tpu and "rpc_egress_carried" not in tpu
+
+
 def test_series_overhead_banks_to_cpu_sidecar_and_never_carries(tmp_path):
     """The gauge time-series A/B is a host stage: banked beside its own
     session's host provenance, never carried into a later tpu bank (the

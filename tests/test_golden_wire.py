@@ -69,6 +69,11 @@ async def _membership_matrix(storage, mark) -> None:
     await storage.push(Member(ip="10.0.0.1", port=5000, active=True))
     mark("membership.push_upsert")
     await storage.push(Member(ip="10.0.0.1", port=5000, active=True))
+    mark("membership.push_shard_map")
+    await storage.push(
+        Member(ip="10.0.0.2", port=5001, active=True,
+               shard_map="3|10.0.0.2:6000,10.0.0.2:6001")
+    )
     mark("membership.members")
     await storage.members()
     mark("membership.active_members")
@@ -206,6 +211,65 @@ async def test_redis_wire_golden(monkeypatch):
         for e in log
     ]
     _assert_golden("redis_wire.txt", "\n".join(lines) + "\n")
+
+
+def test_shard_map_membership_rows_golden():
+    """Pin the shard-map membership column's wire compatibility contract.
+
+    The shard map rides the membership row as an APPENDED column (PR 15),
+    exactly like the load vector before it: a legacy (map-less) row and a
+    shard-mapped row must both decode, legacy-length values written by old
+    nodes must parse with ``shard_map == ""``, and the redirect frames a
+    legacy (non-shard-aware) client follows must be byte-identical whether
+    or not the cluster advertises a map — shard awareness is purely a
+    client-side read of a column legacy decoders skip.
+    """
+    from rio_tpu.cluster.storage.redis import RedisMembershipStorage
+    from rio_tpu.commands import ShardMap
+    from rio_tpu.protocol import (
+        ResponseEnvelope,
+        ResponseError,
+        encode_response_frame,
+    )
+
+    enc = RedisMembershipStorage._encode
+    dec = RedisMembershipStorage._decode
+
+    legacy = Member(ip="10.0.0.1", port=5000, active=True,
+                    last_seen=FROZEN_TIME)
+    mapped = Member(ip="10.0.0.2", port=5001, active=True,
+                    last_seen=FROZEN_TIME,
+                    shard_map="3|10.0.0.2:6000,10.0.0.2:6001")
+
+    lines = [
+        f"== member.legacy\n{enc(legacy)}",
+        f"== member.shard_mapped\n{enc(mapped)}",
+        # Value a pre-shard-map node wrote (5 fields) and the pre-load
+        # 4-field ancestor: both must stay decodable forever.
+        "== member.legacy_5field\n10.0.0.1;5000;1;1700000000.0;",
+        "== member.legacy_4field\n10.0.0.1;5000;1;1700000000.0",
+    ]
+
+    redirect = encode_response_frame(
+        ResponseEnvelope.err(ResponseError.redirect("10.0.0.2:6001"))
+    )
+    lines.append(f"== redirect.frame ({len(redirect)} bytes)")
+    for off in range(0, len(redirect), 16):
+        lines.append(f"{off:04x}  {redirect[off : off + 16].hex(' ')}")
+    _assert_golden("shard_map_rows.txt", "\n".join(lines) + "\n")
+
+    # Decode symmetry + tolerant short-row parsing.
+    assert dec(enc(legacy).encode()) == legacy
+    assert dec(enc(mapped).encode()) == mapped
+    assert dec(b"10.0.0.1;5000;1;1700000000.0;").shard_map == ""
+    assert dec(b"10.0.0.1;5000;1;1700000000.0").shard_map == ""
+    # The advertised map round-trips through the row into a usable router.
+    m = ShardMap.decode(dec(enc(mapped).encode()).shard_map)
+    assert m is not None and m.epoch == 3 and len(m.slots) == 2
+    # Garbage in the column degrades to "no map", never an exception.
+    assert ShardMap.decode("") is None
+    assert ShardMap.decode("not-a-map") is None
+    assert ShardMap.decode("x|10.0.0.1:1") is None
 
 
 def test_dump_events_frame_golden():

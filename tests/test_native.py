@@ -179,6 +179,11 @@ class Publish:
     text: str = ""
 
 
+@message
+class Slow:
+    delay_ms: int = 0
+
+
 @wire_error
 class NativeUnanswerable(Exception):
     pass
@@ -196,6 +201,12 @@ class NativeOracle(ServiceObject):
             raise RuntimeError("boom")
         self.times += 1
         return Answer(text=f"echo:{msg.text}", times=self.times)
+
+    @handler
+    async def slow(self, msg: Slow, ctx: AppData) -> Answer:
+        await asyncio.sleep(msg.delay_ms / 1000.0)
+        self.times += 1
+        return Answer(text="slow", times=self.times)
 
     @handler
     async def publish(self, msg: Publish, ctx: AppData) -> Answer:
@@ -408,6 +419,74 @@ def test_native_client_subscription():
     asyncio.run(
         run_integration_test(
             body, registry_builder=build_registry, num_servers=2, transport="native"
+        )
+    )
+
+
+def test_coalesced_egress_buffer_parity():
+    """A coalesced egress wave — N complete length-prefixed response frames
+    joined into ONE buffer (what `_flush_ready` now hands the engine, and
+    what the engine's sendmsg gather puts on the socket) — must split back
+    into exactly the same frames as N separate writes, in both frame
+    readers. Coalescing may never be observable above the framing layer."""
+    frames = [
+        codec.frame(protocol.ResponseEnvelope.ok(b"r%d" % i).to_bytes())
+        for i in range(9)
+    ]
+    frames.append(
+        codec.frame(
+            protocol.ResponseEnvelope.err(
+                protocol.ResponseError.redirect("1.2.3.4:5")
+            ).to_bytes()
+        )
+    )
+    frames.append(lib.encode_response_ok_frame(b"x" * 70_000))
+    frames.append(codec.frame(b""))  # empty payload mid-wave
+    wave = b"".join(frames)
+    expect = [f[4:] for f in frames]
+    # Single joined feed.
+    assert native.NativeFrameReader(lib).feed(wave) == expect
+    assert codec.FrameReader().feed(wave) == expect
+    # Chunked feed (waves split mid-frame by the kernel) stays in parity.
+    for chunk in (1, 13, 1337):
+        nat, py = native.NativeFrameReader(lib), codec.FrameReader()
+        got_nat: list = []
+        got_py: list = []
+        for i in range(0, len(wave), chunk):
+            got_nat += nat.feed(wave[i : i + chunk])
+            got_py += py.feed(wave[i : i + chunk])
+        assert got_nat == got_py == expect
+
+
+@pytest.mark.parametrize("coalesce", [True, False])
+def test_native_pipelined_wave_coalesce_ab(coalesce, monkeypatch):
+    """Pipelined burst whose HEAD response finishes last: every later
+    response parks in resp_q, so the head's done-callback flushes the whole
+    wave at once — one joined engine.send when coalescing is on, N sends
+    when off. Client-visible behavior must be identical either way."""
+    from rio_tpu.native import transport as nt
+
+    monkeypatch.setattr(nt, "_EGRESS_COALESCE", coalesce)
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        # Warm placements so the burst pipelines on one pooled connection.
+        for i in range(16):
+            await client.send(NativeOracle, f"w{i}", Ask(text="warm"), returns=Answer)
+        outs = await asyncio.gather(
+            client.send(NativeOracle, "w0", Slow(delay_ms=150), returns=Answer),
+            *(
+                client.send(NativeOracle, f"w{i}", Ask(text=f"m{i}"), returns=Answer)
+                for i in range(1, 16)
+            ),
+        )
+        assert outs[0].text == "slow"
+        assert [o.text for o in outs[1:]] == [f"echo:m{i}" for i in range(1, 16)]
+        client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body, registry_builder=build_registry, num_servers=1, transport="native"
         )
     )
 

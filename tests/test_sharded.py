@@ -25,6 +25,7 @@ from rio_tpu import (
     Member,
     ObjectId,
     Server,
+    ShardMap,
     ShardRouter,
     shard_of,
 )
@@ -81,15 +82,18 @@ def test_shard_router_owner_follows_the_map():
 # ----------------------------------------------------------------------
 
 
-async def _boot_router_servers(addrs, slots, members, placement):
+async def _boot_router_servers(addrs, slots, members, placement, advertise_map=""):
     """Boot one echo server per address with a ShardRouter installed."""
     servers, tasks = [], []
     try:
         for addr in addrs:
+            provider = LocalClusterProvider(members)
+            if advertise_map:
+                provider.set_shard_map(advertise_map)
             s = Server(
                 address=addr,
                 registry=build_echo_registry(),
-                cluster_provider=LocalClusterProvider(members),
+                cluster_provider=provider,
                 object_placement_provider=placement,
             )
             # Before bind(): the Service snapshot of app_data happens there.
@@ -171,6 +175,131 @@ def test_router_seam_degrades_when_preferred_owner_is_dead():
             await asyncio.gather(*tasks, return_exceptions=True)
 
     asyncio.run(drive())
+
+
+# ----------------------------------------------------------------------
+# In-process: shard-aware clients (PR 15)
+# ----------------------------------------------------------------------
+
+
+def test_shard_aware_client_direct_dials_with_zero_redirects():
+    """A shard-aware client adopts the map from the membership view and
+    computes crc32 % N locally: every unplaced send dials the owning
+    worker's identity address directly — zero redirects, and the directory
+    rows land exactly where the server-side router would seat them."""
+
+    async def drive():
+        addrs = [f"127.0.0.1:{p}" for p in _free_ports(2)]
+        members, placement = LocalStorage(), LocalObjectPlacement()
+        encoded = ShardMap(epoch=1, slots=tuple(addrs)).encode()
+        tasks = await _boot_router_servers(
+            addrs, addrs, members, placement, advertise_map=encoded
+        )
+        client = Client(members, shard_aware=True)
+        try:
+            tname = type_id(EchoActor)
+            for i in range(24):
+                out = await client.send(EchoActor, f"sa-{i}", Echo(value=i), returns=Echo)
+                assert out.value == i
+            assert client.stats.redirects == 0
+            assert client.stats.shard_routes == 24
+            assert client._shard_map is not None and client._shard_map.epoch == 1
+            for i in range(24):
+                row = await placement.lookup(ObjectId(tname, f"sa-{i}"))
+                assert row == addrs[shard_of(tname, f"sa-{i}", 2)], (i, row)
+        finally:
+            client.close()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    asyncio.run(drive())
+
+
+def test_shard_aware_client_dead_owner_falls_back_to_redirect_follow():
+    """A map slot that is not an active member must not black-hole its
+    slice client-side either: the direct dial is skipped and the send
+    degrades to the reference random-pick + redirect-follow path (the
+    mirror of the server router's dead-owner lazy self-assign)."""
+
+    async def drive():
+        addrs = [f"127.0.0.1:{p}" for p in _free_ports(1)]
+        slots = (addrs[0], "127.0.0.1:1")  # slot 1 is nobody
+        members, placement = LocalStorage(), LocalObjectPlacement()
+        encoded = ShardMap(epoch=1, slots=slots).encode()
+        tasks = await _boot_router_servers(
+            addrs, slots, members, placement, advertise_map=encoded
+        )
+        client = Client(members, shard_aware=True)
+        try:
+            tname = type_id(EchoActor)
+            dead_oid = next(
+                f"d-{i}" for i in range(100) if shard_of(tname, f"d-{i}", 2) == 1
+            )
+            out = await client.send(EchoActor, dead_oid, Echo(value=9), returns=Echo)
+            assert out.value == 9
+            assert await placement.lookup(ObjectId(tname, dead_oid)) == addrs[0]
+            # The dead owner was never direct-dialed (it is not in the
+            # active view), so the attempt cost zero dial failures.
+            assert client.stats.dial_failures == 0
+        finally:
+            client.close()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    asyncio.run(drive())
+
+
+def test_shard_aware_epoch_change_invalidates_client_caches():
+    """Map-epoch change drops everything the client derived under the old
+    map (placement cache, seat hints); an unchanged map re-adopted from a
+    refresh clears nothing; the highest epoch wins across mixed rows."""
+    client = Client(LocalStorage(), shard_aware=True)
+    try:
+        row = lambda mp: Member(ip="10.0.0.1", port=5000, active=True,  # noqa: E731
+                                shard_map=mp)
+        m1 = row(ShardMap(epoch=1, slots=("a:1", "b:2")).encode())
+        client._adopt_shard_map([m1])
+        assert client._shard_map is not None and client._shard_map.epoch == 1
+        client._placement.put(("T", "x"), "a:1")
+        client._read_seats.put(("T", "x"), (["s:1"], 0.0))
+        # Same map seen again (every refresh re-reads it): caches survive.
+        client._adopt_shard_map([m1, row("")])
+        assert client._placement.get(("T", "x")) == "a:1"
+        # Epoch bump (worker died, slice reseated, supervisor restarted):
+        # stale derived state goes, the new map is adopted — highest epoch
+        # wins even when old rows are still mixed into the view.
+        m2 = row(ShardMap(epoch=2, slots=("a:1", "c:3")).encode())
+        client._adopt_shard_map([m1, m2])
+        assert client._shard_map.epoch == 2
+        assert client._shard_map.slots == ("a:1", "c:3")
+        assert client._placement.get(("T", "x")) is None
+        assert client._read_seats.get(("T", "x")) is None
+    finally:
+        client.close()
+
+
+def test_shard_map_epoch_bumps_per_start(tmp_path):
+    """The supervisor persists the map epoch in its data_dir: every start()
+    advertises a HIGHER epoch than the previous incarnation, so clients
+    holding the old map drop their caches instead of direct-dialing a
+    reseated slice."""
+    node = ShardedServer(
+        address="127.0.0.1:0",
+        workers=2,
+        registry=COUNTER_REGISTRY,
+        data_dir=str(tmp_path),
+    )
+    assert node._next_epoch() == 1
+    assert node._next_epoch() == 2
+    other = ShardedServer(
+        address="127.0.0.1:0",
+        workers=2,
+        registry=COUNTER_REGISTRY,
+        data_dir=str(tmp_path),
+    )
+    assert other._next_epoch() == 3  # survives across supervisor objects
 
 
 # ----------------------------------------------------------------------
@@ -399,6 +528,72 @@ def test_sharded_worker_death_reseats_slice_on_survivor(tmp_path):
             assert await placement.lookup(ObjectId(tname, "victim")) == survivor
             out = await client.send(ShardCounter, "victim", Bump(amount=2), returns=Val)
             assert (out.address, out.value) == (survivor, 2)
+        finally:
+            client.close()
+            members.close()
+            placement.close()
+
+    _drive_sharded(node, drive)
+
+
+def test_sharded_worker_death_shard_aware_client_falls_back(tmp_path):
+    """PR 15 regression: a shard-aware client holding the adopted map must
+    NOT keep direct-dialing a SIGKILLed worker's slice. The corpse drops
+    out of the active view, so the direct dial is skipped; the send
+    degrades to redirect-follow, reseats on the survivor, and subsequent
+    traffic converges — while the healthy worker's slice keeps
+    direct-dialing with zero redirects throughout."""
+    node = ShardedServer(
+        address="127.0.0.1:0",
+        workers=2,
+        registry=COUNTER_REGISTRY,
+        data_dir=str(tmp_path),
+    )
+
+    async def drive():
+        await node.wait_ready(60.0)
+        members = sqlite_members(node.data_dir)
+        placement = sqlite_placement(node.data_dir)
+        client = Client(members, shard_aware=True, membership_view_ttl=0.2)
+        try:
+            tname = type_id(ShardCounter)
+            # Warm pass: every unplaced send direct-dials its slice owner.
+            for i in range(8):
+                out = await client.send(ShardCounter, f"sk-{i}", Bump(amount=1), returns=Val)
+                assert out.address == node.worker_addresses[shard_of(tname, f"sk-{i}", 2)]
+            assert client.stats.redirects == 0
+            assert client.stats.shard_routes >= 8
+            assert client._shard_map is not None
+            assert tuple(client._shard_map.slots) == tuple(node.worker_addresses)
+
+            out = await client.send(ShardCounter, "victim", Bump(amount=5), returns=Val)
+            assert out.value == 5
+            seat = out.address
+            node.terminate_worker(node.worker_addresses.index(seat))
+            loop = asyncio.get_event_loop()
+            deadline = loop.time() + 30.0
+            while loop.time() < deadline:
+                if not await members.is_active(seat):
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise TimeoutError("dead worker never marked inactive")
+
+            # Stale-map hazard: "victim" (and the dead worker's whole
+            # slice) must reseat on the survivor, not be direct-dialed
+            # into the corpse off the old map.
+            survivor = next(a for a in node.worker_addresses if a != seat)
+            out = await client.send(ShardCounter, "victim", Get(), returns=Val)
+            assert (out.address, out.value) == (survivor, 0)
+            assert await placement.lookup(ObjectId(tname, "victim")) == survivor
+            # Fresh unplaced traffic hashing to the dead slot also lands.
+            dead_idx = node.worker_addresses.index(seat)
+            fresh = next(
+                f"fr-{i}" for i in range(100)
+                if shard_of(tname, f"fr-{i}", 2) == dead_idx
+            )
+            out = await client.send(ShardCounter, fresh, Bump(amount=3), returns=Val)
+            assert (out.address, out.value) == (survivor, 3)
         finally:
             client.close()
             members.close()
