@@ -1989,6 +1989,35 @@ def faults_overhead() -> dict:
     return out
 
 
+def streams_throughput() -> dict:
+    """Durable-stream data-path rates, A/B'd in the SAME session: the
+    redelivery backstop idle (no reminders — delivery rides the publish
+    wake alone) vs ticking at 0.05 s per partition (40x the shipping 2 s
+    cadence). Acked-publish rate is the producer-facing durability cost;
+    the end-to-end rate covers publish → delivered-then-committed; the
+    median paired ratio prices the at-least-once backstop. Both modes
+    must deliver every acked publish (zero-loss rides along)."""
+    import asyncio
+
+    from rio_tpu.utils.streams_live import measure_streams_overhead
+
+    out = asyncio.run(measure_streams_overhead())
+    out["host"] = _host_provenance()
+    pub, e2e = out["publish_acks_per_sec"], out["deliver_msgs_per_sec"]
+    print(
+        f"# streams throughput ({out['batches']} interleaved batches x "
+        f"{out['publishes_per_batch']} publishes, 2 servers/mode, "
+        f"{out['partitions_active']['on']} partitions, median paired "
+        f"ratio): publish acks off {pub['off']:,.0f}/s, on "
+        f"{pub['on']:,.0f}/s; e2e deliver off {e2e['off']:,.0f}/s, on "
+        f"{e2e['on']:,.0f}/s ({out['redelivery_overhead_pct']:+}% "
+        f"redelivery backstop); zero loss both modes "
+        f"({out['delivered']['on']} delivered)",
+        file=sys.stderr,
+    )
+    return out
+
+
 def series_overhead() -> dict:
     """RPC-loop cost of gauge time-series sampling + HealthWatch, A/B'd in
     the SAME session: servers with timeseries=False vs sampling at an
@@ -2417,6 +2446,10 @@ def main() -> None:
     except Exception as e:
         print(f"# faults overhead failed: {e!r}", file=sys.stderr)
     try:
+        detail["streams"] = streams_throughput()
+    except Exception as e:
+        print(f"# streams throughput failed: {e!r}", file=sys.stderr)
+    try:
         detail["scaled_routing"] = scaled_route_hops()
     except Exception as e:
         print(f"# scaled routing failed: {e!r}", file=sys.stderr)
@@ -2586,6 +2619,10 @@ if __name__ == "__main__":
     # Run the fault-injection disabled-overhead A/B alone and bank it into
     # the cpu sidecar (same CPU-safe in-process-cluster shape as --series).
     parser.add_argument("--faults", action="store_true")
+    # Run the durable-streams publish/deliver + redelivery-backstop A/B
+    # alone and bank it into the cpu sidecar (in-process clusters over
+    # LocalStreamStorage; CPU-safe).
+    parser.add_argument("--streams", action="store_true")
     args = parser.parse_args()
     if args.migration:
         _pin_orchestrator_to_cpu()
@@ -2683,6 +2720,23 @@ if __name__ == "__main__":
         except (OSError, ValueError):
             detail = {}
         detail["faults"] = out
+        _write_detail(detail, here)
+        print(json.dumps(out))
+    elif args.streams:
+        # Standalone --streams updates the banked cpu sidecar in place (the
+        # --faults pattern): the A/B carries its own paired baseline, so
+        # it can refresh independently of the other host stages.
+        _pin_orchestrator_to_cpu()
+        out = streams_throughput()
+        here = os.path.dirname(os.path.abspath(__file__))
+        try:
+            with open(os.path.join(here, "BENCH_DETAIL.cpu.json")) as fh:
+                detail = json.load(fh)
+            if not isinstance(detail, dict):
+                detail = {}
+        except (OSError, ValueError):
+            detail = {}
+        detail["streams"] = out
         _write_detail(detail, here)
         print(json.dumps(out))
     elif args.delta:

@@ -337,6 +337,42 @@ uint8_t* rn_encode_subscribe_frame(const uint8_t* ht, uint32_t htl,
   return finish_frame(w, out_len);
 }
 
+// Frame payload = 0x02 kind byte + msgpack [command, subject, payload]
+// (protocol.py encode_command_frame — control-plane stream/saga commands).
+uint8_t* rn_encode_command_frame(const uint8_t* cmd, uint32_t cmdl,
+                                 const uint8_t* subj, uint32_t subjl,
+                                 const uint8_t* pay, uint32_t pl,
+                                 uint32_t* out_len) {
+  Writer w;
+  w.u8(0x02);
+  w.fixarray(3);
+  w.str(cmd, cmdl);
+  w.str(subj, subjl);
+  w.bin(pay, pl);
+  return finish_frame(w, out_len);
+}
+
+// Traced variant: 0x02 + msgpack [command, subject, payload,
+// [trace_id, span_id, sampled]] — same appended-field rule as requests.
+uint8_t* rn_encode_command_frame_traced(const uint8_t* cmd, uint32_t cmdl,
+                                        const uint8_t* subj, uint32_t subjl,
+                                        const uint8_t* pay, uint32_t pl,
+                                        const uint8_t* tid, uint32_t tidl,
+                                        const uint8_t* sid, uint32_t sidl,
+                                        int32_t sampled, uint32_t* out_len) {
+  Writer w;
+  w.u8(0x02);
+  w.fixarray(4);
+  w.str(cmd, cmdl);
+  w.str(subj, subjl);
+  w.bin(pay, pl);
+  w.fixarray(3);
+  w.str(tid, tidl);
+  w.str(sid, sidl);
+  w.boolean(sampled != 0);
+  return finish_frame(w, out_len);
+}
+
 // ResponseEnvelope ok arm: [true, body].
 uint8_t* rn_encode_response_ok_frame(const uint8_t* body, uint32_t blen,
                                      uint32_t* out_len) {
@@ -394,6 +430,8 @@ uint8_t* rn_encode_subresponse_err_frame(uint32_t kind, const uint8_t* detail,
 // message_type, payload; a 5-element frame additionally fills [4] =
 // trace_id, [5] = span_id and sets *sampled to 0/1 — *sampled stays -1 on
 // the legacy 4-element layout), 1 = subscribe (offs/lens[0..1]),
+// 2 = command (offs/lens[0..2] = command, subject, payload; a 4-element
+// frame fills the trace triple into [4]/[5]/*sampled like requests),
 // -1 = malformed. offs/lens must hold 6 slots.
 int rn_decode_inbound(const uint8_t* buf, uint32_t len, uint32_t* offs,
                       uint32_t* lens, int32_t* sampled) {
@@ -421,6 +459,21 @@ int rn_decode_inbound(const uint8_t* buf, uint32_t len, uint32_t* offs,
     for (int i = 0; i < 2; ++i)
       if (!pr.str_or_bin(&offs[i], &lens[i])) return -1;
     return 1;
+  }
+  if (kind == 0x02) {
+    int n = pr.array_header();
+    if (n != 3 && n != 4) return -1;
+    for (int i = 0; i < 3; ++i)
+      if (!pr.str_or_bin(&offs[i], &lens[i])) return -1;
+    if (n == 4) {
+      if (pr.array_header() != 3) return -1;
+      if (!pr.str_or_bin(&offs[4], &lens[4])) return -1;
+      if (!pr.str_or_bin(&offs[5], &lens[5])) return -1;
+      bool s;
+      if (!pr.boolean(&s)) return -1;
+      *sampled = s ? 1 : 0;
+    }
+    return 2;
   }
   return -1;
 }

@@ -36,11 +36,13 @@ from .errors import Disconnect, SerializationError
 from .message_router import MessageRouter
 from .spans import Phases, finish_request
 from .protocol import (
+    CommandEnvelope,
     RequestEnvelope,
     ResponseEnvelope,
     ResponseError,
     SubscriptionRequest,
     SubscriptionResponse,
+    UnknownFrameKind,
     decode_inbound,
     encode_response_frame,
     encode_subresponse_frame,
@@ -72,12 +74,24 @@ class _BadFrame:
 
     The error response must leave in arrival order with everything else on
     the connection, so the failure rides the same queue as decoded inbounds.
+    ``not_supported`` distinguishes a frame kind this server doesn't speak
+    (a newer client's command against an old server — answered
+    NOT_SUPPORTED so the peer can downgrade) from a corrupt frame
+    (answered UNKNOWN).
     """
 
-    __slots__ = ("detail",)
+    __slots__ = ("detail", "not_supported")
 
-    def __init__(self, detail: str) -> None:
+    def __init__(self, detail: str, *, not_supported: bool = False) -> None:
         self.detail = detail
+        self.not_supported = not_supported
+
+    def response(self) -> ResponseEnvelope:
+        if self.not_supported:
+            return ResponseEnvelope.err(ResponseError.not_supported(self.detail))
+        return ResponseEnvelope.err(
+            ResponseError.unknown(f"bad frame: {self.detail}")
+        )
 
 
 def _stamp_handler_end(task) -> None:
@@ -194,6 +208,8 @@ class ServerConnProtocol(asyncio.Protocol):
                     for p in payloads:
                         try:
                             append(decode_inbound(p))
+                        except UnknownFrameKind as e:
+                            append(_BadFrame(str(e), not_supported=True))
                         except Exception as e:  # noqa: BLE001 — malformed frame
                             append(_BadFrame(str(e)))
                 else:
@@ -203,6 +219,9 @@ class ServerConnProtocol(asyncio.Protocol):
                     for p in payloads:
                         try:
                             env = decode_inbound(p)
+                        except UnknownFrameKind as e:
+                            append(_BadFrame(str(e), not_supported=True))
+                            continue
                         except Exception as e:  # noqa: BLE001 — malformed frame
                             append(_BadFrame(str(e)))
                             continue
@@ -410,6 +429,8 @@ class ServerConnProtocol(asyncio.Protocol):
                     t_recv = _perf() if self._spans is not None else 0.0
                     try:
                         inbound = decode_inbound(inbound)
+                    except UnknownFrameKind as e:
+                        inbound = _BadFrame(str(e), not_supported=True)
                     except Exception as e:  # malformed frame → error response
                         inbound = _BadFrame(str(e))
                     else:
@@ -417,12 +438,19 @@ class ServerConnProtocol(asyncio.Protocol):
                             self._stamp_inbound(inbound, t_recv)
                 if type(inbound) is _BadFrame:
                     fut: asyncio.Future = loop.create_future()
-                    fut.set_result(
-                        ResponseEnvelope.err(
-                            ResponseError.unknown(f"bad frame: {inbound.detail}")
-                        )
-                    )
+                    fut.set_result(inbound.response())
                     self._push_response(fut)
+                    continue
+                if type(inbound) is CommandEnvelope:
+                    # Control-plane command: rides the ordinary response
+                    # FIFO (commands are infrequent — no inline fast path,
+                    # no phase stamping).
+                    while len(self._resp_q) >= self.MAX_CONCURRENT and not self._eof:
+                        self._room = loop.create_future()
+                        await self._room
+                    self._push_response(
+                        loop.create_task(service.call_command(inbound))
+                    )
                     continue
                 if type(inbound) is RequestEnvelope:
                     ph = (

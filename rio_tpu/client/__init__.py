@@ -29,11 +29,13 @@ from ..errors import (
     ServerNotAvailable,
 )
 from ..protocol import (
+    CommandEnvelope,
     ErrorKind,
     RequestEnvelope,
     SubscriptionRequest,
     decode_response,
     decode_subresponse,
+    encode_command_frame,
     encode_request_frame,
     encode_subscribe_frame,
 )
@@ -701,6 +703,176 @@ class Client:
         tname = handler_type if isinstance(handler_type, str) else type_id(handler_type)
         raw = await self.send_raw(tname, handler_id, type_id(type(msg)), codec.serialize(msg))
         return codec.deserialize(raw, returns)
+
+    # -- control-plane commands (streams/sagas, KIND_COMMAND frames) ---------
+
+    async def send_command(
+        self, command: str, subject: str, payload: bytes = b""
+    ) -> bytes:
+        """One control-plane command against any cluster member.
+
+        Saga commands route like requests to the coordinator actor
+        (placement cache + redirect-follow); stream commands are legal on
+        any member (the append log has no owner) and just cache whichever
+        address answered. An old server that predates KIND_COMMAND answers
+        NOT_SUPPORTED — surfaced as :class:`ClientError` with that prefix,
+        never a connection reset.
+        """
+        ctx = outbound_ctx()
+        if ctx is None and head_sampled():
+            from .. import tracing
+
+            if tracing._ENABLED:
+                with span("client_command", object=command, id=subject):
+                    return await self._command_attempts(
+                        command, subject, payload, outbound_ctx()
+                    )
+            ctx = (new_trace_id(), new_span_id(), True)
+        return await self._command_attempts(command, subject, payload, ctx)
+
+    async def _command_attempts(
+        self,
+        command: str,
+        subject: str,
+        payload: bytes,
+        trace_ctx: tuple[str, str, bool] | None,
+    ) -> bytes:
+        frame_bytes = encode_command_frame(
+            CommandEnvelope(command, subject, payload, trace_ctx)
+        )
+        # Saga commands share the coordinator's real placement key so the
+        # cache and redirects line up with ordinary requests to it; stream
+        # commands key on a synthetic type that no server ever redirects.
+        if command.startswith("saga."):
+            key = ("rio.Saga", subject)
+        else:
+            key = ("rio.stream.cmd", subject)
+        self.stats.requests += 1
+        last: BaseException | None = None
+        attempts = 0
+        avoid: set[str] = set()
+        jitter: DecorrelatedJitter | None = None
+        for delay in self._backoff.delays():
+            attempts += 1
+            address = None
+            try:
+                address = await self._pick_address(key[0], key[1], avoid)
+                pool = self._pool(address)
+                conn = await pool.acquire()
+                seen = conn.delivered
+                try:
+                    raw = await conn.roundtrip(frame_bytes)
+                except asyncio.CancelledError:
+                    pool.release(conn, reuse=conn.delivered > seen)
+                    raise
+                except BaseException:
+                    pool.release(conn, reuse=False)
+                    raise
+                pool.release(conn, reuse=True)
+                self.stats.roundtrips += 1
+            except (ServerNotAvailable, Disconnect, OSError) as e:
+                last = e
+                if address is not None:
+                    self.stats.dial_failures += 1
+                    avoid.add(address)
+                self._placement.pop(key)
+                self._invalidate(None)
+                await asyncio.sleep(delay)
+                continue
+            resp = decode_response(raw)
+            if resp.is_ok:
+                self._placement.put(key, address)
+                return resp.body or b""
+            err = resp.error
+            assert err is not None
+            if err.kind == ErrorKind.REDIRECT:
+                self.stats.redirects += 1
+                avoid.discard(err.detail)
+                self._placement.put(key, err.detail)
+                continue
+            if err.kind == ErrorKind.SERVER_BUSY:
+                last = ServerBusy(address or "", err.detail)
+                self.stats.busy_retries += 1
+                if address is not None:
+                    avoid.add(address)
+                self._placement.pop(key)
+                if jitter is None:
+                    jitter = DecorrelatedJitter(
+                        base=self._backoff.initial, cap=self._backoff.cap
+                    )
+                await asyncio.sleep(jitter.next())
+                continue
+            if err.kind in (ErrorKind.DEALLOCATE, ErrorKind.ALLOCATE):
+                last = ClientError(f"{err.kind.name}: {err.detail}")
+                self._placement.pop(key)
+                self._invalidate(address)
+                await asyncio.sleep(delay)
+                continue
+            if err.kind == ErrorKind.APPLICATION:
+                raise decode_error(err.payload, err.detail)
+            raise ClientError(f"{err.kind.name}: {err.detail}")
+        raise RetryExhausted(attempts, last)
+
+    async def publish_stream(
+        self, stream: str, message: Any, *, key: str = ""
+    ) -> tuple[int, int]:
+        """Durably publish ``message``; returns the acked
+        ``(partition, offset)`` — the remote face of
+        :func:`rio_tpu.streams.cursor.publish`."""
+        payload = codec.serialize(
+            [stream, key, type_id(type(message)), codec.serialize(message)]
+        )
+        raw = await self.send_command("stream.publish", stream, payload)
+        partition, offset = codec.deserialize(raw, Any)
+        return int(partition), int(offset)
+
+    async def subscribe_stream(
+        self,
+        stream: str,
+        group: str,
+        target_type: str | type,
+        *,
+        redelivery_period: float = 2.0,
+    ) -> None:
+        """Attach a consumer group remotely (see
+        :func:`rio_tpu.streams.cursor.subscribe_group`)."""
+        tname = target_type if isinstance(target_type, str) else type_id(target_type)
+        await self.send_command(
+            "stream.subscribe",
+            stream,
+            codec.serialize([group, tname, float(redelivery_period)]),
+        )
+
+    async def unsubscribe_stream(self, stream: str, group: str) -> None:
+        await self.send_command(
+            "stream.unsubscribe", stream, codec.serialize([group])
+        )
+
+    async def stream_cursors(self, stream: str, group: str) -> dict[int, int]:
+        """Committed offset per partition (consumer-lag probe)."""
+        raw = await self.send_command(
+            "stream.cursors", stream, codec.serialize([group])
+        )
+        return {int(p): int(o) for p, o in codec.deserialize(raw, Any)}
+
+    async def start_saga(self, saga_id: str, steps: list) -> Any:
+        """Start (or idempotently re-observe) a saga; returns its
+        :class:`~rio_tpu.streams.saga.SagaStatusReply`. Build ``steps``
+        with :func:`rio_tpu.streams.saga.step`."""
+        from ..streams.saga import SagaStatusReply, StartSaga
+
+        raw = await self.send_command(
+            "saga.start", saga_id, codec.serialize(StartSaga(steps=steps))
+        )
+        return codec.deserialize(raw, SagaStatusReply)
+
+    async def saga_status(self, saga_id: str) -> Any:
+        from ..streams.saga import SagaStatus, SagaStatusReply
+
+        raw = await self.send_command(
+            "saga.status", saga_id, codec.serialize(SagaStatus())
+        )
+        return codec.deserialize(raw, SagaStatusReply)
 
     # -- pub/sub (reference client/mod.rs:341-401) ---------------------------
 

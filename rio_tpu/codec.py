@@ -256,14 +256,17 @@ def _compile_dc_decoder(ty: type):
         ns[f"_h{i}"] = hint
         return [f"{v} = _fw(w[{i}], _h{i})"]
 
-    # Trailing fields with plain (non-factory) defaults may be absent on the
+    # Trailing fields with defaults (plain OR factory) may be absent on the
     # wire — the appended-field evolution rule. Handling that HERE keeps a
     # legacy short frame on the compiled fast path: falling back to the
     # generic walker for every old-format message would tax exactly the
     # mixed-version windows where decode throughput matters.
     total = len(schema)
     required = total
-    while required > 0 and flds[required - 1].default is not dataclasses.MISSING:
+    while required > 0 and (
+        flds[required - 1].default is not dataclasses.MISSING
+        or flds[required - 1].default_factory is not dataclasses.MISSING
+    ):
         required -= 1
     lines = ["def _dec(w):", "    n = len(w)"]
     if required == total:
@@ -278,11 +281,18 @@ def _compile_dc_decoder(ty: type):
         if i < required:
             lines.extend("    " + ln for ln in body)
         else:
-            ns[f"_d{i}"] = flds[i].default
             lines.append(f"    if n > {i}:")
             lines.extend("        " + ln for ln in body)
             lines.append("    else:")
-            lines.append(f"        v{i} = _d{i}")
+            if flds[i].default is not dataclasses.MISSING:
+                ns[f"_d{i}"] = flds[i].default
+                lines.append(f"        v{i} = _d{i}")
+            else:
+                # default_factory field: a fresh instance per decode (the
+                # dataclass __init__ semantics — sharing one would alias
+                # mutable state across messages).
+                ns[f"_d{i}"] = flds[i].default_factory
+                lines.append(f"        v{i} = _d{i}()")
     lines.append(f"    return _ty({', '.join(args)})")
     exec("\n".join(lines), ns)  # noqa: S102 — trusted, schema-derived source
     return ns["_dec"]
@@ -369,6 +379,22 @@ def _key_from_json(key: str, ty: Any) -> Any:
     return key
 
 
+def _untyped_from_json(wire: Any) -> Any:
+    """Recursive Any-typed decode: restore ``__bytes__`` sentinels at any
+    depth (lists of rows, nested dicts) — the inverse of ``_to_json`` when
+    no schema narrows the shape."""
+    if isinstance(wire, dict):
+        if set(wire) == {"__bytes__"}:
+            try:
+                return bytes.fromhex(wire["__bytes__"])
+            except (TypeError, ValueError):
+                return wire
+        return {k: _untyped_from_json(v) for k, v in wire.items()}
+    if isinstance(wire, list):
+        return [_untyped_from_json(v) for v in wire]
+    return wire
+
+
 def _from_json(wire: Any, ty: Any) -> Any:
     # The bytes sentinel is only honored where the schema expects bytes (or
     # is untyped): a declared dict field can legitimately contain that key.
@@ -379,11 +405,20 @@ def _from_json(wire: Any, ty: Any) -> Any:
             except (TypeError, ValueError) as e:
                 raise SerializationError(f"bad __bytes__ payload: {e}") from e
         raise SerializationError("expected bytes sentinel")
-    if ty is Any and isinstance(wire, dict) and set(wire) == {"__bytes__"}:
-        try:
-            return bytes.fromhex(wire["__bytes__"])
-        except (TypeError, ValueError):
-            return wire
+    if ty is Any:
+        # Untyped: walk containers so NESTED sentinels decode too — a bare
+        # ``list`` field holding rows with bytes elements (saga steps) must
+        # round-trip through the JSON state providers intact.
+        return _untyped_from_json(wire)
+    if ty in (list, tuple, set, frozenset):
+        # Bare container annotation == container-of-Any.
+        if not isinstance(wire, list):
+            raise SerializationError(f"expected array for {ty}")
+        return ty(_untyped_from_json(v) for v in wire)
+    if ty is dict:
+        if not isinstance(wire, dict):
+            raise SerializationError(f"expected object for {ty}")
+        return {k: _untyped_from_json(v) for k, v in wire.items()}
     if get_origin(ty) is typing.Union or isinstance(ty, types.UnionType):
         args = get_args(ty)
         if wire is None and _NONE_TYPE in args:

@@ -8,7 +8,8 @@ two seams, so chaos coverage is *scripted and replayable* instead of
 ad-hoc per-test process kills:
 
 * **Storage faults** — :class:`FaultyMembershipStorage`,
-  :class:`FaultyObjectPlacement` and :class:`FaultyReminderStorage` wrap
+  :class:`FaultyObjectPlacement`, :class:`FaultyReminderStorage` and
+  :class:`FaultyStreamStorage` wrap
   any concrete backend and consult one :class:`FaultSchedule` before every
   delegated call: seeded error rates, added latency, park-until-heal
   hangs, and scripted total outages (``fail_all()`` / ``heal()`` or
@@ -524,6 +525,7 @@ from .cluster.storage import Member, MembershipStorage  # noqa: E402
 from .object_placement import ObjectPlacement, ObjectPlacementItem  # noqa: E402
 from .registry import ObjectId  # noqa: E402
 from .reminders import Lease, Reminder, ReminderStorage  # noqa: E402
+from .streams import StreamRecord, StreamStorage, Subscription  # noqa: E402
 
 
 class FaultyMembershipStorage(_FaultyBase, MembershipStorage):
@@ -670,6 +672,64 @@ class FaultyReminderStorage(_FaultyBase, ReminderStorage):
 
     async def get_lease(self, shard: int) -> Lease | None:
         return await self._call("reminders.get_lease", self._inner.get_lease, shard)
+
+
+class FaultyStreamStorage(_FaultyBase, StreamStorage):
+    """``StreamStorage`` with a :class:`FaultSchedule` at every call.
+
+    The interesting chaos surface for streams is the *durability seam*:
+    an ``append`` that fails BEFORE the ack means the publisher retries
+    (no loss); a ``commit`` that fails leaves the cursor behind, so the
+    redelivery backstop re-reads — at-least-once, never lost-acked.
+    """
+
+    def __init__(self, inner: Any, schedule: FaultSchedule, health: StorageHealth | None = None) -> None:
+        super().__init__(inner, schedule, health)
+        self.num_partitions = inner.num_partitions
+
+    async def prepare(self) -> None:
+        return await self._call("streams.prepare", self._inner.prepare)
+
+    async def append(self, record: StreamRecord) -> int:
+        return await self._call("streams.append", self._inner.append, record)
+
+    async def read(
+        self, stream: str, partition: int, from_offset: int, limit: int = 256
+    ) -> list[StreamRecord]:
+        return await self._call(
+            "streams.read", self._inner.read, stream, partition, from_offset, limit
+        )
+
+    async def latest(self, stream: str, partition: int) -> int:
+        return await self._call("streams.latest", self._inner.latest, stream, partition)
+
+    async def subscribe(self, sub: Subscription) -> None:
+        return await self._call("streams.subscribe", self._inner.subscribe, sub)
+
+    async def unsubscribe(self, stream: str, group: str) -> None:
+        return await self._call(
+            "streams.unsubscribe", self._inner.unsubscribe, stream, group
+        )
+
+    async def subscriptions(self, stream: str) -> list[Subscription]:
+        return await self._call(
+            "streams.subscriptions", self._inner.subscriptions, stream
+        )
+
+    async def commit(
+        self, stream: str, group: str, partition: int, offset: int
+    ) -> None:
+        return await self._call(
+            "streams.commit", self._inner.commit, stream, group, partition, offset
+        )
+
+    async def committed(self, stream: str, group: str, partition: int) -> int:
+        return await self._call(
+            "streams.committed", self._inner.committed, stream, group, partition
+        )
+
+    async def cursors(self, stream: str, group: str) -> dict[int, int]:
+        return await self._call("streams.cursors", self._inner.cursors, stream, group)
 
 
 # ---------------------------------------------------------------------------

@@ -80,6 +80,9 @@ HEALTH = "health"  # HealthWatch trend rule fired (degradation alarm)
 STORAGE = "storage"  # rendezvous storage degraded / recovered (outage story)
 FAULT = "fault"  # fault-injection schedule transition (scripted outage edges)
 
+STREAM = "stream"  # durable-stream transition (publish/deliver/commit edges)
+SAGA = "saga"  # saga step/compensation transition (workflow story)
+
 EVENT_KINDS: tuple[str, ...] = (
     MEMBER_UP,
     MEMBER_DOWN,
@@ -107,6 +110,8 @@ EVENT_KINDS: tuple[str, ...] = (
     HEALTH,
     STORAGE,
     FAULT,
+    STREAM,
+    SAGA,
 )
 
 

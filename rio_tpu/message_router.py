@@ -22,9 +22,10 @@ class _Broadcast:
     """Single-producer multi-consumer ring: each subscriber gets its own
     bounded queue; slow subscribers drop oldest (broadcast-lag semantics)."""
 
-    def __init__(self, capacity: int) -> None:
+    def __init__(self, capacity: int, router: "MessageRouter | None" = None) -> None:
         self.capacity = capacity
         self.queues: set[asyncio.Queue[SubscriptionResponse]] = set()
+        self._router = router
 
     def subscribe(self) -> asyncio.Queue[SubscriptionResponse]:
         q: asyncio.Queue[SubscriptionResponse] = asyncio.Queue(self.capacity)
@@ -39,6 +40,14 @@ class _Broadcast:
             if q.full():
                 try:
                     q.get_nowait()  # lagging subscriber loses oldest message
+                    # Overflow is survivable (broadcast-lag semantics) but
+                    # must be OBSERVABLE: the return value still counts this
+                    # subscriber as a receiver, so without the counter a
+                    # durable-stream fan-in loses items with no trace
+                    # anywhere (rio.router.dropped gauge + journal-free —
+                    # this is the data path).
+                    if self._router is not None:
+                        self._router.dropped += 1
                 except asyncio.QueueEmpty:
                     pass
             q.put_nowait(item)
@@ -51,9 +60,20 @@ class MessageRouter:
     def __init__(self, capacity: int = DEFAULT_CAPACITY) -> None:
         self._channels: dict[tuple[str, str], _Broadcast] = {}
         self._capacity = capacity
+        #: Items silently displaced from full subscriber queues since boot
+        #: (process-wide; surfaced as the ``rio.router.dropped`` gauge).
+        self.dropped = 0
+
+    def gauges(self) -> dict[str, float]:
+        return {
+            "rio.router.dropped": float(self.dropped),
+            "rio.router.channels": float(len(self._channels)),
+        }
 
     def _channel(self, type_name: str, object_id: str) -> _Broadcast:
-        return self._channels.setdefault((type_name, object_id), _Broadcast(self._capacity))
+        return self._channels.setdefault(
+            (type_name, object_id), _Broadcast(self._capacity, self)
+        )
 
     def create_subscription(self, type_name: str, object_id: str) -> asyncio.Queue:
         """Reference ``message_router.rs:25-35``."""

@@ -108,6 +108,22 @@ class NativeLib:
         )
         dll.rn_encode_request_frame_traced.restype = _U8P
 
+        try:
+            # Command frames (KIND_COMMAND, streams/sagas PR): absent from
+            # env-pinned prebuilt libraries, which then report
+            # has_command=False and callers stay on the Python codec.
+            dll.rn_encode_command_frame.argtypes = (
+                [ctypes.c_char_p, _U32] * 3 + [_U32P]
+            )
+            dll.rn_encode_command_frame.restype = _U8P
+            dll.rn_encode_command_frame_traced.argtypes = (
+                [ctypes.c_char_p, _U32] * 5 + [ctypes.c_int32, _U32P]
+            )
+            dll.rn_encode_command_frame_traced.restype = _U8P
+            self.has_command = True
+        except AttributeError:
+            self.has_command = False
+
         dll.rn_decode_inbound.argtypes = [
             ctypes.c_char_p, _U32, _U32P, _U32P, ctypes.POINTER(ctypes.c_int32),
         ]
@@ -194,6 +210,29 @@ class NativeLib:
             raise SerializationError("rn_encode_request_frame_traced: frame too large")
         return self._take(ptr, n.value)
 
+    def encode_command_frame(self, cmd: bytes, subject: bytes, payload: bytes) -> bytes:
+        n = _U32(0)
+        ptr = self._dll.rn_encode_command_frame(
+            cmd, len(cmd), subject, len(subject), payload, len(payload), ctypes.byref(n)
+        )
+        if not ptr:
+            raise SerializationError("rn_encode_command_frame: frame too large")
+        return self._take(ptr, n.value)
+
+    def encode_command_frame_traced(
+        self, cmd: bytes, subject: bytes, payload: bytes,
+        trace_id: bytes, span_id: bytes, sampled: bool,
+    ) -> bytes:
+        n = _U32(0)
+        ptr = self._dll.rn_encode_command_frame_traced(
+            cmd, len(cmd), subject, len(subject), payload, len(payload),
+            trace_id, len(trace_id), span_id, len(span_id),
+            1 if sampled else 0, ctypes.byref(n),
+        )
+        if not ptr:
+            raise SerializationError("rn_encode_command_frame_traced: frame too large")
+        return self._take(ptr, n.value)
+
     def encode_subscribe_frame(self, ht: bytes, hid: bytes) -> bytes:
         n = _U32(0)
         ptr = self._dll.rn_encode_subscribe_frame(ht, len(ht), hid, len(hid), ctypes.byref(n))
@@ -237,7 +276,8 @@ class NativeLib:
 
     def decode_inbound(self, payload: bytes):
         """Returns ``(0, ht, hid, mt, body)`` (traced frames append
-        ``tid, sid, sampled``) | ``(1, ht, hid)`` | None."""
+        ``tid, sid, sampled``) | ``(1, ht, hid)`` |
+        ``(2, cmd, subject, body[, tid, sid, sampled])`` | None."""
         offs = (_U32 * 6)()
         lens = (_U32 * 6)()
         sampled = ctypes.c_int32(-1)
@@ -246,9 +286,9 @@ class NativeLib:
         )
         if rc < 0:
             return None
-        n_fields = 4 if rc == 0 else 2
+        n_fields = 4 if rc == 0 else 3 if rc == 2 else 2
         spans = [payload[offs[i] : offs[i] + lens[i]] for i in range(n_fields)]
-        if rc == 0 and sampled.value >= 0:
+        if rc in (0, 2) and sampled.value >= 0:
             spans.extend(
                 (
                     payload[offs[4] : offs[4] + lens[4]],

@@ -26,10 +26,12 @@ from typing import TYPE_CHECKING, Callable
 from ..message_router import MessageRouter
 from ..spans import Phases, finish_request
 from ..protocol import (
+    CommandEnvelope,
     RequestEnvelope,
     ResponseEnvelope,
     ResponseError,
     SubscriptionRequest,
+    UnknownFrameKind,
     decode_inbound,
     encode_response_frame,
     encode_subresponse_frame,
@@ -606,12 +608,32 @@ class NativeServerTransport:
                     t_recv = 0.0
                 try:
                     inbound = decode_inbound(payload)
-                except Exception as e:  # malformed frame → error response
+                except UnknownFrameKind as e:
+                    # A frame kind this server doesn't speak (newer client):
+                    # clean NOT_SUPPORTED, connection survives.
                     fut: asyncio.Future = loop.create_future()
+                    fut.set_result(
+                        ResponseEnvelope.err(ResponseError.not_supported(str(e)))
+                    )
+                    self._push_response(conn, state, fut)
+                    continue
+                except Exception as e:  # malformed frame → error response
+                    fut = loop.create_future()
                     fut.set_result(
                         ResponseEnvelope.err(ResponseError.unknown(f"bad frame: {e}"))
                     )
                     self._push_response(conn, state, fut)
+                    continue
+                if type(inbound) is CommandEnvelope:
+                    # Control-plane command: ordinary response FIFO, no
+                    # inline fast path or phase stamping (commands are
+                    # infrequent) — mirrors rio_tpu.aio.
+                    while len(state.resp_q) >= _MAX_CONCURRENT and not state.eof:
+                        state.room = loop.create_future()
+                        await state.room
+                    self._push_response(
+                        conn, state, loop.create_task(service.call_command(inbound))
+                    )
                     continue
                 ph = None
                 if t_recv and type(inbound) is RequestEnvelope:

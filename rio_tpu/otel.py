@@ -123,6 +123,16 @@ def server_gauges(server: Any) -> dict[str, float]:
         # Rendezvous-storage outage ledger (rio.storage.*): error/degraded
         # counters shared by the service layer, gossip loop, and daemons.
         gauges.update(storage.gauges())
+    app_data = getattr(server, "app_data", None)
+    if app_data is not None:
+        from .message_router import MessageRouter
+
+        router = app_data.try_get(MessageRouter)
+        if router is not None:
+            # Pub/sub fan-out counters (rio.router.*): dropped counts items
+            # displaced from full subscriber queues — durable-stream fan-in
+            # loss that the publish return value alone cannot show.
+            gauges.update(router.gauges())
     provider = getattr(server, "cluster_provider", None)
     gossip_stats = getattr(provider, "stats", None)
     if gossip_stats is not None:

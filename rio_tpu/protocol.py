@@ -62,6 +62,38 @@ class RequestEnvelope:
         return codec.deserialize(data, cls)
 
 
+@dataclass
+class CommandEnvelope:
+    """One control-plane command crossing the wire (streams/sagas, PR-16).
+
+    Unlike :class:`RequestEnvelope`, a command is addressed to the *server*
+    (``command`` names the verb, ``subject`` scopes it — a stream name, a
+    saga id), not to a seated object; the server decides which actor or
+    subsystem services it. Commands ride a distinct frame kind
+    (:data:`KIND_COMMAND`) so an old server rejects them with a clean
+    NOT_SUPPORTED response instead of a garbled request decode.
+    """
+
+    command: str
+    subject: str
+    payload: bytes
+    # Same appended-field evolution rule as RequestEnvelope: ``None`` is
+    # omitted from the wire so untraced frames stay 3-element.
+    trace_ctx: tuple[str, str, bool] | None = None
+
+    def to_bytes(self) -> bytes:
+        tc = self.trace_ctx
+        if tc is None:
+            return codec.serialize([self.command, self.subject, self.payload])
+        return codec.serialize(
+            [self.command, self.subject, self.payload, [tc[0], tc[1], tc[2]]]
+        )
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "CommandEnvelope":
+        return codec.deserialize(data, cls)
+
+
 class ErrorKind(IntEnum):
     """Wire tags for ``ResponseError`` variants."""
 
@@ -220,6 +252,17 @@ class SubscriptionResponse:
 
 KIND_REQUEST = b"\x00"
 KIND_SUBSCRIBE = b"\x01"
+KIND_COMMAND = b"\x02"
+
+
+class UnknownFrameKind(SerializationError):
+    """An inbound frame whose 1-byte kind prefix this server does not speak.
+
+    Distinct from a generic decode failure so transports can answer
+    NOT_SUPPORTED (a *protocol* gap — the client may downgrade or report
+    cleanly) rather than UNKNOWN (a corrupt frame). The connection survives
+    either way; the FIFO response contract keeps the stream aligned.
+    """
 
 # These helpers are deliberately pure Python.  The C++ codec
 # (``rio_tpu.native``) produces byte-identical frames (parity-locked by
@@ -235,6 +278,10 @@ def encode_request_frame(env: RequestEnvelope) -> bytes:
 
 def encode_subscribe_frame(req: SubscriptionRequest) -> bytes:
     return codec.frame(KIND_SUBSCRIBE + req.to_bytes())
+
+
+def encode_command_frame(env: CommandEnvelope) -> bytes:
+    return codec.frame(KIND_COMMAND + env.to_bytes())
 
 
 def encode_response_frame(resp: ResponseEnvelope) -> bytes:
@@ -257,7 +304,7 @@ def decode_subresponse(payload: bytes) -> SubscriptionResponse:
     return SubscriptionResponse.from_bytes(payload)
 
 
-def decode_inbound(payload: bytes) -> RequestEnvelope | SubscriptionRequest:
+def decode_inbound(payload: bytes) -> RequestEnvelope | SubscriptionRequest | CommandEnvelope:
     """Decode one inbound frame payload on the server side."""
     if not payload:
         raise SerializationError("empty frame")
@@ -266,4 +313,6 @@ def decode_inbound(payload: bytes) -> RequestEnvelope | SubscriptionRequest:
         return RequestEnvelope.from_bytes(body)
     if kind == KIND_SUBSCRIBE:
         return SubscriptionRequest.from_bytes(body)
-    raise SerializationError(f"unknown frame kind {kind!r}")
+    if kind == KIND_COMMAND:
+        return CommandEnvelope.from_bytes(body)
+    raise UnknownFrameKind(f"unknown frame kind {kind!r}")

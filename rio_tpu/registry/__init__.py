@@ -131,12 +131,14 @@ class Registry:
             # (rio_tpu/replication).
             self._replicated.add(tname)
         for spec in resolve_handlers(cls):
-            # Lifecycle dispatch (activation Load) and reminder wakeups are
-            # framework plumbing and must exist regardless of the declared
-            # message surface.
+            # Lifecycle dispatch (activation Load), reminder wakeups, and
+            # stream/saga step delivery are framework plumbing and must
+            # exist regardless of the declared message surface.
             if auto_handlers or spec.message_type_name in (
                 "rio.LifecycleMessage",
                 "rio.ReminderFired",
+                "rio.StreamDelivery",
+                "rio.SagaStep",
             ):
                 self._handlers[(tname, spec.message_type_name)] = spec
                 if spec.readonly:

@@ -312,6 +312,10 @@ class Server:
 
         if ReminderStorage in self.app_data:
             await self.app_data.get(ReminderStorage).prepare()
+        from .streams import StreamStorage
+
+        if StreamStorage in self.app_data:
+            await self.app_data.get(StreamStorage).prepare()
 
     def _resolve_transport(self) -> str:
         if self.transport == "auto":
@@ -438,6 +442,19 @@ class Server:
                 )
             )
             self.registry.add_type(AdminControl)
+        from .streams import StreamStorage
+
+        if StreamStorage in self.app_data:
+            # Durable-streams control plane: the live-tail anchor, the
+            # consumer-group cursors, and the saga coordinator are ordinary
+            # placement-seated actors — registered only when the node has a
+            # stream log to serve.
+            from .streams.cursor import StreamCursor, StreamTap
+            from .streams.saga import SagaCoordinator
+
+            self.registry.add_type(StreamTap)
+            self.registry.add_type(StreamCursor)
+            self.registry.add_type(SagaCoordinator)
         if self.replication_manager is None and self.replication_config is not None:
             # Rides the MigrationInbox registered above — no extra actor.
             from .replication import ReplicationManager

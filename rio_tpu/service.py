@@ -29,6 +29,7 @@ from .journal import ADMIT_SHED, PLACE_ASSIGN, PLACE_RELEASE, STORAGE, Journal
 from .message_router import MessageRouter
 from .object_placement import ObjectPlacement, ObjectPlacementItem
 from .protocol import (
+    CommandEnvelope,
     ErrorKind,
     RequestEnvelope,
     ResponseEnvelope,
@@ -397,6 +398,100 @@ class Service:
                         self._load.request_finished()
         finally:
             release(token)
+
+    async def call_command(self, env: CommandEnvelope) -> ResponseEnvelope:
+        """One control-plane command (KIND_COMMAND frame) end-to-end.
+
+        Saga commands are sugar over the ordinary request path (the
+        coordinator is a seated actor — placement, redirects, and tracing
+        all apply unchanged). Stream commands talk to the node-wide
+        ``StreamStorage`` directly: a publish is legal on ANY member (the
+        append log has no owner), which is what lets remote producers
+        publish without learning the cluster's seating first.
+        """
+        from typing import Any as _Any
+
+        from . import codec
+        from .streams import StreamStorage
+
+        cmd = env.command
+        if cmd == "saga.start" or cmd == "saga.status":
+            mt = "rio.StartSaga" if cmd == "saga.start" else "rio.SagaStatus"
+            return await self.call(
+                RequestEnvelope("rio.Saga", env.subject, mt, env.payload, env.trace_ctx)
+            )
+        if cmd.startswith("stream.") and self.app_data.try_get(StreamStorage) is None:
+            return ResponseEnvelope.err(
+                ResponseError.not_supported(
+                    f"command {cmd!r} needs a StreamStorage backend"
+                )
+            )
+        if cmd == "stream.publish":
+            from .streams.cursor import publish_raw
+
+            try:
+                stream_key_mt_body = codec.deserialize(env.payload, _Any)
+                stream, key, message_type, body = stream_key_mt_body
+            except Exception as e:  # noqa: BLE001 — malformed payload
+                return ResponseEnvelope.err(
+                    ResponseError.unknown(f"bad stream.publish payload: {e}")
+                )
+            token = adopt(env.trace_ctx)
+            try:
+                partition, offset = await publish_raw(
+                    self.app_data, env.subject or stream, key, message_type, body
+                )
+            except Exception as e:  # noqa: BLE001 — backend failure
+                log.exception("stream.publish failed")
+                return ResponseEnvelope.err(
+                    ResponseError.unknown(f"publish failed: {e}")
+                )
+            finally:
+                release(token)
+            return ResponseEnvelope.ok(codec.serialize([partition, offset]))
+        if cmd == "stream.subscribe":
+            from .streams.cursor import subscribe_group
+
+            try:
+                group, target_type, period = codec.deserialize(env.payload, _Any)
+                await subscribe_group(
+                    self.app_data,
+                    env.subject,
+                    group,
+                    target_type,
+                    redelivery_period=float(period),
+                )
+            except Exception as e:  # noqa: BLE001 — malformed payload/backend
+                return ResponseEnvelope.err(
+                    ResponseError.unknown(f"stream.subscribe failed: {e}")
+                )
+            return ResponseEnvelope.ok(b"")
+        if cmd == "stream.unsubscribe":
+            from .streams.cursor import unsubscribe_group
+
+            try:
+                (group,) = codec.deserialize(env.payload, _Any)
+                await unsubscribe_group(self.app_data, env.subject, group)
+            except Exception as e:  # noqa: BLE001 — malformed payload/backend
+                return ResponseEnvelope.err(
+                    ResponseError.unknown(f"stream.unsubscribe failed: {e}")
+                )
+            return ResponseEnvelope.ok(b"")
+        if cmd == "stream.cursors":
+            storage = self.app_data.get(StreamStorage)
+            try:
+                (group,) = codec.deserialize(env.payload, _Any)
+                cursors = await storage.cursors(env.subject, group)
+            except Exception as e:  # noqa: BLE001 — malformed payload/backend
+                return ResponseEnvelope.err(
+                    ResponseError.unknown(f"stream.cursors failed: {e}")
+                )
+            return ResponseEnvelope.ok(
+                codec.serialize(sorted(cursors.items()))
+            )
+        return ResponseEnvelope.err(
+            ResponseError.not_supported(f"unknown command {cmd!r}")
+        )
 
     async def _route(
         self, req: RequestEnvelope, object_id: ObjectId

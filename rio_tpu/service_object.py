@@ -23,6 +23,7 @@ from .commands import AdminCommand, AdminSender, InternalClientSender
 from .errors import ServiceObjectLifeCycleError
 from .protocol import ErrorKind, ResponseEnvelope
 from .registry import decode_error, handler, message, type_id
+from .streams import SagaStep, StreamDelivery
 
 T = TypeVar("T")
 
@@ -159,6 +160,34 @@ class ServiceObject:
         """Blanket reminder handler: every service object can be woken by
         the reminder daemon; subclasses override :meth:`receive_reminder`."""
         await self.receive_reminder(msg, ctx)
+
+    @handler
+    async def _handle_stream_delivery(self, msg: StreamDelivery, ctx: AppData) -> Any:
+        """Blanket stream-delivery handler: consumer-group cursors deliver
+        records as ordinary requests (like ``rio.ReminderFired``);
+        subclasses override :meth:`receive_stream`. A clean return acks
+        the record; any raise leaves it undelivered (redelivered later)."""
+        return await self.receive_stream(msg, ctx)
+
+    async def receive_stream(self, delivery: "StreamDelivery", ctx: AppData) -> Any:  # noqa: ARG002
+        """Called for each stream record delivered to this actor (override
+        me). ``delivery.decode()`` yields the application message;
+        ``delivery.attempt > 1`` marks a redelivery (dedup hint)."""
+        log.debug(
+            "%s/%s: unhandled stream delivery %s@%d",
+            type_id(type(self)), self.id, delivery.stream, delivery.offset,
+        )
+        return None
+
+    @handler
+    async def _handle_saga_step(self, msg: SagaStep, ctx: AppData) -> Any:
+        """Blanket saga-step handler: any actor can participate in a saga.
+        Dispatches the carried message to this object's own handler with a
+        persisted dedup ledger (see :func:`rio_tpu.streams.saga.
+        apply_saga_step`) so re-sent steps apply exactly once."""
+        from .streams.saga import apply_saga_step
+
+        return await apply_saga_step(self, msg, ctx)
 
     async def receive_reminder(self, fired: ReminderFired, ctx: AppData) -> None:  # noqa: ARG002
         """Called on each durable-reminder tick (override me).

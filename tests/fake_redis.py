@@ -8,7 +8,7 @@ Redis backends under test use their production code path end to end
 (``rio_tpu/utils/resp.py`` over a socket).
 
 Supported commands: PING SELECT SET (incl. NX) GET DEL EXISTS INCR HSET
-HGET HGETALL HDEL RPUSH LTRIM LRANGE SADD SREM SMEMBERS ZADD ZREM ZCARD
+HGET HGETALL HDEL RPUSH LLEN LTRIM LRANGE SADD SREM SMEMBERS ZADD ZREM ZCARD
 ZRANGEBYSCORE (incl. LIMIT) FLUSHDB KEYS, plus the optimistic-locking
 transaction surface WATCH UNWATCH MULTI EXEC DISCARD. Watch semantics are
 version-based: every write command bumps a per-key version regardless of
@@ -259,6 +259,8 @@ class FakeRedisServer:
             start = max(0, start if start >= 0 else len(lst) + start)
             d[args[0]] = lst[start:stop]
             return _enc("OK")
+        if name == "LLEN":
+            return _enc(len(d.get(args[0], [])))
         if name == "LRANGE":
             lst = d.get(args[0], [])
             start, stop = int(args[1]), int(args[2])

@@ -408,6 +408,43 @@ def test_series_overhead_banks_to_cpu_sidecar_and_never_carries(tmp_path):
     assert "series" not in tpu and "series_carried" not in tpu
 
 
+def test_streams_banks_to_cpu_sidecar_and_never_carries(tmp_path):
+    """The durable-streams publish/deliver A/B is a host stage: banked
+    beside its own session's host provenance, never carried into a later
+    tpu bank (absolute host rates drift ±30-40% between sessions; only
+    the paired backstop-off/on ratio under that run's box weather means
+    anything)."""
+    stage = {
+        "publish_acks_per_sec": {"off": 1960.0, "on": 1978.0},
+        "deliver_msgs_per_sec": {"off": 1903.0, "on": 1454.0},
+        "redelivery_overhead_pct": 26.05,
+        "delivered": {"off": 1248, "on": 1248},
+        "host": {"cpu_count": 1, "sched_affinity": [0], "loadavg": [0, 0, 0]},
+    }
+    _write_detail(
+        {"solve_tier": {"platform": "cpu"}, "streams": stage},
+        here=str(tmp_path),
+    )
+    banked = _read(tmp_path, "BENCH_DETAIL.cpu.json")
+    assert banked["streams"] == stage
+    # A later tpu run must not inherit it.
+    _write_detail({"solve_tier": {"platform": "tpu"}}, here=str(tmp_path))
+    tpu = _read(tmp_path, "BENCH_DETAIL.tpu.json")
+    assert "streams" not in tpu and "streams_carried" not in tpu
+
+
+def test_committed_cpu_capture_banks_streams_with_provenance():
+    """The repo's banked cpu sidecar carries the measured streams A/B:
+    both modes delivered every acked publish (zero loss on disk), and
+    the stage is stamped with the host conditions it ran under."""
+    committed = Path(__file__).resolve().parent.parent / "BENCH_DETAIL.cpu.json"
+    streams = json.loads(committed.read_text())["streams"]
+    assert set(streams["publish_acks_per_sec"]) == {"off", "on"}
+    assert set(streams["deliver_msgs_per_sec"]) == {"off", "on"}
+    assert streams["delivered"]["off"] == streams["delivered"]["on"] > 0
+    assert set(streams["host"]) == {"cpu_count", "sched_affinity", "loadavg"}
+
+
 def test_committed_cpu_capture_banks_series_with_provenance():
     """The repo's banked cpu sidecar carries the measured series A/B — the
     ISSUE's ≤1% bar is evidence on disk, stamped with host conditions."""

@@ -272,3 +272,32 @@ def test_compiled_decoder_factory_defaults_use_generic_fallback():
     assert out1 == WithFactory(7) and out2 == WithFactory(8)
     out1.items.append(1)
     assert out2.items == []  # no shared mutable default
+
+
+def test_json_bytes_survive_inside_untyped_containers():
+    """Regression: ``__bytes__`` sentinels nested inside a bare ``list``
+    (or ``dict``/``Any``) field came back as marker dicts, not bytes —
+    persisted saga step rows loaded through a JSON state provider then
+    fed ``bytes({'__bytes__': ...})`` downstream. Untyped decode must
+    restore the sentinel at ANY depth."""
+
+    @dataclass
+    class Rec:
+        rows: list = field(default_factory=list)
+        extra: dict = field(default_factory=dict)
+        blob: Any = None
+
+    rec = Rec(
+        rows=[["Gate", "g1", b"\x91\xa4hold", ["deep", b"\x00\xff"]]],
+        extra={"k": b"\x01\x02", "nest": {"x": b"\x03"}},
+        blob=[{"b": b"\x04"}],
+    )
+    out = codec.deserialize_json(codec.serialize_json(rec), Rec)
+    assert out.rows == rec.rows
+    assert out.extra == rec.extra
+    assert out.blob == rec.blob
+    # A dict that merely CONTAINS a __bytes__ key alongside others is data,
+    # not a sentinel.
+    odd = Rec(extra={"m": {"__bytes__": "zz-not-hex"}})
+    back = codec.deserialize_json(codec.serialize_json(odd), Rec)
+    assert back.extra == {"m": {"__bytes__": "zz-not-hex"}}

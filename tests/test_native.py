@@ -132,6 +132,37 @@ def test_decode_inbound_parity():
     assert back == env
 
 
+def test_command_frame_parity():
+    """KIND_COMMAND (streams/sagas control plane) byte parity, both
+    arities, plus the rc=2 decode shape mirroring requests."""
+    if not lib.has_command:
+        pytest.skip("prebuilt native lib predates command frames")
+    env = protocol.CommandEnvelope("stream.publish", "orders", b"\x01\x02pay")
+    assert protocol.encode_command_frame(env) == lib.encode_command_frame(
+        b"stream.publish", b"orders", b"\x01\x02pay"
+    )
+    tid, sid = "e5" * 16, "f6" * 8
+    for sampled in (True, False):
+        traced = protocol.CommandEnvelope(
+            "saga.start", "order-1", b"pp", (tid, sid, sampled)
+        )
+        assert protocol.encode_command_frame(traced) == lib.encode_command_frame_traced(
+            b"saga.start", b"order-1", b"pp", tid.encode(), sid.encode(), sampled
+        )
+    # Decode: untraced 4-tuple, traced 7-tuple (trace triple appended,
+    # symmetric with the request shapes).
+    framed = protocol.encode_command_frame(env)
+    assert lib.decode_inbound(framed[4:]) == (2, b"stream.publish", b"orders", b"\x01\x02pay")
+    traced = protocol.CommandEnvelope("saga.start", "order-1", b"pp", (tid, sid, True))
+    tframed = protocol.encode_command_frame(traced)
+    assert lib.decode_inbound(tframed[4:]) == (
+        2, b"saga.start", b"order-1", b"pp", tid.encode(), sid.encode(), True,
+    )
+    # Python typed decode agrees with both.
+    back = protocol.decode_inbound(tframed[4:])
+    assert type(back) is protocol.CommandEnvelope and back == traced
+
+
 def test_native_frame_reader_parity():
     frames_in = [
         protocol.encode_request_frame(protocol.RequestEnvelope("A", "b", "C", b"d")),

@@ -218,3 +218,37 @@ def test_router_channels_do_not_leak():
     assert router.close_subscriptions("T", "b", error=None) == 1
     assert len(router._channels) == 0
     assert q3.qsize() == 1  # the final error item was delivered
+
+
+def test_router_overflow_is_observable():
+    """Regression: a full subscriber queue silently displaced the oldest
+    item — ``publish`` still counted the laggard as a receiver, so a
+    durable-stream fan-in lost messages with no trace anywhere. Overflow
+    stays survivable (broadcast-lag semantics) but must surface through
+    the ``rio.router.dropped`` gauge."""
+    router = MessageRouter(capacity=2)
+    q = router.create_subscription("T", "a")
+    fast = router.create_subscription("T", "a")
+
+    for seq in range(2):
+        assert router.publish("T", "a", Event(seq=seq)) == 2
+    assert router.dropped == 0
+
+    # Drain only the fast subscriber; the laggard's queue is now full.
+    while not fast.empty():
+        fast.get_nowait()
+    assert router.publish("T", "a", Event(seq=2)) == 2  # still "delivered"
+    assert router.dropped == 1  # ...but the displacement is visible
+    assert router.publish("T", "a", Event(seq=3)) == 2
+    assert router.dropped == 2
+    assert fast.qsize() == 2  # the healthy subscriber lost nothing
+
+    # Oldest-first displacement: the laggard kept the newest two.
+    import rio_tpu.codec as _codec
+    kept = [
+        _codec.deserialize(q.get_nowait().body, Event).seq for _ in range(2)
+    ]
+    assert kept == [2, 3]
+
+    # The gauge rides the standard surface the collector scrapes.
+    assert router.gauges()["rio.router.dropped"] == 2.0

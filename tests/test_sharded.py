@@ -324,8 +324,10 @@ async def _raw_roundtrip(host, port, frame_bytes):
 
 @pytest.mark.parametrize("batch", [True, False])
 def test_inbound_decode_bad_frame_keeps_order_and_connection(batch):
-    """Garbage frame → in-order UNKNOWN error response; the connection and
-    the requests behind it keep working — on BOTH decode paths (the
+    """Garbage frame → in-order NOT_SUPPORTED error response (the
+    unknown-frame-kind compat contract: a newer client's command frame
+    must degrade cleanly, see MIGRATING.md); the connection and the
+    requests behind it keep working — on BOTH decode paths (the
     batch-decode fast path and the legacy per-frame fallback)."""
     from rio_tpu import aio
 
@@ -353,8 +355,8 @@ def test_inbound_decode_bad_frame_keeps_order_and_connection(batch):
                     frames.append(await asyncio.wait_for(reader.readexactly(n), 10))
                 bad = ResponseEnvelope.from_bytes(frames[0])
                 assert bad.error is not None
-                assert bad.error.kind == ErrorKind.UNKNOWN
-                assert bad.error.detail.startswith("bad frame:")
+                assert bad.error.kind == ErrorKind.NOT_SUPPORTED
+                assert "unknown frame kind" in bad.error.detail
                 ok = ResponseEnvelope.from_bytes(frames[1])
                 assert ok.is_ok
                 assert codec.deserialize(ok.body, Echo).value == 3

@@ -1,0 +1,340 @@
+"""KIND_COMMAND wire layer: golden frames, old-server compat, live commands.
+
+Three contracts:
+
+* **golden frames** — the exact bytes every stream/saga command puts on
+  the wire, committed under ``tests/golden/`` (regenerate intentionally
+  with ``RIO_TPU_REGEN_GOLDEN=1``). A drift here is a wire break for
+  mixed-version clusters and has to be a conscious decision.
+* **old-server story** — a frame kind the server doesn't speak (or, on a
+  pre-streams server, a command it can't service) answers a clean
+  NOT_SUPPORTED response; the connection survives and later requests on
+  it still work. No resets, ever.
+* **live commands** — the remote producer/consumer/saga APIs
+  (``Client.publish_stream`` & co.) against a real cluster.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import difflib
+import os
+import pathlib
+from collections import defaultdict
+
+import pytest
+
+from rio_tpu import AppData, Registry, ServiceObject, codec, handler, message
+from rio_tpu.errors import ClientError
+from rio_tpu.protocol import (
+    ErrorKind,
+    RequestEnvelope,
+    UnknownFrameKind,
+    decode_inbound,
+    decode_response,
+    encode_command_frame,
+    encode_request_frame,
+    CommandEnvelope,
+)
+from rio_tpu.state import LocalState, StateProvider
+from rio_tpu.streams import LocalStreamStorage, StreamDelivery, StreamStorage
+from rio_tpu.streams.saga import SAGA_TYPE, SagaStatus, StartSaga, step
+
+from .server_utils import Cluster, run_integration_test
+
+GOLDEN_DIR = pathlib.Path(__file__).parent / "golden"
+
+
+# ---------------------------------------------------------------------------
+# golden frames
+# ---------------------------------------------------------------------------
+
+
+@message
+class Note:
+    text: str = ""
+
+
+def _command_matrix() -> list[tuple[str, bytes]]:
+    """Every new wire command, with deterministic payloads."""
+    note = codec.serialize(Note(text="hi"))
+    trace = ("ab" * 16, "cd" * 8, True)
+    saga_steps = [step("Account", "a", Note(text="go"), Note(text="undo"))]
+    matrix = [
+        (
+            "stream.publish",
+            CommandEnvelope(
+                "stream.publish",
+                "orders",
+                codec.serialize(["orders", "k1", "Note", note]),
+            ),
+        ),
+        (
+            "stream.publish traced",
+            CommandEnvelope(
+                "stream.publish",
+                "orders",
+                codec.serialize(["orders", "k1", "Note", note]),
+                trace,
+            ),
+        ),
+        (
+            "stream.subscribe",
+            CommandEnvelope(
+                "stream.subscribe", "orders", codec.serialize(["g1", "Sink", 2.0])
+            ),
+        ),
+        (
+            "stream.unsubscribe",
+            CommandEnvelope("stream.unsubscribe", "orders", codec.serialize(["g1"])),
+        ),
+        (
+            "stream.cursors",
+            CommandEnvelope("stream.cursors", "orders", codec.serialize(["g1"])),
+        ),
+        (
+            "saga.start",
+            CommandEnvelope(
+                "saga.start",
+                "order-1",
+                codec.serialize(StartSaga(steps=saga_steps)),
+            ),
+        ),
+        (
+            "saga.status",
+            CommandEnvelope("saga.status", "order-1", codec.serialize(SagaStatus())),
+        ),
+    ]
+    return [(name, encode_command_frame(env)) for name, env in matrix]
+
+
+def test_command_frames_golden():
+    lines = [f"{name}: {frame.hex()}" for name, frame in _command_matrix()]
+    text = "\n".join(lines) + "\n"
+    path = GOLDEN_DIR / "command_frames.txt"
+    if os.environ.get("RIO_TPU_REGEN_GOLDEN"):
+        path.write_text(text)
+        return
+    assert path.exists(), (
+        f"missing golden file {path} — run with RIO_TPU_REGEN_GOLDEN=1 to create"
+    )
+    expected = path.read_text()
+    if text != expected:
+        diff = "\n".join(
+            difflib.unified_diff(
+                expected.splitlines(), text.splitlines(),
+                fromfile="golden/command_frames.txt", tofile="captured",
+                lineterm="",
+            )
+        )
+        raise AssertionError(f"command wire drifted:\n{diff}")
+
+
+def test_command_envelope_roundtrip():
+    for _, frame in _command_matrix():
+        env = decode_inbound(frame[4:])
+        assert type(env) is CommandEnvelope
+        assert encode_command_frame(env) == frame
+    # Untraced frames omit the trace field entirely (3-element layout),
+    # byte-identical to a legacy encoder that never heard of tracing.
+    untraced = CommandEnvelope("stream.cursors", "s", b"")
+    assert untraced.to_bytes() == codec.serialize(["stream.cursors", "s", b""])
+
+
+def test_unknown_frame_kind_is_typed():
+    with pytest.raises(UnknownFrameKind):
+        decode_inbound(b"\x7fjunk")
+    # Empty / malformed frames stay generic SerializationError — only a
+    # recognizably-framed-but-unknown kind takes the NOT_SUPPORTED path.
+    from rio_tpu.errors import SerializationError
+
+    with pytest.raises(SerializationError) as ei:
+        decode_inbound(b"")
+    assert not isinstance(ei.value, UnknownFrameKind)
+
+
+# ---------------------------------------------------------------------------
+# live cluster: client command APIs
+# ---------------------------------------------------------------------------
+
+SEEN: dict[str, list[tuple]] = defaultdict(list)
+
+
+class CmdSink(ServiceObject):
+    async def receive_stream(self, delivery: StreamDelivery, ctx) -> None:
+        SEEN[self.id].append(
+            (delivery.group, delivery.offset, delivery.decode(Note).text)
+        )
+
+
+class CmdAccount(ServiceObject):
+    @handler
+    async def note(self, msg: Note, ctx) -> str:
+        return msg.text
+
+
+def build_registry() -> Registry:
+    return Registry().add_type(CmdSink).add_type(CmdAccount)
+
+
+def _streams_app_data():
+    storage = LocalStreamStorage()
+    state = LocalState()
+
+    def build() -> AppData:
+        return (
+            AppData()
+            .set(storage, as_type=StreamStorage)
+            .set(state, as_type=StateProvider)
+        )
+
+    return storage, build
+
+
+async def wait_until(pred, timeout: float, interval: float = 0.02):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if pred():
+            return
+        await asyncio.sleep(interval)
+    raise AssertionError(f"condition never became true within {timeout}s")
+
+
+def test_client_stream_commands_end_to_end():
+    """Remote producer/consumer management purely over KIND_COMMAND."""
+    SEEN.clear()
+    storage, app_data = _streams_app_data()
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        await client.subscribe_stream("orders", "audit", CmdSink)
+        acks = [
+            await client.publish_stream("orders", Note(text=f"n{i}"), key="k")
+            for i in range(5)
+        ]
+        partition = storage.partition_of("orders", "k")
+        assert [o for _, o in acks] == [0, 1, 2, 3, 4]
+        assert all(p == partition for p, _ in acks)
+
+        def delivered():
+            return sum(len(v) for v in SEEN.values()) == 5
+
+        await wait_until(delivered, 10.0)
+        rows = [r for v in SEEN.values() for r in v]
+        assert sorted(r[1] for r in rows) == [0, 1, 2, 3, 4]
+        cursors = await client.stream_cursors("orders", "audit")
+        assert cursors.get(partition) == 5
+        await client.unsubscribe_stream("orders", "audit")
+        assert await storage.subscriptions("orders") == []
+        client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_registry,
+            num_servers=2,
+            app_data_builder=app_data,
+        )
+    )
+
+
+def test_client_saga_commands():
+    _, app_data = _streams_app_data()
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        reply = await client.start_saga(
+            "cmd-saga-1",
+            [step(CmdAccount, "a", Note(text="go"), Note(text="undo"))],
+        )
+        assert reply.status == "completed", reply
+        status = await client.saga_status("cmd-saga-1")
+        assert status.status == "completed" and status.total == 1
+        client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_registry,
+            num_servers=2,
+            app_data_builder=app_data,
+        )
+    )
+
+
+def test_unknown_command_and_missing_backend_answer_not_supported():
+    """A verb the server doesn't know — and a stream command on a server
+    with no StreamStorage — both come back NOT_SUPPORTED, not a reset."""
+    _, app_data = _streams_app_data()
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        with pytest.raises(ClientError, match="NOT_SUPPORTED"):
+            await client.send_command("stream.compact", "orders", b"")
+        # The connection pool survived: a real command still works after.
+        await client.subscribe_stream("orders", "g", CmdSink)
+        client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_registry,
+            num_servers=1,
+            app_data_builder=app_data,
+        )
+    )
+
+    async def bare_body(cluster: Cluster):
+        client = cluster.client()
+        with pytest.raises(ClientError, match="NOT_SUPPORTED"):
+            await client.publish_stream("orders", Note(text="x"))
+        client.close()
+
+    asyncio.run(
+        run_integration_test(
+            bare_body, registry_builder=build_registry, num_servers=1
+        )
+    )
+
+
+def test_unknown_frame_kind_survives_connection():
+    """The old-server story, at the socket level: an unrecognized frame
+    kind answers NOT_SUPPORTED in FIFO position, and a pipelined valid
+    request on the SAME connection is still answered."""
+    _, app_data = _streams_app_data()
+
+    async def body(cluster: Cluster):
+        host, _, port = cluster.addresses[0].rpartition(":")
+        reader, writer = await asyncio.open_connection(host, int(port))
+        # Pipeline: bogus kind 0x7f, then a valid saga status request.
+        writer.write(codec.frame(b"\x7f" + b"not-a-real-frame"))
+        writer.write(
+            encode_request_frame(
+                RequestEnvelope(
+                    SAGA_TYPE, "ghost", "rio.SagaStatus",
+                    codec.serialize(SagaStatus()),
+                )
+            )
+        )
+        await writer.drain()
+
+        async def read_frame() -> bytes:
+            header = await reader.readexactly(4)
+            return await reader.readexactly(int.from_bytes(header, "big"))
+
+        first = decode_response(await read_frame())
+        assert not first.is_ok
+        assert first.error.kind == ErrorKind.NOT_SUPPORTED
+        assert "unknown frame kind" in first.error.detail
+        second = decode_response(await read_frame())
+        assert second.is_ok  # idle saga reports cleanly — conn survived
+        writer.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_registry,
+            num_servers=1,
+            app_data_builder=app_data,
+        )
+    )

@@ -1,0 +1,465 @@
+"""Durable streams + sagas: storage contract, delivery, redelivery, sagas.
+
+The tentpole subsystem end to end: the :class:`StreamStorage` backend
+contract across all four backends (fakes carry postgres/redis), the
+publish → cursor → consumer delivery path on a live cluster, at-least-once
+redelivery driven by the reminder subsystem after a consumer rejection,
+and saga step/compensation chains with participant-side exactly-once
+dedup.
+"""
+
+import asyncio
+from collections import defaultdict
+
+import pytest
+
+from rio_tpu import (
+    AppData,
+    LocalReminderStorage,
+    Registry,
+    ReminderDaemonConfig,
+    ReminderStorage,
+    ServiceObject,
+    handler,
+    message,
+)
+from rio_tpu.registry import wire_error
+from rio_tpu.state import LocalState, StateProvider
+from rio_tpu.streams import (
+    LocalStreamStorage,
+    StreamDelivery,
+    StreamRecord,
+    StreamStorage,
+    Subscription,
+)
+from rio_tpu.streams.cursor import (
+    CURSOR_TYPE,
+    cursor_id,
+    publish,
+    subscribe_group,
+    unsubscribe_group,
+)
+from rio_tpu.streams.saga import (
+    SAGA_TYPE,
+    SagaStatus,
+    SagaStatusReply,
+    StartSaga,
+    step,
+)
+from rio_tpu.utils import ExponentialBackoff
+
+from .server_utils import Cluster, run_integration_test
+
+# ---------------------------------------------------------------------------
+# storage contract (all four backends)
+# ---------------------------------------------------------------------------
+
+
+async def check_stream_storage(s: StreamStorage) -> None:
+    """The backend contract every StreamStorage must satisfy."""
+    await s.prepare()
+    p = s.partition_of("orders", "k1")
+    offs = [
+        await s.append(StreamRecord("orders", p, 0, "M", b"x%d" % i, "k1", 1.0))
+        for i in range(5)
+    ]
+    assert offs == [0, 1, 2, 3, 4]  # dense, 0-based
+    assert await s.latest("orders", p) == 5
+    # Distinct (stream, partition) logs never interleave.
+    other = (p + 1) % s.num_partitions
+    assert await s.append(StreamRecord("orders", other, 0, "M", b"o", "", 1.0)) == 0
+    recs = await s.read("orders", p, 2, 10)
+    assert [r.offset for r in recs] == [2, 3, 4]
+    assert recs[0].payload == b"x2" and recs[0].message_type == "M"
+    assert recs[0].key == "k1"
+    assert await s.read("orders", p, 2, 2) and len(await s.read("orders", p, 2, 2)) == 2
+    assert await s.read("orders", p, 99) == []
+    # Subscriptions: upsert + ordered listing + removal.
+    await s.subscribe(Subscription("orders", "g1", "T", 0.5))
+    await s.subscribe(Subscription("orders", "g0", "T"))
+    await s.subscribe(Subscription("orders", "g1", "T2", 0.25))  # overwrite
+    subs = await s.subscriptions("orders")
+    assert [(x.group, x.target_type) for x in subs] == [("g0", "T"), ("g1", "T2")]
+    assert subs[1].redelivery_period == 0.25
+    # Cursors: default 0, monotone commit, per-partition map.
+    assert await s.committed("orders", "g1", p) == 0
+    await s.commit("orders", "g1", p, 3)
+    await s.commit("orders", "g1", p, 2)  # stale — must not regress
+    assert await s.committed("orders", "g1", p) == 3
+    assert await s.cursors("orders", "g1") == {p: 3}
+    await s.unsubscribe("orders", "g0")
+    assert [x.group for x in await s.subscriptions("orders")] == ["g1"]
+
+
+@pytest.mark.asyncio
+async def test_local_stream_storage():
+    await check_stream_storage(LocalStreamStorage())
+
+
+@pytest.mark.asyncio
+async def test_sqlite_stream_storage(tmp_path):
+    from rio_tpu.streams.sqlite import SqliteStreamStorage
+
+    await check_stream_storage(SqliteStreamStorage(str(tmp_path / "s.db")))
+
+
+@pytest.mark.asyncio
+async def test_postgres_stream_storage():
+    import os
+
+    from rio_tpu.streams.postgres import PostgresStreamStorage
+    from rio_tpu.utils.pg import driver_available
+
+    dsn = os.environ.get("RIO_TPU_PG_DSN", "")
+    if not driver_available() or not dsn:
+        from tests import fake_pg
+
+        fake_pg.install()
+        fake_pg.reset()
+        dsn = "postgresql://fake-pg/streams"
+    await check_stream_storage(PostgresStreamStorage(dsn))
+
+
+@pytest.mark.asyncio
+async def test_redis_stream_storage():
+    from rio_tpu.streams.redis import RedisStreamStorage
+
+    from tests.fake_redis import FakeRedisServer
+
+    srv = FakeRedisServer()
+    await srv.start()
+    try:
+        await check_stream_storage(
+            RedisStreamStorage(f"redis://127.0.0.1:{srv.port}")
+        )
+    finally:
+        await srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# delivery integration (live cluster)
+# ---------------------------------------------------------------------------
+
+# Global records: (group, stream, offset, payload, attempt) per sink id —
+# survives re-activation and server moves (one process).
+SEEN: dict[str, list[tuple]] = defaultdict(list)
+REJECT: dict[str, int] = {}  # sink id -> number of deliveries to reject
+
+
+@message
+class Item:
+    n: int = 0
+
+
+@wire_error
+class SinkRejected(Exception):
+    pass
+
+
+class Sink(ServiceObject):
+    async def receive_stream(self, delivery: StreamDelivery, ctx) -> None:
+        if REJECT.get(self.id, 0) > 0:
+            REJECT[self.id] -= 1
+            raise SinkRejected(self.id)
+        item = delivery.decode(Item)
+        SEEN[self.id].append(
+            (delivery.group, delivery.stream, delivery.offset, item.n, delivery.attempt)
+        )
+
+
+def build_registry() -> Registry:
+    return Registry().add_type(Sink).add_type(Account).add_type(Vetoer)
+
+
+def streams_kwargs(
+    storage: StreamStorage,
+    reminders: LocalReminderStorage | None = None,
+    state: LocalState | None = None,
+    daemon: bool = False,
+) -> dict:
+    shared_state = state or LocalState()
+
+    def app_data() -> AppData:
+        ad = AppData().set(storage, as_type=StreamStorage)
+        ad.set(shared_state, as_type=StateProvider)
+        if reminders is not None:
+            ad.set(reminders, as_type=ReminderStorage)
+        return ad
+
+    kwargs: dict = {"app_data_builder": app_data}
+    if daemon:
+        kwargs["server_kwargs"] = {
+            "reminder_daemon": True,
+            "reminder_daemon_config": ReminderDaemonConfig(
+                poll_interval=0.05,
+                lease_ttl=2.0,
+                delivery_backoff=ExponentialBackoff(
+                    initial=1e-3, cap=0.05, max_retries=4
+                ),
+            ),
+        }
+    return kwargs
+
+
+async def wait_until(pred, timeout: float, interval: float = 0.02):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        v = pred()
+        if v:
+            return v
+        await asyncio.sleep(interval)
+    raise AssertionError(f"condition never became true within {timeout}s")
+
+
+def test_publish_delivers_to_every_group():
+    """Two consumer groups each see every record, in per-partition order;
+    cursors advance to the log head."""
+    SEEN.clear()
+    REJECT.clear()
+    storage = LocalStreamStorage()
+
+    async def body(cluster: Cluster):
+        ctx = cluster.servers[0].app_data
+        await subscribe_group(ctx, "orders", "audit", Sink)
+        await subscribe_group(ctx, "orders", "billing", Sink)
+        acks = []
+        for i in range(10):
+            acks.append(await publish(ctx, "orders", Item(n=i), key=f"k{i % 3}"))
+        assert all(isinstance(o, int) for _, o in acks)
+
+        def total():
+            rows = [r for rows in SEEN.values() for r in rows]
+            groups = {r[0] for r in rows}
+            return len(rows) == 20 and groups == {"audit", "billing"}
+
+        await wait_until(total, 10.0)
+        # Per (group, key-partition) delivery is in offset order.
+        for sink_id, rows in SEEN.items():
+            for g in ("audit", "billing"):
+                offs = [r[2] for r in rows if r[0] == g]
+                assert offs == sorted(offs), (sink_id, rows)
+        # Cursors committed to the head of each partition.
+        for group in ("audit", "billing"):
+            cursors = await storage.cursors("orders", group)
+            for p, committed in cursors.items():
+                assert committed == await storage.latest("orders", p)
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_registry,
+            num_servers=1,
+            **streams_kwargs(storage),
+        )
+    )
+
+
+def test_rejected_delivery_stalls_then_redelivers():
+    """A consumer rejection stalls the partition (no skip, no commit); the
+    reminder backstop redelivers until it lands — with attempt > 1 and no
+    record lost or reordered."""
+    SEEN.clear()
+    REJECT.clear()
+    storage = LocalStreamStorage()
+    reminders = LocalReminderStorage()
+
+    async def body(cluster: Cluster):
+        ctx = cluster.servers[0].app_data
+        await subscribe_group(
+            ctx, "jobs", "work", Sink, redelivery_period=0.2
+        )
+        # All records share one key → one partition → strict order.
+        REJECT["kA"] = 2  # first two delivery attempts bounce
+        for i in range(4):
+            await publish(ctx, "jobs", Item(n=i), key="kA")
+
+        def done():
+            rows = SEEN.get("kA", [])
+            return len(rows) == 4
+
+        await wait_until(done, 15.0)
+        rows = SEEN["kA"]
+        assert [r[3] for r in rows] == [0, 1, 2, 3]  # nothing lost/reordered
+        assert rows[0][4] > 1  # offset 0 landed via redelivery
+        p = storage.partition_of("jobs", "kA")
+        deadline = asyncio.get_event_loop().time() + 5.0
+        while await storage.committed("jobs", "work", p) < 4:
+            assert asyncio.get_event_loop().time() < deadline, "commit never caught up"
+            await asyncio.sleep(0.02)
+        await unsubscribe_group(ctx, "jobs", "work")
+        assert await reminders.list_object(
+            CURSOR_TYPE, cursor_id("jobs", "work", p)
+        ) == []
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_registry,
+            num_servers=1,
+            timeout=40.0,
+            **streams_kwargs(storage, reminders=reminders, daemon=True),
+        )
+    )
+
+
+# ---------------------------------------------------------------------------
+# sagas
+# ---------------------------------------------------------------------------
+
+LEDGER: dict[str, list[str]] = defaultdict(list)  # account id -> effects
+
+
+@message
+class Reserve:
+    amount: int = 0
+
+
+@message
+class Unreserve:
+    amount: int = 0
+
+
+@wire_error
+class Vetoed(Exception):
+    pass
+
+
+class Account(ServiceObject):
+    @handler
+    async def reserve(self, msg: Reserve, ctx) -> int:
+        LEDGER[self.id].append(f"reserve:{msg.amount}")
+        return msg.amount
+
+    @handler
+    async def unreserve(self, msg: Unreserve, ctx) -> int:
+        LEDGER[self.id].append(f"unreserve:{msg.amount}")
+        return msg.amount
+
+
+class Vetoer(ServiceObject):
+    """Participant that rejects every action (typed error)."""
+
+    @handler
+    async def reserve(self, msg: Reserve, ctx) -> int:
+        LEDGER[self.id].append("veto")
+        raise Vetoed(self.id)
+
+
+def test_saga_completes_across_participants():
+    LEDGER.clear()
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        reply = await client.send(
+            SAGA_TYPE,
+            "order-1",
+            StartSaga(
+                steps=[
+                    step(Account, "a", Reserve(amount=5), Unreserve(amount=5)),
+                    step(Account, "b", Reserve(amount=7), Unreserve(amount=7)),
+                ]
+            ),
+            returns=SagaStatusReply,
+        )
+        assert reply.status == "completed", reply
+        assert LEDGER["a"] == ["reserve:5"]
+        assert LEDGER["b"] == ["reserve:7"]
+        # Idempotent restart: same saga id reports, never re-runs.
+        again = await client.send(
+            SAGA_TYPE, "order-1", StartSaga(steps=[]), returns=SagaStatusReply
+        )
+        assert again.status == "completed" and again.total == 2
+        assert LEDGER["a"] == ["reserve:5"]
+        status = await client.send(
+            SAGA_TYPE, "order-1", SagaStatus(), returns=SagaStatusReply
+        )
+        assert status.status == "completed"
+        client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_registry,
+            num_servers=1,
+            **streams_kwargs(LocalStreamStorage()),
+        )
+    )
+
+
+def test_saga_compensates_in_reverse_on_rejection():
+    LEDGER.clear()
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        reply = await client.send(
+            SAGA_TYPE,
+            "order-2",
+            StartSaga(
+                steps=[
+                    step(Account, "a", Reserve(amount=5), Unreserve(amount=5)),
+                    step(Account, "b", Reserve(amount=7), Unreserve(amount=7)),
+                    step(Vetoer, "v", Reserve(amount=9), Unreserve(amount=9)),
+                ]
+            ),
+            returns=SagaStatusReply,
+        )
+        assert reply.status == "compensated", reply
+        assert "Vetoed" in reply.error
+        # Completed steps undone, in reverse order; the rejected step has
+        # no compensation effect (it never completed).
+        assert LEDGER["a"] == ["reserve:5", "unreserve:5"]
+        assert LEDGER["b"] == ["reserve:7", "unreserve:7"]
+        assert LEDGER["v"] == ["veto"]
+        client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_registry,
+            num_servers=1,
+            **streams_kwargs(LocalStreamStorage()),
+        )
+    )
+
+
+def test_saga_step_dedup_is_exactly_once():
+    """A re-sent step (coordinator resume after a crash mid-send) is
+    absorbed by the participant's persisted ledger."""
+    LEDGER.clear()
+
+    async def body(cluster: Cluster):
+        from rio_tpu.streams import SagaStep
+        from rio_tpu import codec
+
+        client = cluster.client()
+        saga_step = SagaStep(
+            saga_id="s-dup",
+            step=0,
+            kind="action",
+            message_type="Reserve",
+            payload=codec.serialize(Reserve(amount=3)),
+        )
+        await client.send("Account", "dup", saga_step)
+        await client.send("Account", "dup", saga_step)  # duplicate
+        assert LEDGER["dup"] == ["reserve:3"]
+        # Same step, different kind → a distinct effect (compensation).
+        comp = SagaStep(
+            saga_id="s-dup",
+            step=0,
+            kind="compensate",
+            message_type="Unreserve",
+            payload=codec.serialize(Unreserve(amount=3)),
+        )
+        await client.send("Account", "dup", comp)
+        await client.send("Account", "dup", comp)
+        assert LEDGER["dup"] == ["reserve:3", "unreserve:3"]
+        client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_registry,
+            num_servers=1,
+            **streams_kwargs(LocalStreamStorage()),
+        )
+    )

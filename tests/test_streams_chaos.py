@@ -1,0 +1,441 @@
+"""Fixed-seed chaos for durable streams + sagas, across every backend.
+
+The matrix (sqlite / fake-pg / fake-redis carrying the StreamStorage):
+
+* the node seating a **consumer-cursor actor dies mid-batch** while a
+  seeded :class:`FaultSchedule` (fixed seed — replayable) is already
+  failing a quarter of cursor commits → zero lost acked publishes: every
+  ``(partition, offset)`` the producer was acked for is delivered at
+  least once, and the group cursor converges to the log head on the
+  survivor;
+* the node seating the **saga coordinator dies mid-step** → the resume
+  reminder re-drives the persisted record on a survivor, the in-flight
+  step re-sends, the participant ledger absorbs the duplicate — every
+  effect exactly once;
+* the node seating a **saga participant dies mid-step** → the
+  coordinator's send retries through re-seat and the saga still
+  completes with exactly-once effects;
+* the coordinator dies **mid-compensation** → compensations land exactly
+  once (never doubled) and the saga terminates ``compensated``.
+
+Each scenario also asserts the journal tells one causal story: STREAM
+deliveries on the survivor after the kill, SAGA events sharing a single
+trace id across the crash (one saga = one trace tree).
+"""
+
+import asyncio
+from collections import defaultdict
+
+import pytest
+
+from rio_tpu import (
+    AdminCommand,
+    LocalReminderStorage,
+    ServiceObject,
+    Registry,
+    handler,
+    message,
+)
+from rio_tpu.faults import FaultRule, FaultSchedule, FaultyStreamStorage
+from rio_tpu.journal import SAGA, STREAM, Journal
+from rio_tpu.registry import type_id, wire_error
+from rio_tpu.streams import StreamDelivery, StreamStorage
+from rio_tpu.streams.cursor import CURSOR_TYPE, cursor_id
+from rio_tpu.streams.saga import SAGA_TYPE, step
+
+from .server_utils import Cluster, run_integration_test
+from .test_streams import streams_kwargs, wait_until
+
+BACKENDS = ("sqlite", "pg", "redis")
+
+
+async def _open_backend(kind: str, tmp_path):
+    """(storage, async-close) for one matrix cell."""
+    if kind == "sqlite":
+        from rio_tpu.streams.sqlite import SqliteStreamStorage
+
+        async def noop():
+            return None
+
+        return SqliteStreamStorage(str(tmp_path / "chaos.db")), noop
+    if kind == "pg":
+        from rio_tpu.streams.postgres import PostgresStreamStorage
+
+        from tests import fake_pg
+
+        fake_pg.install()
+        fake_pg.reset()
+
+        async def noop():
+            return None
+
+        return PostgresStreamStorage("postgresql://fake-pg/chaos"), noop
+    from rio_tpu.streams.redis import RedisStreamStorage
+
+    from tests.fake_redis import FakeRedisServer
+
+    srv = FakeRedisServer()
+    await srv.start()
+    return RedisStreamStorage(f"redis://127.0.0.1:{srv.port}"), srv.stop
+
+
+async def _kill_server(cluster: Cluster, address: str) -> None:
+    victim = next(s for s in cluster.servers if s.local_address == address)
+    victim.admin_sender().send(AdminCommand.server_exit())
+    deadline = asyncio.get_event_loop().time() + 10.0
+    while asyncio.get_event_loop().time() < deadline:
+        if not await cluster.members.is_active(address):
+            return
+        await asyncio.sleep(0.02)
+    raise AssertionError(f"{address} never left membership")
+
+
+def _journals(cluster: Cluster, skip_address: str | None = None) -> list[Journal]:
+    out = []
+    for s in cluster.servers:
+        if skip_address is not None and s.local_address == skip_address:
+            continue
+        j = s.app_data.try_get(Journal)
+        if j is not None:
+            out.append(j)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# consumer-cursor node death mid-batch
+# ---------------------------------------------------------------------------
+
+CH_SEEN: dict[str, set] = defaultdict(set)  # sink id -> {(partition, offset)}
+
+
+@message
+class ChaosItem:
+    n: int = 0
+
+
+class ChaosSink(ServiceObject):
+    async def receive_stream(self, delivery: StreamDelivery, ctx) -> None:
+        CH_SEEN[self.id].add((delivery.partition, delivery.offset))
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_cursor_node_death_mid_batch_loses_no_acked_publish(backend, tmp_path):
+    CH_SEEN.clear()
+
+    async def main():
+        raw, close = await _open_backend(backend, tmp_path)
+        # Seeded noise UNDER the kill: a quarter of cursor commits fail, so
+        # the run leans on redelivery even before the node dies. Same seed
+        # → same injection pattern every run.
+        schedule = FaultSchedule(
+            seed=11, rules=[FaultRule(op="streams.commit", error_rate=0.25)]
+        )
+        storage = FaultyStreamStorage(raw, schedule)
+        reminders = LocalReminderStorage()
+
+        async def body(cluster: Cluster):
+            client = cluster.client()
+            try:
+                await client.subscribe_stream(
+                    "chaos", "g", ChaosSink, redelivery_period=0.2
+                )
+                acks = [
+                    await client.publish_stream("chaos", ChaosItem(n=i), key="k")
+                    for i in range(10)
+                ]
+                partition = storage.partition_of("chaos", "k")
+                assert all(p == partition for p, _ in acks)
+
+                def seen() -> set:
+                    return set().union(*CH_SEEN.values()) if CH_SEEN else set()
+
+                # Mid-batch: some (not all) of the first wave delivered.
+                await wait_until(lambda: len(seen()) >= 3, 15.0)
+                cid = cursor_id("chaos", "g", partition)
+                addr = await cluster.allocation_address(CURSOR_TYPE, cid)
+                assert addr is not None, "cursor actor never seated"
+                await _kill_server(cluster, addr)
+
+                # The producer keeps publishing straight through the death.
+                acks += [
+                    await client.publish_stream("chaos", ChaosItem(n=i), key="k")
+                    for i in range(10, 20)
+                ]
+                want = set(acks)
+                # Zero lost acked publishes (at-least-once; duplicates fine).
+                await wait_until(lambda: want <= seen(), 30.0)
+                # The cursor converges to the log head on the survivor —
+                # read through the RAW backend so the assertion can't be
+                # perturbed by the fault schedule.
+                latest = await raw.latest("chaos", partition)
+                assert latest == 20
+
+                async def caught_up() -> bool:
+                    return await raw.committed("chaos", "g", partition) == latest
+
+                deadline = asyncio.get_event_loop().time() + 30.0
+                while not await caught_up():
+                    if asyncio.get_event_loop().time() > deadline:
+                        raise AssertionError("cursor never converged")
+                    await asyncio.sleep(0.05)
+
+                # Causal story: the survivor journaled post-kill deliveries.
+                key = f"chaos/g/{partition}"
+                survivor_events = [
+                    ev
+                    for j in _journals(cluster, skip_address=addr)
+                    for ev in j.events(kinds=[STREAM], key=key)
+                    if ev.attrs.get("op") == "deliver"
+                ]
+                assert survivor_events, "no STREAM deliver events on survivor"
+                assert schedule.injected_errors > 0, "seeded commit faults never fired"
+            finally:
+                client.close()
+
+        try:
+            await run_integration_test(
+                body,
+                registry_builder=lambda: Registry().add_type(ChaosSink),
+                num_servers=2,
+                timeout=90.0,
+                **streams_kwargs(storage, reminders=reminders, daemon=True),
+            )
+        finally:
+            await close()
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# saga kills
+# ---------------------------------------------------------------------------
+
+CH_LEDGER: dict[str, list[str]] = defaultdict(list)
+GATE: dict[str, asyncio.Event] = {}
+GATE_WAITERS: dict[str, int] = defaultdict(int)
+
+
+@message
+class GateAct:
+    tag: str = ""
+
+
+@message
+class GateUndo:
+    tag: str = ""
+
+
+@wire_error
+class ChaosVetoed(Exception):
+    pass
+
+
+class Gate(ServiceObject):
+    """Participant whose effects can be held open mid-step: the handler
+    parks on a named event until the test releases it — the window in
+    which a node gets killed."""
+
+    @handler
+    async def act(self, msg: GateAct, ctx) -> str:
+        GATE_WAITERS[msg.tag] += 1
+        ev = GATE.get(msg.tag)
+        if ev is not None:
+            await ev.wait()
+        CH_LEDGER[self.id].append(f"act:{msg.tag}")
+        return msg.tag
+
+    @handler
+    async def undo(self, msg: GateUndo, ctx) -> str:
+        GATE_WAITERS[msg.tag] += 1
+        ev = GATE.get(msg.tag)
+        if ev is not None:
+            await ev.wait()
+        CH_LEDGER[self.id].append(f"undo:{msg.tag}")
+        return msg.tag
+
+
+class ChaosVetoer(ServiceObject):
+    @handler
+    async def act(self, msg: GateAct, ctx) -> str:
+        CH_LEDGER[self.id].append("veto")
+        raise ChaosVetoed(self.id)
+
+
+def saga_registry() -> Registry:
+    return Registry().add_type(Gate).add_type(ChaosVetoer)
+
+
+def _reset_saga_globals() -> None:
+    CH_LEDGER.clear()
+    GATE.clear()
+    GATE_WAITERS.clear()
+
+
+def _saga_journal_story(cluster: Cluster, saga_id: str, want_ops: set[str]) -> None:
+    """One causal story: the required ops all journaled, and every SAGA
+    event that carries a trace id carries the SAME one — the post-crash
+    spans joined the original tree."""
+    events = [
+        ev for j in _journals(cluster) for ev in j.events(kinds=[SAGA], key=saga_id)
+    ]
+    ops = {ev.attrs.get("op") for ev in events}
+    assert want_ops <= ops, f"journal ops {ops} missing {want_ops - ops}"
+    traces = {ev.trace_id for ev in events if ev.trace_id}
+    assert len(traces) <= 1, f"saga {saga_id} split across traces: {traces}"
+
+
+async def _saga_status_is(client, saga_id: str, status: str, timeout: float = 30.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    last = None
+    while asyncio.get_event_loop().time() < deadline:
+        last = await client.saga_status(saga_id)
+        if last.status == status:
+            return last
+        await asyncio.sleep(0.05)
+    raise AssertionError(f"saga {saga_id} stuck at {last}")
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_saga_coordinator_death_mid_step_resumes_exactly_once(backend, tmp_path):
+    _reset_saga_globals()
+
+    async def main():
+        raw, close = await _open_backend(backend, tmp_path)
+        reminders = LocalReminderStorage()
+        GATE["hold"] = asyncio.Event()
+
+        async def body(cluster: Cluster):
+            client = cluster.client()
+            try:
+                steps = [
+                    step(Gate, "g1", GateAct(tag="hold"), GateUndo(tag="free")),
+                    step(Gate, "g2", GateAct(tag="free"), GateUndo(tag="free")),
+                ]
+                start = asyncio.create_task(client.start_saga("cs-coord", steps))
+                # Mid-step: the participant is inside the held handler.
+                await wait_until(lambda: GATE_WAITERS["hold"] >= 1, 15.0)
+                addr = await cluster.allocation_address(SAGA_TYPE, "cs-coord")
+                assert addr is not None
+                await _kill_server(cluster, addr)
+                GATE["hold"].set()
+                # The client's own retry re-seats the coordinator; the reply
+                # may be non-terminal ("running") — the resume reminder owns
+                # driving it home.
+                await start
+                await _saga_status_is(client, "cs-coord", "completed")
+                # Exactly once, both steps, despite the re-sent step 0.
+                assert CH_LEDGER["g1"] == ["act:hold"]
+                assert CH_LEDGER["g2"] == ["act:free"]
+                _saga_journal_story(
+                    cluster, "cs-coord", {"start", "step", "completed"}
+                )
+            finally:
+                client.close()
+
+        try:
+            await run_integration_test(
+                body,
+                registry_builder=saga_registry,
+                num_servers=2,
+                timeout=90.0,
+                **streams_kwargs(raw, reminders=reminders, daemon=True),
+            )
+        finally:
+            await close()
+
+    asyncio.run(main())
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_saga_participant_death_mid_step_applies_once(backend, tmp_path):
+    _reset_saga_globals()
+
+    async def main():
+        raw, close = await _open_backend(backend, tmp_path)
+        reminders = LocalReminderStorage()
+        GATE["hold"] = asyncio.Event()
+
+        async def body(cluster: Cluster):
+            client = cluster.client()
+            try:
+                steps = [step(Gate, "p1", GateAct(tag="hold"), GateUndo(tag="free"))]
+                start = asyncio.create_task(client.start_saga("cs-part", steps))
+                await wait_until(lambda: GATE_WAITERS["hold"] >= 1, 15.0)
+                addr = await cluster.allocation_address(type_id(Gate), "p1")
+                assert addr is not None
+                await _kill_server(cluster, addr)
+                GATE["hold"].set()
+                await start
+                await _saga_status_is(client, "cs-part", "completed")
+                assert CH_LEDGER["p1"] == ["act:hold"]
+                _saga_journal_story(cluster, "cs-part", {"start", "step", "completed"})
+            finally:
+                client.close()
+
+        try:
+            await run_integration_test(
+                body,
+                registry_builder=saga_registry,
+                num_servers=2,
+                timeout=90.0,
+                **streams_kwargs(raw, reminders=reminders, daemon=True),
+            )
+        finally:
+            await close()
+
+    asyncio.run(main())
+
+
+def test_coordinator_death_mid_compensation_never_doubles(tmp_path):
+    """The kill lands INSIDE the compensation chain: step 0 completed,
+    step 1 vetoed, the undo of step 0 is parked when the coordinator's
+    node dies. The resumed coordinator re-sends the compensation; the
+    participant ledger dedups — exactly one undo, terminal state
+    ``compensated``."""
+    _reset_saga_globals()
+
+    async def main():
+        raw, close = await _open_backend("sqlite", tmp_path)
+        reminders = LocalReminderStorage()
+        GATE["undo-hold"] = asyncio.Event()
+
+        async def body(cluster: Cluster):
+            client = cluster.client()
+            try:
+                steps = [
+                    step(Gate, "c1", GateAct(tag="free"), GateUndo(tag="undo-hold")),
+                    step(ChaosVetoer, "v", GateAct(tag="free"), GateUndo(tag="free")),
+                ]
+                start = asyncio.create_task(client.start_saga("cs-comp", steps))
+                # The veto flips the saga to compensating; the undo parks.
+                await wait_until(lambda: GATE_WAITERS["undo-hold"] >= 1, 15.0)
+                addr = await cluster.allocation_address(SAGA_TYPE, "cs-comp")
+                assert addr is not None
+                await _kill_server(cluster, addr)
+                GATE["undo-hold"].set()
+                await start
+                reply = await _saga_status_is(client, "cs-comp", "compensated")
+                assert "ChaosVetoed" in reply.error
+                # No double compensation: one act, one undo, in order.
+                assert CH_LEDGER["c1"] == ["act:free", "undo:undo-hold"]
+                assert CH_LEDGER["v"] == ["veto"]  # rejected step never undone
+                _saga_journal_story(
+                    cluster,
+                    "cs-comp",
+                    {"start", "step", "compensating", "compensate", "compensated"},
+                )
+            finally:
+                client.close()
+
+        try:
+            await run_integration_test(
+                body,
+                registry_builder=saga_registry,
+                num_servers=2,
+                timeout=90.0,
+                **streams_kwargs(raw, reminders=reminders, daemon=True),
+            )
+        finally:
+            await close()
+
+    asyncio.run(main())
