@@ -2018,6 +2018,44 @@ def streams_throughput() -> dict:
     return out
 
 
+def affinity_payoff() -> dict:
+    """Affinity-aware placement payoff + sampler cost, A/B'd in the SAME
+    session. Payoff: an adversarial multi-hop pipeline (producer + stream
+    cursors seated on node 0, consumers on node 1) runs affinity-blind,
+    then the merged edge graph is fed back through ``set_edge_graph`` +
+    ``rebalance`` and the same traffic re-runs — the honest numerator is
+    the transports' TCP byte counters, and the ISSUE 17 bar is a >= 2x
+    drop plus formerly cross-node delivery hops vanishing from the wire
+    span rings. Cost: the dispatch-path sampler priced off-vs-on over an
+    affinity-neutral echo cluster, median paired ratio (bar: <= ~2%)."""
+    import asyncio
+
+    from rio_tpu.utils.affinity_live import (
+        measure_affinity_payoff,
+        measure_sampler_overhead,
+    )
+
+    out = asyncio.run(measure_affinity_payoff())
+    out["sampler"] = asyncio.run(measure_sampler_overhead())
+    out["host"] = _host_provenance()
+    tcp, spans = out["tcp_bytes"], out["delivery_wire_spans"]
+    m = out["sampler"]["msgs_per_sec"]
+    print(
+        f"# affinity payoff ({out['n_records']} records x "
+        f"{out['pad_bytes']}B over {out['partitions']} partitions, "
+        f"{out['edges_installed']} edges fed back, {out['moves']} moves, "
+        f"solved as {out['solved_as']}): TCP bytes blind "
+        f"{tcp['blind']:,} -> affinity {tcp['affinity']:,} "
+        f"({out['bytes_ratio']:.1f}x), cross-node delivery wire spans "
+        f"{spans['blind']} -> {spans['affinity']}, "
+        f"{out['pairs_colocated']}/{out['partitions']} pairs co-located; "
+        f"sampler off {m['off']:,.0f}/s, on {m['on']:,.0f}/s "
+        f"({out['sampler']['sampler_overhead_pct']:+}% median paired)",
+        file=sys.stderr,
+    )
+    return out
+
+
 def series_overhead() -> dict:
     """RPC-loop cost of gauge time-series sampling + HealthWatch, A/B'd in
     the SAME session: servers with timeseries=False vs sampling at an
@@ -2450,6 +2488,10 @@ def main() -> None:
     except Exception as e:
         print(f"# streams throughput failed: {e!r}", file=sys.stderr)
     try:
+        detail["affinity"] = affinity_payoff()
+    except Exception as e:
+        print(f"# affinity payoff failed: {e!r}", file=sys.stderr)
+    try:
         detail["scaled_routing"] = scaled_route_hops()
     except Exception as e:
         print(f"# scaled routing failed: {e!r}", file=sys.stderr)
@@ -2623,6 +2665,10 @@ if __name__ == "__main__":
     # alone and bank it into the cpu sidecar (in-process clusters over
     # LocalStreamStorage; CPU-safe).
     parser.add_argument("--streams", action="store_true")
+    # Run the affinity-placement bytes-over-TCP A/B + sampler-overhead
+    # stage alone and bank it into the cpu sidecar (in-process clusters;
+    # CPU-safe).
+    parser.add_argument("--affinity", action="store_true")
     args = parser.parse_args()
     if args.migration:
         _pin_orchestrator_to_cpu()
@@ -2737,6 +2783,24 @@ if __name__ == "__main__":
         except (OSError, ValueError):
             detail = {}
         detail["streams"] = out
+        _write_detail(detail, here)
+        print(json.dumps(out))
+    elif args.affinity:
+        # Standalone --affinity updates the banked cpu sidecar in place
+        # (the --streams pattern): the A/B carries its own paired
+        # baseline, so it can refresh independently of the other host
+        # stages.
+        _pin_orchestrator_to_cpu()
+        out = affinity_payoff()
+        here = os.path.dirname(os.path.abspath(__file__))
+        try:
+            with open(os.path.join(here, "BENCH_DETAIL.cpu.json")) as fh:
+                detail = json.load(fh)
+            if not isinstance(detail, dict):
+                detail = {}
+        except (OSError, ValueError):
+            detail = {}
+        detail["affinity"] = out
         _write_detail(detail, here)
         print(json.dumps(out))
     elif args.delta:

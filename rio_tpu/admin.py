@@ -30,7 +30,8 @@ Operator CLI (see ``_cli_main``)::
     python -m rio_tpu.admin explain --nodes host:p,host:p TYPE ID
     python -m rio_tpu.admin stats   --nodes host:p,host:p
     python -m rio_tpu.admin trace   --nodes host:p,host:p TRACE_ID
-    python -m rio_tpu.admin --demo {tail|explain|stats|watch|trace}
+    python -m rio_tpu.admin edges   --nodes host:p,host:p [--limit K]
+    python -m rio_tpu.admin --demo {tail|explain|stats|watch|trace|edges}
 
 A fourth wire pair serves the request-waterfall plane: :class:`DumpSpans`
 → :class:`SpansSnapshot` returns the node's retained request spans
@@ -182,6 +183,34 @@ class SpansSnapshot:
         return [SpanRecord.from_row(r) for r in self.rows]
 
 
+@message(name="rio.DumpEdges")
+@dataclass
+class DumpEdges:
+    """Ask a node for its communication-edge graph (``rio_tpu/affinity``).
+
+    ``limit`` bounds the response to the HEAVIEST edges by byte rate
+    (0 = everything the sampler retained, itself top-K bounded).
+    """
+
+    limit: int = 256
+
+
+@message(name="rio.EdgesSnapshot")
+@dataclass
+class EdgesSnapshot:
+    """One node's sampled edge graph (merge with ``affinity.merge_edges``)."""
+
+    address: str = ""
+    # EdgeSampler wire rows: [src, dst, bytes_per_s, calls_per_s,
+    # local_frac] — src/dst are "{type}.{id}" object keys ("client" for
+    # external callers). Rows may only ever GROW by appending trailing
+    # fields.
+    rows: list = field(default_factory=list)
+    sampled: int = 0  # dispatches observed (stride-scaled source count)
+    evictions: int = 0  # cold edges dropped by the top-K bound
+    cross_bytes_per_s: float = 0.0  # EMA byte rate of non-local traffic
+
+
 @message(name="rio.AdminRequest")
 @dataclass
 class AdminRequest:
@@ -316,6 +345,25 @@ class AdminControl(ServiceObject):
         )
 
     @handler
+    async def dump_edges(self, msg: DumpEdges, ctx: AppData) -> EdgesSnapshot:
+        from .affinity import EdgeSampler
+        from .commands import ServerInfo
+
+        info = ctx.try_get(ServerInfo)
+        address = info.address if info else ""
+        sampler = ctx.try_get(EdgeSampler)
+        if sampler is None:
+            return EdgesSnapshot(address=address)
+        sampler.fold(force=True)  # rows reflect traffic up to this scrape
+        return EdgesSnapshot(
+            address=address,
+            rows=sampler.edges(limit=msg.limit),
+            sampled=sampler.sampled,
+            evictions=sampler.evictions,
+            cross_bytes_per_s=round(sampler.cross_bytes_per_s, 3),
+        )
+
+    @handler
     async def admin(self, msg: AdminRequest, ctx: AppData) -> AdminAck:
         sender = ctx.try_get(AdminSender)
         if sender is None:
@@ -415,6 +463,48 @@ async def scrape_spans(
             continue
         snapshots.append(snap)
     return snapshots
+
+
+async def scrape_edges(
+    client: Any,
+    nodes: Any,
+    *,
+    limit: int = 256,
+) -> list[EdgesSnapshot]:
+    """One :class:`DumpEdges` round trip per live node; dead nodes skipped.
+
+    Nodes predating the edge sampler answer the admin envelope with an
+    error (unknown message) — they are skipped like unreachable nodes, so
+    a mixed-version cluster still yields the survivors' graphs.
+    """
+    msg = DumpEdges(limit=limit)
+    snapshots: list[EdgesSnapshot] = []
+    for address in await _node_addresses(nodes):
+        try:
+            snap = await client.send(ADMIN_TYPE, address, msg, returns=EdgesSnapshot)
+        except Exception:
+            continue
+        snapshots.append(snap)
+    return snapshots
+
+
+async def cluster_edges(
+    client: Any,
+    nodes: Any,
+    *,
+    limit: int = 256,
+) -> list[list]:
+    """The cluster-merged communication graph, heaviest pairs first.
+
+    Each node observes its own side of the traffic (dst-side for local
+    sends, sender-side for remote ones), so the merge sums per-node rates
+    into cluster-wide edge weights — the rows
+    :meth:`JaxObjectPlacement.set_edge_graph` consumes directly.
+    """
+    from .affinity import merge_edges
+
+    snapshots = await scrape_edges(client, nodes, limit=limit)
+    return merge_edges([s.rows for s in snapshots])
 
 
 async def cluster_events(
@@ -818,6 +908,16 @@ async def _cli_main(argv: Sequence[str] | None = None) -> int:
         "--window", type=int, default=64, help="samples scraped per node"
     )
 
+    edges_p = _common(
+        sub.add_parser(
+            "edges",
+            help="top chatty actor pairs from the communication-edge samplers",
+        )
+    )
+    edges_p.add_argument(
+        "--limit", type=int, default=16, help="pairs shown (heaviest first)"
+    )
+
     trace_p = _common(
         sub.add_parser(
             "trace", help="assemble one request's cross-node waterfall"
@@ -916,6 +1016,59 @@ async def _cli_main(argv: Sequence[str] | None = None) -> int:
             if args.json:
                 print(json.dumps(out))
             return 0 if reached else 1
+        if args.cmd == "edges":
+            from .affinity import merge_edges
+
+            snapshots = await scrape_edges(
+                client, nodes, limit=max(args.limit * 4, 64)
+            )
+            merged = merge_edges([s.rows for s in snapshots])
+            top = merged[: args.limit]
+            total_bps = sum(r[2] for r in merged)
+            local_bps = sum(r[2] * r[4] for r in merged)
+            cross_bps = total_bps - local_bps
+            if args.json:
+                print(json.dumps({
+                    "nodes": {
+                        s.address: {
+                            "sampled": s.sampled,
+                            "evictions": s.evictions,
+                            "cross_bytes_per_s": s.cross_bytes_per_s,
+                        }
+                        for s in snapshots
+                    },
+                    "edges": [
+                        {
+                            "src": r[0],
+                            "dst": r[1],
+                            "bytes_per_s": r[2],
+                            "calls_per_s": r[3],
+                            "local_frac": r[4],
+                        }
+                        for r in top
+                    ],
+                    "total_bytes_per_s": total_bps,
+                    "local_bytes_per_s": local_bps,
+                    "cross_bytes_per_s": cross_bps,
+                }))
+            else:
+                header = (
+                    f"{'src':<28} {'dst':<28} {'bytes/s':>12} "
+                    f"{'calls/s':>10} {'local%':>7}"
+                )
+                print(header)
+                print("-" * len(header))
+                for r in top:
+                    print(
+                        f"{r[0]:<28} {r[1]:<28} {r[2]:>12.0f} "
+                        f"{r[3]:>10.1f} {r[4] * 100:>6.1f}%"
+                    )
+                print(
+                    f"[edges] {len(merged)} pair(s) from {len(snapshots)} "
+                    f"node(s); bytes/s local={local_bps:.0f} "
+                    f"cross={cross_bps:.0f}"
+                )
+            return 0 if snapshots else 1
         if args.cmd == "trace":
             from .spans import client_ring
 

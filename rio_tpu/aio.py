@@ -126,6 +126,7 @@ class ServerConnProtocol(asyncio.Protocol):
         "_out",
         "_flush_scheduled",
         "_spans",
+        "_affinity",
         "_ph_tick",
     )
 
@@ -138,6 +139,7 @@ class ServerConnProtocol(asyncio.Protocol):
         self._on_task = on_task
         self._service: Service | None = None
         self._spans = None  # SpanRing (resolved from the service at accept)
+        self._affinity = None  # EdgeSampler (TCP byte counters), same resolve
         self._ph_tick = -1  # 1-in-8 phase-clock stride for untraced traffic
         self._frames = FrameReader()
         # Inbound work: decoded envelopes / _BadFrame markers (batch-decode
@@ -164,6 +166,7 @@ class ServerConnProtocol(asyncio.Protocol):
         self._transport = transport  # type: ignore[assignment]
         self._service = self._service_factory()
         self._spans = getattr(self._service, "spans", None)
+        self._affinity = getattr(self._service, "affinity", None)
         self._worker = asyncio.ensure_future(self._run())
         if self._on_task is not None:
             self._on_task(self._worker)
@@ -190,6 +193,10 @@ class ServerConnProtocol(asyncio.Protocol):
         env._phases = ph
 
     def data_received(self, data: bytes) -> None:
+        if self._affinity is not None:
+            # Honest bytes-over-TCP ledger (bench --affinity numerator):
+            # raw socket reads, before any decode.
+            self._affinity.tcp_in_bytes += len(data)
         try:
             payloads = self._frames.feed(data)
         except SerializationError as e:
@@ -338,6 +345,8 @@ class ServerConnProtocol(asyncio.Protocol):
                 return
             try:
                 assert self._transport is not None
+                if self._affinity is not None:
+                    self._affinity.tcp_out_bytes += len(data)
                 self._transport.write(data)
             except Exception:
                 log.exception("response write error; dropping connection")
@@ -359,6 +368,8 @@ class ServerConnProtocol(asyncio.Protocol):
             return
         try:
             assert self._transport is not None
+            if self._affinity is not None:
+                self._affinity.tcp_out_bytes += len(data)
             self._transport.write(data)
         except Exception:
             log.exception("response write error; dropping connection")

@@ -112,6 +112,10 @@ class AdminCommandKind(Enum):
     # rio.Admin DumpSpans) this node's retained request spans. Old servers
     # answer the wire form with the clean unknown-kind AdminAck.
     DUMP_SPANS = "dump_spans"
+    # Communication-affinity edge graph: log (in-process) or return (wire,
+    # via rio.Admin DumpEdges) this node's sampled (src, dst) edge rates.
+    # Old servers answer the wire form with the clean unknown-kind AdminAck.
+    DUMP_EDGES = "dump_edges"
 
 
 @dataclasses.dataclass
@@ -165,6 +169,13 @@ class AdminCommand:
         return cls(AdminCommandKind.DUMP_SPANS)
 
     @classmethod
+    def dump_edges(cls) -> "AdminCommand":
+        """Log this node's sampled communication-affinity edges (the
+        in-process twin of the wire ``DumpEdges`` scrape served by
+        ``rio.Admin``)."""
+        return cls(AdminCommandKind.DUMP_EDGES)
+
+    @classmethod
     def migrate(cls, type_name: str, object_id: str, target: str) -> "AdminCommand":
         """Hand one locally-seated object to ``target`` through the full
         migration protocol (pin → deactivate → snapshot → flip → fence) —
@@ -195,6 +206,10 @@ class SendCommand:
     # its OWN context, so the sender's trace would otherwise die at the
     # queue boundary.
     trace_ctx: tuple | None = None
+    # The affinity source identity ("{type}.{id}" of the sending actor),
+    # snapshotted at enqueue for the same reason as trace_ctx. Rides the
+    # replayed RequestEnvelope in-process only — never the wire.
+    source: str = ""
 
 
 class InternalClientSender:
@@ -213,6 +228,7 @@ class InternalClientSender:
         self, handler_type: str, handler_id: str, message_type: str, payload: bytes
     ) -> bytes:
         """Enqueue a request and await the (serialized) response."""
+        from .affinity import current_source
         from .tracing import outbound_ctx
 
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
@@ -220,6 +236,7 @@ class InternalClientSender:
             SendCommand(
                 handler_type, handler_id, message_type, payload, fut,
                 trace_ctx=outbound_ctx(),
+                source=current_source(),
             )
         )
         return await fut

@@ -136,6 +136,18 @@ def default_rules(
             factor=solve_drift_factor,
             min_delta=5.0,  # ignore drift below 5 ms absolute
         ),
+        TrendRule(
+            name="cross_node_bytes_rising",
+            gauge="rio.affinity.cross_bytes_per_s",
+            kind="rising",
+            windows=windows,
+            # Jitter floor well above sampler noise: sustained growth in
+            # actor-to-actor bytes crossing TCP means placement has
+            # drifted away from the traffic pattern — time to feed the
+            # merged edge graph back into the solver (`admin edges`,
+            # set_edge_graph + rebalance).
+            min_delta=1024.0,
+        ),
     ]
 
 

@@ -393,6 +393,8 @@ class NativeServerTransport:
         # needs the handle before any worker has run).
         self._spans = None
         self._spans_resolved = False
+        # EdgeSampler (node-wide TCP byte counters), same lazy resolve.
+        self._affinity = None
         self._conns: dict[int, _ConnState] = {}
         self._workers: set[asyncio.Task] = set()
         self._loop: asyncio.AbstractEventLoop | None = None
@@ -426,6 +428,10 @@ class NativeServerTransport:
                 state.worker.add_done_callback(self._workers.discard)
                 self._conns[conn] = state
             elif ev_type == EV_FRAME:
+                if self._affinity is not None:
+                    # Frame payload + the 4-byte length prefix the engine
+                    # already consumed — matches what crossed TCP.
+                    self._affinity.tcp_in_bytes += len(data) + 4
                 state = self._conns.get(conn)
                 if state is not None:
                     if len(state.queue) >= _MAX_PENDING_FRAMES:
@@ -494,6 +500,7 @@ class NativeServerTransport:
         """
         q = state.resp_q
         spans = self._spans
+        affinity = self._affinity
         wave: list[bytes] = []  # coalesced frames awaiting one engine.send
         stamped: list = []  # (ph, env) pairs whose flush stamp awaits that send
         try:
@@ -515,6 +522,8 @@ class NativeServerTransport:
                             wave.append(frame)
                             stamped.append((ph, env))
                             continue
+                        if affinity is not None:
+                            affinity.tcp_out_bytes += len(frame)
                         self._engine.send(conn, frame)
                         ph.flush = _perf()
                         finish_request(spans, ph, env)
@@ -522,11 +531,14 @@ class NativeServerTransport:
                 if _EGRESS_COALESCE:
                     wave.append(frame)
                 else:
+                    if affinity is not None:
+                        affinity.tcp_out_bytes += len(frame)
                     self._engine.send(conn, frame)
             if wave:
-                self._engine.send(
-                    conn, wave[0] if len(wave) == 1 else b"".join(wave)
-                )
+                data = wave[0] if len(wave) == 1 else b"".join(wave)
+                if affinity is not None:
+                    affinity.tcp_out_bytes += len(data)
+                self._engine.send(conn, data)
                 if stamped:
                     t = _perf()
                     for ph, env in stamped:
@@ -589,6 +601,7 @@ class NativeServerTransport:
         if not self._spans_resolved:
             self._spans_resolved = True
             self._spans = getattr(service, "spans", None)
+            self._affinity = getattr(service, "affinity", None)
         loop = asyncio.get_running_loop()
         cancelled = False
         try:
@@ -649,6 +662,8 @@ class NativeServerTransport:
                             ph.handler_end = _perf()
                         if not state.broken:
                             frame = encode_response_frame(resp)
+                            if self._affinity is not None:
+                                self._affinity.tcp_out_bytes += len(frame)
                             if ph is None:
                                 self._engine.send(conn, frame)
                             else:

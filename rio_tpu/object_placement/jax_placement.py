@@ -27,6 +27,7 @@ service checks ``is_active`` before honoring a placement
 from __future__ import annotations
 
 import asyncio
+import logging
 import os
 import time
 from dataclasses import dataclass, field, replace
@@ -47,6 +48,8 @@ from ..ops import (
     sinkhorn,
 )
 from . import ObjectPlacement, ObjectPlacementItem, sanitize_standby_row
+
+log = logging.getLogger(__name__)
 
 _FEAT_DIM = 16  # hashed-identity feature width for the hierarchical mode
 
@@ -90,8 +93,23 @@ class AffinityTracker:
         tracker.observe(str(object_id), serving_address, weight=1.0)
     """
 
-    def __init__(self, dim: int = _FEAT_DIM, stickiness: float = 0.25) -> None:
+    def __init__(
+        self,
+        dim: int = _FEAT_DIM,
+        stickiness: float = 0.25,
+        max_objects: int = 262_144,
+    ) -> None:
         self.dim = dim
+        # Hard bound on per-object state (_obj warmth vectors, rate EMAs,
+        # state-bytes records): a high-cardinality workload — millions of
+        # one-shot actor ids — would otherwise grow the tracker without
+        # limit. fold_rates() enforces it by evicting the COLDEST entries
+        # (lowest folded req/sec; unknown rate counts as 0) down to the
+        # cap; the hottest objects, the only ones whose warmth can change
+        # a placement decision, always survive. ``evictions`` counts
+        # dropped entries for telemetry.
+        self.max_objects = int(max_objects)
+        self.evictions = 0
         # EMA coefficient toward the serving node's embedding per unit
         # weight; 0.0 disables learning.  The default keeps MULTI-node
         # warmth: with interleaved traffic the feature converges to the
@@ -136,6 +154,12 @@ class AffinityTracker:
             return
         target = self._node_vec(node_address)
         cur = self._obj.get(key)
+        if cur is None and len(self._obj) >= 2 * self.max_objects:
+            # Backstop when no LoadMonitor drives fold_rates(): force a
+            # fold (which evicts down to max_objects) before admitting a
+            # new key, so the tracker never exceeds 2x its cap.
+            self.fold_rates(min_dt=0.0)
+            cur = self._obj.get(key)
         if cur is None:
             # Cold object: blend from the same weak hashed-identity base
             # obj_features() would have used, so a low-weight stray request
@@ -184,6 +208,28 @@ class AffinityTracker:
         for k, cnt in window.items():
             rates[k] = beta * (cnt / dt)
         self._rates = rates
+        # Enforce the max_objects bound on every per-object map. Build
+        # fresh dicts and swap (solver thread reads concurrently); evict
+        # coldest-by-rate first so the warmth that matters survives.
+        for name in ("_obj", "_state_bytes"):
+            cur = getattr(self, name)
+            over = len(cur) - self.max_objects
+            if over <= 0:
+                continue
+            doomed = sorted(cur, key=lambda k: rates.get(k, 0.0))[:over]
+            kept = dict(cur)
+            for k in doomed:
+                del kept[k]
+            setattr(self, name, kept)
+            self.evictions += over
+        if len(rates) > self.max_objects:
+            over = len(rates) - self.max_objects
+            doomed = sorted(rates, key=rates.get)[:over]
+            kept_r = dict(rates)
+            for k in doomed:
+                del kept_r[k]
+            self._rates = kept_r
+            self.evictions += over
 
     def total_rate(self) -> float:
         return float(sum(self._rates.values()))
@@ -249,6 +295,13 @@ _HIER_CHUNK_ROWS = 524_288
 # in ~50 s and executes 10.5M in 2.6 s. The threshold is the largest
 # flat bucket actually proven on hardware.
 _FLAT_REBALANCE_MAX_ROWS = 1_048_576
+
+# Row cap for the affinity refine's subset solve: the communication graph
+# is top-K bounded per node (EdgeSampler), so the edge-touching object set
+# is small by construction; the cap is a second fence so a pathological
+# merged graph can never turn the post-solve refine into a directory-sized
+# dense problem. Heaviest-degree objects win the slots.
+_AFFINITY_MAX_ROWS = 4096
 
 
 def _next_bucket(n: int, minimum: int = 256) -> int:
@@ -698,6 +751,10 @@ class JaxObjectPlacement(ObjectPlacement):
         delta_threshold: float = 0.25,
         max_delta_solves: int = 8,
         delta_audit_ratio: float = 1.05,
+        affinity_weight: float = 0.0,
+        affinity_passes: int = 3,
+        affinity_host_factor: float = 0.5,
+        affinity_slack: float = 1.25,
     ) -> None:
         self._eps = eps
         self._n_iters = n_iters
@@ -762,6 +819,35 @@ class JaxObjectPlacement(ObjectPlacement):
         if object_costs is None and affinity_tracker is not None:
             object_costs = affinity_tracker.move_weights
         self._object_costs = object_costs
+        # Communication-graph refinement (rio_tpu/affinity): after every
+        # FULL solve, `affinity_passes` alternating linearized OT passes
+        # fold the current assignment's neighbor attraction into per-object
+        # cost rows and re-run the unchanged Sinkhorn core over the
+        # edge-touching subset. weight 0.0 (the default) disables the term
+        # entirely; the delta path never refines (its warm potentials
+        # assume the pure balance objective).
+        self._affinity_weight = float(affinity_weight)
+        self._affinity_passes = max(1, int(affinity_passes))
+        # Attraction credit for landing on a DIFFERENT worker shard of the
+        # same host (same address up to the ":port"): 0 = only exact
+        # co-seating counts, 1 = any same-host seat is as good as local.
+        # Intermediate values make the refine optimize at two
+        # granularities at once — node first, host second.
+        self._affinity_host_factor = min(1.0, max(0.0, affinity_host_factor))
+        # Column-capacity slack for the refine's subset solve. Strictly
+        # balanced capacities provably block the simplest win (two chatty
+        # objects on two equal nodes can never co-locate — either move
+        # overflows a node by one), so the refine may overfill a node by
+        # this factor; the acceptance check still rejects passes whose
+        # total objective (balance overflow + weighted cut) regresses.
+        self._affinity_slack = max(1.0, float(affinity_slack))
+        # (src, dst) -> normalized byte-rate weight, undirected keys with
+        # src < dst. Atomic-swap discipline: set_edge_graph builds a fresh
+        # dict, the solver thread snapshots the reference.
+        self._edge_graph: dict[tuple[str, str], float] = {}
+        # Per-refine pass history ([{pass, cut, total, accepted}, ...]) —
+        # the monotonicity evidence tests and telemetry read.
+        self._affinity_history: list[dict] = []
         # Host-mirrored directory: "{type}.{id}" -> node index.
         self._placements: dict[str, int] = {}
         # Replica rows: "{type}.{id}" -> (standby addresses, epoch). Kept by
@@ -1889,6 +1975,247 @@ class JaxObjectPlacement(ObjectPlacement):
         stale = bool(den > 0.0 and num > self._delta_audit_ratio * den)
         return out, g_new, coarse_new, d, stale, conv
 
+    # ------------------------------------------------ communication graph
+    def set_edge_graph(self, rows) -> int:
+        """Install the cluster-merged communication graph.
+
+        ``rows`` is the ``merge_edges`` shape (``[src, dst, bytes_per_s,
+        calls_per_s, local_frac]``, extra columns optional). Edges from
+        external clients (``src == "client"``) are dropped — a client
+        cannot be co-located, so attraction toward its traffic is
+        meaningless — as are self-edges and zero-rate rows. The remaining
+        edges are symmetrized (undirected key, rates summed), weighted as
+        bytes/s plus a per-call framing overhead, and normalized so the
+        heaviest edge is 1.0: the affinity_weight knob then has one unit
+        regardless of absolute traffic volume. Returns the edge count;
+        atomic swap, safe against a concurrent solver-thread read."""
+        edges: dict[tuple[str, str], float] = {}
+        for r in rows or ():
+            src, dst = str(r[0]), str(r[1])
+            if src == "client" or src == dst:
+                continue
+            bps = max(0.0, float(r[2]))
+            cps = max(0.0, float(r[3])) if len(r) > 3 else 0.0
+            # ~64 B of frame/header cost per call keeps pure-call-count
+            # chatter (tiny payloads, high rate) visible in the weight.
+            w = bps + 64.0 * cps
+            if w <= 0.0:
+                continue
+            key = (src, dst) if src < dst else (dst, src)
+            edges[key] = edges.get(key, 0.0) + w
+        if edges:
+            top = max(edges.values())
+            edges = {k: v / top for k, v in edges.items()}
+        self._edge_graph = edges
+        return len(edges)
+
+    def _affinity_refine(self, keys, assignment, node_order, cap, alive):
+        """Alternating linearized OT refinement over the edge graph.
+
+        Runs in the solver thread after a FULL solve. Each pass linearizes
+        the quadratic co-location objective around the current assignment:
+        an object's attraction to node ``a`` is the edge-weighted sum of
+        ``Hfac[a, seat(neighbor)]`` (1.0 same worker, host_factor same
+        host, 0.0 cross-host), folded into the per-object cost row as a
+        discount — so the unchanged Sinkhorn core (per-row gauge shift,
+        warm starts) solves it like any other dense problem. Only the
+        edge-touching subset is re-solved (capped at
+        ``_AFFINITY_MAX_ROWS`` heaviest, padded to a power-of-2 bucket for
+        compile reuse); everything else keeps its balance-optimal seat. A
+        pass is accepted only if BOTH the edge-cut transport cost and the
+        total objective (capacity overflow + weighted cut) are
+        non-increasing — the monotonicity the invariant tests pin.
+
+        Returns the refined assignment (np.int32, length n) or None when
+        the graph doesn't touch this directory / no pass was accepted.
+        """
+        edges = self._edge_graph  # atomic snapshot
+        w_aff = self._affinity_weight
+        n = len(keys)
+        key_ix = {k: i for i, k in enumerate(keys)}
+        ei: list[int] = []
+        ej: list[int] = []
+        ew: list[float] = []
+        for (a, b), w in edges.items():
+            ia = key_ix.get(a)
+            ib = key_ix.get(b)
+            if ia is None or ib is None:
+                continue
+            ei.append(ia)
+            ej.append(ib)
+            ew.append(w)
+        if not ei:
+            return None
+        # Symmetrize into directed arrays (each undirected edge twice) so
+        # one scatter-add accumulates every object's full neighborhood.
+        e_src = np.asarray(ei + ej, np.int64)
+        e_dst = np.asarray(ej + ei, np.int64)
+        e_w = np.asarray(ew + ew, np.float32)
+
+        cap_np = np.asarray(cap, np.float32)
+        alive_np = np.asarray(alive, np.float32)
+        m = cap_np.shape[0]
+        # Same-host structure: address up to the ":port" suffix identifies
+        # the host; padded (unregistered) columns get unique tokens so the
+        # host mask degenerates to the identity there.
+        hosts = [
+            node_order[i].rsplit(":", 1)[0] if i < len(node_order) else f"\x00pad{i}"
+            for i in range(m)
+        ]
+        host_id = np.asarray(
+            [list(dict.fromkeys(hosts)).index(h) for h in hosts], np.int64
+        )
+        hf = self._affinity_host_factor
+        same_host = (host_id[:, None] == host_id[None, :]).astype(np.float32)
+        hfac = hf * same_host
+        np.fill_diagonal(hfac, 1.0)
+        dist = 1.0 - hfac  # 0 same worker / (1-hf) same host / 1 cross
+
+        # Edge-touching subset, heaviest first when over the row cap.
+        deg = np.zeros((n,), np.float32)
+        np.add.at(deg, e_src, e_w)
+        sub = np.nonzero(deg > 0.0)[0]
+        if sub.size > _AFFINITY_MAX_ROWS:
+            sub = sub[np.argsort(-deg[sub], kind="stable")[:_AFFINITY_MAX_ROWS]]
+            sub = np.sort(sub)
+        s = int(sub.size)
+        pos = np.full((n,), -1, np.int64)
+        pos[sub] = np.arange(s)
+        in_sub = pos[e_src] >= 0
+        # Per-pass edge orientation. A simultaneous (Jacobi) update lets a
+        # chatty pair SWAP seats forever — each endpoint chases the
+        # other's pre-pass seat — so every pass anchors one endpoint per
+        # edge: even passes move the lighter-degree endpoint toward the
+        # heavier one (satellites join planets), odd passes reverse the
+        # orientation so anchors catch up to moved satellites. Degree
+        # ties break by index, keeping the orientation a strict total
+        # order per edge.
+        lighter = (deg[e_src] < deg[e_dst]) | (
+            (deg[e_src] == deg[e_dst]) & (e_src < e_dst)
+        )
+
+        # Balance base row (identical for every object, exactly the dense
+        # solve's cost model) and fair shares: each pass gives the mobile
+        # half a slackened residual capacity per node — what the slack-
+        # padded fair share leaves after every frozen seat is counted.
+        # The +1 covers integer granularity at small fair shares (with 2
+        # objects per node a 1.25x slack is less than one whole object,
+        # and no pair could ever co-locate).
+        base = np.asarray(
+            build_cost_matrix(jnp.zeros((m,), jnp.float32), cap, alive),
+            np.float32,
+        ).reshape(-1, m)[0]
+        cap_alive = cap_np * alive_np
+        fair = cap_alive / max(float(np.sum(cap_alive)), 1e-30) * n
+        slack_cap = fair * self._affinity_slack + 1.0
+        schedulable = (cap_alive > 0.0).astype(np.float64)
+
+        total_w = float(np.sum(e_w))
+
+        def _cut(seats: np.ndarray) -> float:
+            return float(np.sum(e_w * dist[seats[e_src], seats[e_dst]])) / max(
+                total_w, 1e-30
+            )
+
+        def _total(seats: np.ndarray) -> float:
+            counts = np.bincount(seats, minlength=m)
+            overflow = float(np.sum(np.maximum(counts - slack_cap, 0.0))) / n
+            return overflow + w_aff * _cut(seats)
+
+        seats = np.asarray(assignment, np.int32).copy()
+        history = [
+            {"pass": 0, "cut": _cut(seats), "total": _total(seats), "accepted": True}
+        ]
+        g_warm = None
+        accepted_any = False
+        for p in range(self._affinity_passes):
+            mask = in_sub & (lighter if p % 2 == 0 else ~lighter)
+            if not np.any(mask):
+                continue
+            # Only the mobile endpoints are re-solved this pass; anchors
+            # and everything outside the subset are frozen — their seats
+            # consume capacity but cannot be displaced (the failure mode
+            # of re-solving anchors is capacity pressure pushing them off
+            # the very seats their satellites are converging toward).
+            mobile = np.unique(e_src[mask])
+            sp = int(mobile.size)
+            pos_p = np.full((n,), -1, np.int64)
+            pos_p[mobile] = np.arange(sp)
+            attract = np.zeros((sp, m), np.float32)
+            np.add.at(
+                attract,
+                pos_p[e_src[mask]],
+                e_w[mask, None] * hfac[seats[e_dst[mask]]],
+            )
+            cost = np.broadcast_to(base, (sp, m)).copy()
+            cost -= w_aff * attract
+            # Stay-put discount at the object's current seat: a refine
+            # move still pays the state handoff.
+            cost[np.arange(sp), seats[mobile]] -= self._move_cost
+            frozen = np.bincount(seats, minlength=m).astype(np.float64)
+            frozen -= np.bincount(seats[mobile], minlength=m)
+            col_cap = np.maximum(slack_cap - frozen, 0.0) * schedulable
+            bucket = _next_bucket(sp)
+            mass = np.zeros((bucket,), np.float32)
+            mass[:sp] = 1.0
+            cost_p = np.zeros((bucket, m), np.float32)
+            cost_p[:sp] = cost
+            cost_j = jnp.asarray(cost_p)
+            f, g, _err = sinkhorn(
+                cost_j,
+                jnp.asarray(mass),
+                jnp.asarray(col_cap, jnp.float32),
+                eps=self._eps,
+                n_iters=self._n_iters,
+                g_init=g_warm,
+            )
+            g_warm = g  # warm-start the next linearization
+            new_seats = np.asarray(plan_rounded_assign(cost_j, f, g, self._eps))[
+                :sp
+            ]
+            # Any row the rounded plan could not seat on a live column
+            # keeps its current seat (mirrors _route_unseatable's intent
+            # without re-pricing the frozen rows).
+            old = seats[mobile]
+            bad = (
+                (new_seats < 0)
+                | (new_seats >= m)
+                | (alive_np[new_seats % m] <= 0.0)
+            )
+            new_seats = np.where(bad, old, new_seats).astype(np.int32)
+            # Integer capacity enforcement: the rounded plan's per-row
+            # argmax can overshoot a column (that's what _repair_exact
+            # fixes on the main path). Movers INTO each node are ranked
+            # by cost gain and truncated to the whole seats the residual
+            # actually has; the rest keep their current seat.
+            gain = (
+                cost[np.arange(sp), old] - cost[np.arange(sp), new_seats]
+            )
+            stayers = np.bincount(old[new_seats == old], minlength=m)
+            for c in np.unique(new_seats[new_seats != old]):
+                movers = np.nonzero((new_seats == c) & (old != c))[0]
+                allowed = int(max(0.0, np.floor(col_cap[c] - stayers[c])))
+                if movers.size > allowed:
+                    ranked = movers[np.argsort(-gain[movers], kind="stable")]
+                    new_seats[ranked[allowed:]] = old[ranked[allowed:]]
+            cand = seats.copy()
+            cand[mobile] = new_seats
+            c_cut, c_tot = _cut(cand), _total(cand)
+            ok = (
+                c_cut <= history[-1]["cut"] + 1e-9
+                and c_tot <= history[-1]["total"] + 1e-9
+            )
+            history.append(
+                {"pass": p + 1, "cut": c_cut, "total": c_tot, "accepted": ok}
+            )
+            if not ok:
+                break
+            if not np.array_equal(cand, seats):
+                accepted_any = True
+            seats = cand
+        self._affinity_history = history  # atomic swap (tests/telemetry)
+        return seats if accepted_any else None
+
     async def rebalance(
         self,
         *,
@@ -2296,6 +2623,23 @@ class JaxObjectPlacement(ObjectPlacement):
             out = _route_unseatable(
                 np.asarray(assignment)[:n], len(node_order), load, alive, cap
             )
+            # Communication-graph refinement (full solves only: the delta
+            # path returned above, and its warm potentials price pure
+            # balance). Runs on the already-routed assignment so the
+            # refine's stay-put baseline is a feasible seating.
+            if self._affinity_weight > 0.0 and self._edge_graph:
+                try:
+                    refined = self._affinity_refine(
+                        keys, out, node_order, cap, alive
+                    )
+                except Exception:  # noqa: BLE001 - refine must never kill a solve
+                    log.exception("affinity refine failed; keeping base solve")
+                    refined = None
+                if refined is not None:
+                    out = _route_unseatable(
+                        refined, len(node_order), load, alive, cap
+                    )
+                    solved_as = f"{solved_as}+affinity"
             solve_ms, conv = _conv_timing(conv, t0, c0)
             return out, g, coarse_g, solve_ms, solved_as, n, False, conv
 

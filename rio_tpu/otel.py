@@ -103,6 +103,11 @@ def server_gauges(server: Any) -> dict[str, float]:
     if spans is not None:
         # Request-waterfall span ring counters (rio.spans.*).
         gauges.update(spans.gauges())
+    affinity = getattr(server, "affinity", None)
+    if affinity is not None:
+        # Communication-edge sampler counters (rio.affinity.*): tracked
+        # edges, evictions, cross-node byte rate, raw TCP byte totals.
+        gauges.update(affinity.gauges())
     solve_stats = getattr(placement, "stats", None)
     history_gauges = getattr(solve_stats, "history_gauges", None)
     if history_gauges is not None:

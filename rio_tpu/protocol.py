@@ -40,6 +40,12 @@ class RequestEnvelope:
     # old decoders (which reject extra fields) never see it. The C++ codec
     # (native/rio_native.cc) mirrors both arities.
     trace_ctx: tuple[str, str, bool] | None = None
+    # In-process only — NEVER serialized (`to_bytes` below doesn't emit it,
+    # and the positional decode leaves it at the default). The affinity
+    # source identity of an internal server-to-self send ("{type}.{id}" of
+    # the sending actor); "" means the request arrived over TCP, i.e. from
+    # an external client or another node.
+    source: str = ""
 
     def to_bytes(self) -> bytes:
         tc = self.trace_ctx

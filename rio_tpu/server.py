@@ -116,6 +116,9 @@ class Server:
         spans: bool = True,
         spans_capacity: int = 2048,
         spans_slo_ms: float = 250.0,
+        affinity_sampler: bool = True,
+        affinity_stride: int = 8,
+        affinity_top_k: int = 512,
     ) -> None:
         if transport not in ("asyncio", "native", "auto"):
             raise ValueError(f"unknown transport {transport!r}")
@@ -266,6 +269,20 @@ class Server:
 
             self.spans = SpanRing(capacity=spans_capacity, slo_ms=spans_slo_ms)
             self.app_data.set(self.spans)
+        # Communication-edge sampler (rio_tpu/affinity): on by default —
+        # the dispatch path pays one stride-masked integer check per
+        # request (1-in-``affinity_stride`` sampled); the EMA fold rides
+        # the LoadMonitor loop. ``affinity_sampler=False`` removes even the
+        # check (the service resolves no sampler). Scraped cluster-wide via
+        # rio.Admin DumpEdges and fed to graph-aware placement.
+        self.affinity = None
+        if affinity_sampler:
+            from .affinity import EdgeSampler
+
+            self.affinity = EdgeSampler(
+                stride=affinity_stride, top_k=affinity_top_k
+            )
+            self.app_data.set(self.affinity)
         self.timeseries = None
         self.health_watch = None
         if timeseries and self.load_monitor is not None:
@@ -533,6 +550,7 @@ class Server:
                     env = RequestEnvelope(
                         c.handler_type, c.handler_id, c.message_type, c.payload,
                         c.trace_ctx,
+                        source=c.source,
                     )
                     resp = await self._service().call(env)
                     if not c.response.done():
@@ -618,6 +636,25 @@ class Server:
                             f"#{r.seq} {r.trace_id[:8]} {r.name} "
                             f"{r.attrs.get('handler', '?')} {r.duration_us}us"
                             for r in tail
+                        ),
+                    )
+            if cmd.kind == AdminCommandKind.DUMP_EDGES:
+                # In-process twin of the rio.Admin DumpEdges wire scrape:
+                # dump the hottest sampled communication edges to the log.
+                if self.affinity is None:
+                    log.info("%s: AdminCommand::DumpEdges (sampler off)",
+                             self._local_addr)
+                else:
+                    rows = self.affinity.edges(limit=16)
+                    log.info(
+                        "%s: AdminCommand::DumpEdges (%d tracked, %d sampled, "
+                        "%d evicted)\n%s",
+                        self._local_addr, len(self.affinity._edges),
+                        self.affinity.sampled, self.affinity.evictions,
+                        "\n".join(
+                            f"{src} -> {dst} {b:.0f} B/s {c:.1f} call/s "
+                            f"local={lf:.2f}"
+                            for src, dst, b, c, lf in rows
                         ),
                     )
             if cmd.kind == AdminCommandKind.MIGRATE_OBJECT:
@@ -784,6 +821,11 @@ class Server:
                         self.health_watch.tick()
 
                 self.load_monitor.tickers.append(_series_tick)
+            if self.affinity is not None:
+                # EMA fold rides the load loop — no new task; same
+                # isolation contract as every ticker (a failure is logged,
+                # sampling continues).
+                self.load_monitor.tickers.append(self.affinity.fold)
             tasks.append(asyncio.ensure_future(self.load_monitor.run()))
         if self.replication_manager is not None:
             tasks.append(asyncio.ensure_future(self.replication_manager.run()))

@@ -21,6 +21,7 @@ import asyncio
 import logging
 import time
 
+from .affinity import _SOURCE as _AFFINITY_SOURCE
 from .app_data import AppData
 from .cluster.storage import MembershipStorage
 from .commands import DispatchObserver, ServerDraining, ShardRouter
@@ -69,6 +70,13 @@ class Service:
         # observation hook (None for deployments without a tracker).
         observer = app_data.try_get(DispatchObserver)
         self._observe = observer.fn if observer is not None else None
+        from .affinity import EdgeSampler
+
+        # Communication-edge sampler (None when the sampler is off): the
+        # dispatch path records (source → served object) edges through it,
+        # and both transports read it off the service for their TCP byte
+        # counters — same resolve-once pattern as ``spans``.
+        self.affinity = app_data.try_get(EdgeSampler)
         from .migration import MigrationManager
 
         self._migrator = app_data.try_get(MigrationManager)
@@ -626,14 +634,55 @@ class Service:
             return ResponseEnvelope.err(start_err)
 
         try:
-            with span("handler_dispatch", object=object_id, msg=req.message_type):
-                body = await self.registry.send_raw(
-                    req.handler_type,
-                    req.handler_id,
-                    req.message_type,
-                    req.payload,
-                    self.app_data,
-                )
+            source_token = None
+            obj_key = None
+            if self.affinity is not None:
+                # Bind this actor's identity as the affinity source for any
+                # internal sends its handler issues (InternalClientSender
+                # snapshots it at enqueue, like trace_ctx) — so the edge
+                # graph sees actor→actor, not client→everything. The key
+                # string is built ONCE per request and shared with the edge
+                # observation and the tracker hook below — string churn on
+                # the skip path was the sampler's measurable overhead.
+                obj_key = f"{req.handler_type}.{req.handler_id}"
+                source_token = _AFFINITY_SOURCE.set(obj_key)
+            try:
+                with span("handler_dispatch", object=object_id, msg=req.message_type):
+                    body = await self.registry.send_raw(
+                        req.handler_type,
+                        req.handler_id,
+                        req.message_type,
+                        req.payload,
+                        self.app_data,
+                    )
+            finally:
+                if source_token is not None:
+                    _AFFINITY_SOURCE.reset(source_token)
+            if obj_key is not None and not self.registry.is_node_scoped(
+                req.handler_type
+            ):
+                # Record the (source → this object) edge (node-scoped
+                # control-plane actors are skipped — the solver can't move
+                # them, so their edges would only pollute the graph).
+                # Internal sends carry their source in-process
+                # (req.source); anything that arrived over TCP has none
+                # and is attributed to "client". The stride gate is
+                # INLINED (see EdgeSampler.observe_sampled): the skipped
+                # 7-in-8 path is one int add + mask + compare, with the
+                # exception guard and argument construction paid only on
+                # a sampling hit.
+                aff = self.affinity
+                aff._tick = tick = (aff._tick + 1) & aff._mask
+                if not tick:
+                    try:
+                        aff.observe_sampled(
+                            req.source or "client",
+                            obj_key,
+                            len(req.payload),
+                            bool(req.source),
+                        )
+                    except Exception:
+                        log.exception("affinity sampler failed")
             if self._observe is not None:
                 # Feed the affinity tracker: this node served this object
                 # (reference has no counterpart — placement there is random).
@@ -641,7 +690,12 @@ class Service:
                 # mistaken for a handler panic (which would deallocate a
                 # healthy object and fail an already-served request).
                 try:
-                    self._observe(f"{req.handler_type}.{req.handler_id}", self.address)
+                    self._observe(
+                        obj_key
+                        if obj_key is not None
+                        else f"{req.handler_type}.{req.handler_id}",
+                        self.address,
+                    )
                 except Exception:
                     log.exception("dispatch observer failed")
             if self._replication is not None and self.registry.is_replicated(

@@ -35,6 +35,7 @@ import time
 from typing import Any
 
 from .. import codec
+from ..affinity import EdgeSampler, sending_from
 from ..app_data import AppData
 from ..cluster.storage import MembershipStorage
 from ..errors import HandlerError
@@ -213,6 +214,10 @@ class StreamCursor(ServiceObject):
         # redelivery happens anyway.
         self._attempted = -1
         self.delivered = 0
+        # Targets whose seat turned out remote: skip the local-first probe
+        # for them until the next pump (seats move between pumps — the
+        # affinity solver's whole point — so the cache is pump-scoped).
+        self._remote: set[str] = set()
 
     def _parts(self) -> tuple[str, str, int]:
         s, g, p = self.id.split(CURSOR_SEP)
@@ -262,6 +267,7 @@ class StreamCursor(ServiceObject):
         committed = await storage.committed(stream, group, partition)
         total = 0
         stalled = False
+        self._remote.clear()  # re-probe seats once per pump
         while not stalled:
             records = await storage.read(stream, partition, committed, self.batch)
             if not records:
@@ -299,6 +305,15 @@ class StreamCursor(ServiceObject):
     ) -> bool:
         """Send one record; True when it counts as delivered.
 
+        Local-first: consumers seated on THIS node (or not seated at all —
+        the internal path self-assigns them beside their cursor) are
+        delivered through the in-server dispatch queue, never touching
+        TCP; only a Redirect (seated elsewhere) falls back to the cluster
+        client. Both paths stamp the cursor→consumer edge into the
+        affinity sampler (``sending_from`` on the local leg, an explicit
+        remote observation on the client leg) — the traffic the
+        graph-aware solver co-locates by.
+
         A typed application error from the consumer is a REJECTION —
         not delivered, the pump stalls and redelivery retries (ordered
         logs block on a poison record rather than skip it). Transport
@@ -315,10 +330,43 @@ class StreamCursor(ServiceObject):
             key=rec.key,
             attempt=attempt,
         )
+        src = f"{CURSOR_TYPE}.{self.id}"
+        if target_id not in self._remote:
+            try:
+                with sending_from(src):
+                    await ServiceObject.send(
+                        ctx, sub.target_type, target_id, delivery
+                    )
+                return True
+            except HandlerError as e:
+                if not str(e).startswith("REDIRECT"):
+                    log.warning(
+                        "delivery %s/%s@%d -> %s/%s failed: %r",
+                        rec.stream, rec.partition, rec.offset,
+                        sub.target_type, target_id, e,
+                    )
+                    return False
+                self._remote.add(target_id)  # seated elsewhere; go remote
+            except Exception as e:  # noqa: BLE001 — consumer rejected it
+                log.warning(
+                    "delivery %s/%s@%d -> %s/%s raised: %r",
+                    rec.stream, rec.partition, rec.offset,
+                    sub.target_type, target_id, e,
+                )
+                return False
         try:
             await self._delivery_client(ctx).send(
                 sub.target_type, target_id, delivery
             )
+            sampler = ctx.try_get(EdgeSampler)
+            if sampler is not None:
+                # Remote leg: the receiving node can't see our identity
+                # (source never rides the wire), so the edge is stamped
+                # sender-side.
+                sampler.observe(
+                    src, f"{sub.target_type}.{target_id}",
+                    len(rec.payload), False,
+                )
             return True
         except (HandlerError, OSError, asyncio.TimeoutError) as e:
             log.warning(

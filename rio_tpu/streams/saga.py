@@ -30,6 +30,7 @@ import logging
 from typing import Any
 
 from .. import codec
+from ..affinity import EdgeSampler, sending_from
 from ..app_data import AppData
 from ..cluster.storage import MembershipStorage
 from ..errors import HandlerError, StateNotFound
@@ -336,19 +337,37 @@ class SagaCoordinator(ServiceObject):
             release(token)
 
     async def _send_step(self, ctx: AppData, index: int, row: list, kind: str) -> None:
+        """Deliver one step, local-first (same pattern as the stream
+        cursor): a participant seated HERE — or unseated, which the
+        internal path self-assigns beside its coordinator — never touches
+        TCP; a Redirect falls back to the cluster client. Both legs stamp
+        the coordinator→participant edge into the affinity sampler. Error
+        shapes are identical on both paths (``_is_rejection`` triages
+        them), so retry/compensate semantics are unchanged."""
         mtype = row[_ATY] if kind == "action" else row[_CTY]
         payload = row[_APL] if kind == "action" else row[_CPL]
-        await self._delivery_client(ctx).send(
-            row[_HT],
-            row[_HID],
-            SagaStep(
-                saga_id=self.id,
-                step=index,
-                kind=kind,
-                message_type=mtype,
-                payload=bytes(payload),
-            ),
+        step = SagaStep(
+            saga_id=self.id,
+            step=index,
+            kind=kind,
+            message_type=mtype,
+            payload=bytes(payload),
         )
+        src = f"{SAGA_TYPE}.{self.id}"
+        try:
+            with sending_from(src):
+                await ServiceObject.send(ctx, row[_HT], row[_HID], step)
+            return
+        except HandlerError as e:
+            if not str(e).startswith("REDIRECT"):
+                raise
+        await self._delivery_client(ctx).send(row[_HT], row[_HID], step)
+        sampler = ctx.try_get(EdgeSampler)
+        if sampler is not None:
+            # Remote leg: stamped sender-side (source never rides the wire).
+            sampler.observe(
+                src, f"{row[_HT]}.{row[_HID]}", len(step.payload), False
+            )
 
     async def _finish(self, ctx: AppData) -> None:
         self._journal(ctx, self.record.status, steps=len(self.record.steps))

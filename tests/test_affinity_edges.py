@@ -1,0 +1,297 @@
+"""Affinity edge subsystem invariants: sampler, merge, tracker bound, refine.
+
+Three layers, one contract each:
+
+- ``EdgeSampler`` (rio_tpu/affinity): the stride gate stays unbiased, both
+  the window accumulator and the folded map stay bounded under key churn,
+  and the inlined hot-path gate in ``service.py`` matches ``observe``.
+- ``AffinityTracker`` (jax_placement): per-object state is hard-bounded at
+  ``max_objects`` even under a high-cardinality one-shot-id workload — the
+  regression this PR's memory satellite pins.
+- ``_affinity_refine``: the alternating linearized OT passes are
+  monotonically non-increasing on the edge-cut transport cost, and the
+  graph term survives cost ranges wide enough to underflow a global gauge
+  shift (the per-row shift contract ``test_scaling_sinkhorn.py`` pins for
+  the core, re-checked here THROUGH the refine path).
+"""
+
+import numpy as np
+import pytest
+
+from rio_tpu import ObjectId, ObjectPlacementItem
+from rio_tpu.affinity import EdgeSampler, current_source, merge_edges, sending_from
+from rio_tpu.object_placement.jax_placement import (
+    AffinityTracker,
+    JaxObjectPlacement,
+)
+
+# ---------------------------------------------------------------------------
+# EdgeSampler
+# ---------------------------------------------------------------------------
+
+
+def test_stride_gate_is_unbiased():
+    """1-in-stride sampling scaled by the stride reconstructs true totals."""
+    s = EdgeSampler(stride=4, min_fold_dt=0.0)
+    for _ in range(40):
+        s.observe("a", "b", 100, local=False)
+    assert s.sampled == 10  # tick starts at -1, so hit 1, 5, 9, ...
+    s.fold(now=s._fold_t + 1.0, force=True)
+    rows = s.edges()
+    assert len(rows) == 1
+    src, dst, bps, cps, lf = rows[0]
+    assert (src, dst) == ("a", "b")
+    # One 1 s window: EMA = beta * (total / dt) = 0.3 * 4000 bytes/s.
+    assert bps == pytest.approx(0.3 * 40 * 100, rel=1e-6)
+    assert cps == pytest.approx(0.3 * 40, rel=1e-6)
+    assert lf == 0.0
+
+
+def test_inlined_gate_matches_observe():
+    """service.py inlines the stride gate (`_tick = (tick+1) & _mask`) and
+    calls observe_sampled on the hit; drive both forms with the same
+    sequence and require identical sampler state."""
+    a = EdgeSampler(stride=8, min_fold_dt=0.0)
+    b = EdgeSampler(stride=8, min_fold_dt=0.0)
+    seq = [("x", "y", 64), ("y", "z", 256), ("x", "z", 32)] * 40
+    for src, dst, nb in seq:
+        a.observe(src, dst, nb, local=True)
+    for src, dst, nb in seq:  # the inlined form, verbatim from service.py
+        b._tick = tick = (b._tick + 1) & b._mask
+        if not tick:
+            b.observe_sampled(src, dst, nb, local=True)
+    assert a.sampled == b.sampled > 0
+    assert a._acc == b._acc
+
+
+def test_self_edges_and_stride_rounding():
+    s = EdgeSampler(stride=3)  # rounds up to 4
+    assert s.stride == 4
+    s.observe_sampled("a", "a", 1000, local=True)
+    assert s.sampled == 0 and not s._acc
+
+
+def test_accumulator_bounded_under_key_churn():
+    """A high-cardinality storm of one-shot edges must not grow the window
+    accumulator past 2x top_k between folds."""
+    s = EdgeSampler(stride=1, top_k=8)
+    for i in range(1000):
+        s.observe(f"src{i}", "dst", 100 + i, local=False)
+        assert len(s._acc) <= 16
+    assert s.evictions > 0
+
+
+def test_fold_keeps_hottest_topk():
+    s = EdgeSampler(stride=1, top_k=4, min_fold_dt=0.0)
+    for i in range(8):
+        s.observe(f"s{i}", "d", (i + 1) * 1000, local=False)
+    s.fold(now=s._fold_t + 1.0, force=True)
+    rows = s.edges()
+    assert len(rows) == 4
+    assert [r[0] for r in rows] == ["s7", "s6", "s5", "s4"]  # hottest survive
+    assert s.evictions == 4
+
+
+def test_ema_decay_prunes_cold_edges():
+    """An edge that stops sending decays geometrically and is dropped once
+    both rates fall below the floor — the folded map self-cleans."""
+    s = EdgeSampler(stride=1, min_fold_dt=0.0)
+    s.observe("a", "b", 10_000, local=False)
+    t = s._fold_t
+    s.fold(now=t + 1.0, force=True)
+    assert len(s._edges) == 1
+    for k in range(2, 80):
+        s.fold(now=t + float(k), force=True)
+        if not s._edges:
+            break
+    assert not s._edges, "cold edge never pruned"
+
+
+def test_local_frac_and_cross_bytes_split():
+    s = EdgeSampler(stride=1, min_fold_dt=0.0)
+    for _ in range(3):
+        s.observe("a", "b", 100, local=True)
+    s.observe("a", "b", 100, local=False)
+    s.fold(now=s._fold_t + 1.0, force=True)
+    (row,) = s.edges()
+    assert row[4] == pytest.approx(0.75, abs=1e-4)  # local_frac
+    # Only the non-local send counts toward the cross-node byte rate.
+    assert s.cross_bytes_per_s == pytest.approx(0.3 * 100, rel=1e-6)
+    g = s.gauges()
+    assert g["rio.affinity.edges"] == 1.0
+    assert g["rio.affinity.cross_bytes_per_s"] == pytest.approx(
+        s.cross_bytes_per_s, abs=1e-3
+    )
+    assert set(g) == {
+        "rio.affinity.edges",
+        "rio.affinity.evictions",
+        "rio.affinity.sampled",
+        "rio.affinity.cross_bytes_per_s",
+        "rio.affinity.tcp_in_bytes",
+        "rio.affinity.tcp_out_bytes",
+    }
+
+
+def test_merge_edges_sums_and_byte_weights_local_frac():
+    node_a = [["P.1", "C.1", 1000.0, 10.0, 0.0]]
+    node_b = [["P.1", "C.1", 3000.0, 30.0, 1.0], ["P.2", "C.2", 50.0, 1.0, 0.5]]
+    merged = merge_edges([node_a, node_b])
+    assert merged[0][:2] == ["P.1", "C.1"]
+    assert merged[0][2] == pytest.approx(4000.0)
+    assert merged[0][3] == pytest.approx(40.0)
+    assert merged[0][4] == pytest.approx(0.75)  # byte-weighted local_frac
+    # Wire contract: rows may grow trailing fields; extras are ignored.
+    grown = [r + ["future-field"] for r in node_b]
+    assert merge_edges([node_a, grown]) == merged
+
+
+def test_sending_from_nests_and_restores():
+    assert current_source() == ""
+    with sending_from("Stream.orders#cursor"):
+        assert current_source() == "Stream.orders#cursor"
+        with sending_from("Saga.s1"):
+            assert current_source() == "Saga.s1"
+        assert current_source() == "Stream.orders#cursor"
+    assert current_source() == ""
+
+
+# ---------------------------------------------------------------------------
+# AffinityTracker memory bound (high-cardinality regression)
+# ---------------------------------------------------------------------------
+
+
+def test_affinity_tracker_high_cardinality_stays_bounded():
+    """Millions of one-shot actor ids must not grow the tracker without
+    limit: per-object maps are hard-capped at 2x max_objects between folds
+    and at max_objects after one, with the hottest objects surviving."""
+    tracker = AffinityTracker(max_objects=64)
+    hot = [f"Hot.{i}" for i in range(8)]
+    for i in range(2000):  # one-shot id churn with sustained hot traffic
+        for k in hot:
+            tracker.observe(k, "10.0.0.1:5000", weight=1.0)
+        tracker.observe(f"OneShot.{i}", "10.0.0.2:5000", weight=1.0)
+        assert len(tracker._obj) <= 2 * 64
+    tracker.fold_rates(min_dt=0.0)
+    assert len(tracker._obj) <= 64
+    assert len(tracker._rates) <= 64
+    assert tracker.evictions > 0
+    # Eviction is coldest-first: the sustained-rate keys keep their warmth.
+    assert all(k in tracker._obj for k in hot)
+
+
+# ---------------------------------------------------------------------------
+# _affinity_refine solver invariants
+# ---------------------------------------------------------------------------
+
+N0 = "10.0.0.1:5000"
+N1 = "10.0.0.2:5000"
+
+
+async def _split_pairs_placement(pairs=8, **kw):
+    """Two nodes (distinct hosts), `pairs` chatty producer->consumer pairs
+    seated load-balanced but pair-split: only the graph term can justify a
+    move, never load-balancing luck."""
+    p = JaxObjectPlacement(node_axis_size=2, mode="greedy", **kw)
+    p.register_node(N0)
+    p.register_node(N1)
+    for i in range(pairs):
+        await p.update(
+            ObjectPlacementItem(ObjectId("P", str(i)), N0 if i % 2 else N1)
+        )
+        await p.update(
+            ObjectPlacementItem(ObjectId("C", str(i)), N1 if i % 2 else N0)
+        )
+    return p
+
+
+async def test_affinity_refine_passes_monotone_and_colocate():
+    """The acceptance contract of the alternating linearized passes: the
+    edge-cut transport cost is non-increasing over accepted passes, the
+    run is attributed in the solve stats, and every chatty pair lands
+    co-seated."""
+    pairs = 8
+    p = await _split_pairs_placement(
+        pairs, affinity_weight=2.0, affinity_host_factor=0.0
+    )
+    n = p.set_edge_graph(
+        [[f"P.{i}", f"C.{i}", 1000.0 + 10.0 * i, 10.0, 0.0] for i in range(pairs)]
+    )
+    assert n == pairs
+    moved = await p.rebalance(delta=False)
+    assert moved > 0
+    history = list(p._affinity_history)
+    accepted = [h for h in history if h["accepted"]]
+    assert accepted, history
+    for prev, cur in zip(accepted, accepted[1:]):
+        assert cur["cut"] <= prev["cut"] + 1e-6, history
+        assert cur["total"] <= prev["total"] + 1e-6, history
+    # The final accepted pass fully cleared the cut for this toy graph.
+    assert accepted[-1]["cut"] == pytest.approx(0.0, abs=1e-6)
+    assert "+affinity" in str(p.stats.mode)
+    for i in range(pairs):
+        a = await p.lookup(ObjectId("P", str(i)))
+        b = await p.lookup(ObjectId("C", str(i)))
+        assert a == b, (i, a, b)
+    # Balance survived the refine: the slack cap keeps both nodes seated.
+    counts = {}
+    for k, ix in p._placements.items():
+        counts[ix] = counts.get(ix, 0) + 1
+    assert max(counts.values()) <= pairs + 2, counts
+
+
+async def test_affinity_refine_survives_wide_cost_ranges():
+    """Per-row gauge shift THROUGH the graph term: a huge affinity weight
+    stretches the refined cost rows far past exp-underflow range for a
+    global shift (cost-range/eps >> 88). The refine must still converge,
+    keep every object seated on a real node, and co-locate the pairs."""
+    pairs = 6
+    p = await _split_pairs_placement(
+        pairs, affinity_weight=5000.0, affinity_host_factor=0.0
+    )
+    # Edge rates spanning 6 decades: normalization leaves weights down to
+    # 1e-6, so the weighted rows mix O(5000) and O(0.005) entries.
+    p.set_edge_graph(
+        [
+            [f"P.{i}", f"C.{i}", 10.0 ** (6 - i), 0.0, 0.0]
+            for i in range(pairs)
+        ]
+    )
+    await p.rebalance(delta=False)
+    seats = set()
+    for i in range(pairs):
+        a = await p.lookup(ObjectId("P", str(i)))
+        b = await p.lookup(ObjectId("C", str(i)))
+        assert a in (N0, N1) and b in (N0, N1)
+        seats.add(a)
+    # The heaviest pairs must have been pulled together despite the range;
+    # the featherweight tail may legally stay put (its gain is ~0).
+    for i in range(3):
+        a = await p.lookup(ObjectId("P", str(i)))
+        b = await p.lookup(ObjectId("C", str(i)))
+        assert a == b, (i, a, b)
+    assert p.count() == 2 * pairs
+
+
+async def test_affinity_refine_noop_without_matching_edges():
+    """A graph that references no directory key (and client-source rows,
+    which set_edge_graph drops) leaves the solve untouched: no history, no
+    moves, no "+affinity" attribution."""
+    p = await _split_pairs_placement(4, affinity_weight=2.0)
+    assert p.set_edge_graph([["client", "P.0", 9e9, 10.0, 0.0]]) == 0
+    p.set_edge_graph([["Ghost.a", "Ghost.b", 1000.0, 1.0, 0.0]])
+    before = dict(p._placements)
+    await p.rebalance(delta=False)
+    assert p._placements == before
+    assert not p._affinity_history
+    assert "+affinity" not in str(p.stats.mode)
+
+
+async def test_affinity_weight_zero_disables_refine():
+    p = await _split_pairs_placement(4)  # default affinity_weight=0.0
+    p.set_edge_graph([[f"P.{i}", f"C.{i}", 1000.0, 10.0, 0.0] for i in range(4)])
+    await p.rebalance(delta=False)
+    assert not p._affinity_history
+    # Pairs stay split: without the graph term there is no reason to move.
+    a = await p.lookup(ObjectId("P", "0"))
+    b = await p.lookup(ObjectId("C", "0"))
+    assert a != b

@@ -433,6 +433,49 @@ def test_streams_banks_to_cpu_sidecar_and_never_carries(tmp_path):
     assert "streams" not in tpu and "streams_carried" not in tpu
 
 
+def test_affinity_banks_to_cpu_sidecar_and_never_carries(tmp_path):
+    """The affinity placement A/B is a host stage: banked beside its own
+    session's host provenance, never carried into a later tpu bank (the
+    bytes ratio and the paired sampler-off/on ratio only mean anything
+    under that run's box weather)."""
+    stage = {
+        "tcp_bytes": {"blind": 1077981, "affinity": 93},
+        "bytes_ratio": 11591.2,
+        "pairs_colocated": 8,
+        "sampler": {"sampler_overhead_pct": 0.66},
+        "host": {"cpu_count": 4, "sched_affinity": [0, 1, 2, 3],
+                 "loadavg": [0.5, 0.4, 0.3]},
+    }
+    _write_detail(
+        {"solve_tier": {"platform": "cpu"}, "affinity": stage},
+        here=str(tmp_path),
+    )
+    banked = _read(tmp_path, "BENCH_DETAIL.cpu.json")
+    assert banked["affinity"] == stage
+    # A later tpu run must not inherit it.
+    _write_detail({"solve_tier": {"platform": "tpu"}}, here=str(tmp_path))
+    tpu = _read(tmp_path, "BENCH_DETAIL.tpu.json")
+    assert "affinity" not in tpu and "affinity_carried" not in tpu
+
+
+def test_committed_cpu_capture_banks_affinity_with_provenance():
+    """The repo's banked cpu sidecar carries the measured affinity A/B:
+    the ISSUE 17 bars on disk — bytes-over-TCP dropped >= 2x after the
+    edge-graph feedback, formerly cross-node delivery hops left the wire
+    span rings, and the dispatch-path sampler priced under the paired
+    off/on A/B — each stamped with the host conditions it ran under."""
+    committed = Path(__file__).resolve().parent.parent / "BENCH_DETAIL.cpu.json"
+    aff = json.loads(committed.read_text())["affinity"]
+    assert aff["bytes_ratio"] >= 2.0
+    assert aff["tcp_bytes"]["affinity"] < aff["tcp_bytes"]["blind"]
+    assert aff["delivery_wire_spans"]["blind"] > 0
+    assert aff["delivery_wire_spans"]["affinity"] == 0
+    assert aff["pairs_colocated"] == aff["partitions"]
+    assert "+affinity" in aff["solved_as"]
+    assert aff["sampler"]["sampled_on"] > 0
+    assert set(aff["host"]) == {"cpu_count", "sched_affinity", "loadavg"}
+
+
 def test_committed_cpu_capture_banks_streams_with_provenance():
     """The repo's banked cpu sidecar carries the measured streams A/B:
     both modes delivered every acked publish (zero loss on disk), and

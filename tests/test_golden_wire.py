@@ -551,3 +551,68 @@ def test_admin_unknown_kind_acked_not_crashed():
     assert isinstance(ack, AdminAck)
     assert ack.ok is False
     assert "dump_holograms" in ack.detail
+
+
+def test_dump_edges_frame_golden():
+    """Pin the rio.Admin edge-graph-scrape frames byte for byte.
+
+    DUMP_EDGES is the affinity plane's operator scrape (the ``edges`` CLI
+    and the placement feedback loop speak it to arbitrary-version nodes);
+    the request envelope and the EdgesSnapshot response — including the
+    positional edge row shape [src, dst, bytes_per_s, calls_per_s,
+    local_frac] — are a compatibility contract: rows may only ever GROW
+    by appending trailing fields (merge_edges reads by position and
+    ignores extras).
+    """
+    from rio_tpu import codec
+    from rio_tpu.admin import ADMIN_TYPE, DumpEdges, EdgesSnapshot
+    from rio_tpu.protocol import (
+        RequestEnvelope,
+        ResponseEnvelope,
+        encode_request_frame,
+        encode_response_frame,
+    )
+
+    request = encode_request_frame(
+        RequestEnvelope(
+            handler_type=ADMIN_TYPE,
+            handler_id="10.0.0.1:5000",
+            message_type="rio.DumpEdges",
+            payload=codec.serialize(DumpEdges(limit=64)),
+        )
+    )
+    snapshot = EdgesSnapshot(
+        address="10.0.0.1:5000",
+        rows=[
+            ["rio.StreamCursor.orders/fan", "Consumer.c1", 16384.0, 12.5, 1.0],
+            ["rio.Saga.ord-7", "Inventory.i9", 4096.0, 4.0, 0.0],
+            ["client", "Gateway.g1", 2048.0, 2.0, 0.0],
+        ],
+        sampled=640,
+        evictions=3,
+        cross_bytes_per_s=4096.0,
+    )
+    response = encode_response_frame(
+        ResponseEnvelope(body=codec.serialize(snapshot))
+    )
+
+    def hexdump(label: str, frame: bytes) -> list[str]:
+        lines = [f"== {label} ({len(frame)} bytes)"]
+        for off in range(0, len(frame), 16):
+            chunk = frame[off : off + 16]
+            lines.append(f"{off:04x}  {chunk.hex(' ')}")
+        return lines
+
+    text = "\n".join(hexdump("dump_edges.request", request)
+                     + hexdump("dump_edges.response", response)) + "\n"
+    _assert_golden("dump_edges_frames.txt", text)
+
+    back = codec.deserialize(codec.serialize(snapshot), EdgesSnapshot)
+    assert back.rows[0][0] == "rio.StreamCursor.orders/fan"
+    assert back.sampled == 640 and back.evictions == 3
+    # merge_edges reads rows positionally and tolerates extra trailing
+    # fields — the growth contract the golden pins.
+    from rio_tpu.affinity import merge_edges
+
+    merged = merge_edges([back.rows, [r + ["extra"] for r in back.rows]])
+    assert merged[0][2] == 2 * 16384.0

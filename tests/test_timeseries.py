@@ -310,11 +310,13 @@ def test_default_rules_cover_the_stock_alarm_set():
     assert names == {
         "p99_rising", "loop_lag_rising", "journal_dropped", "shed_rate",
         "residual_diverging", "storage_errors", "solve_ms_drift",
+        "cross_node_bytes_rising",
     }
     kinds = {r.name: r.kind for r in default_rules()}
     assert kinds["journal_dropped"] == "delta"
     assert kinds["storage_errors"] == "delta"
     assert kinds["solve_ms_drift"] == "drift"
+    assert kinds["cross_node_bytes_rising"] == "rising"
 
 
 def test_health_alert_defaults():
