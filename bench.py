@@ -1113,6 +1113,152 @@ def run_hier_tier(n_obj: int, deadline: float, platform: str = "tpu") -> None:
         sys.exit(EXIT_SOLVE_FAIL)
 
 
+def run_hier_mesh_ab_tier(n_obj: int, deadline: float) -> None:
+    """Child entry for the mesh x chunk vs chunked-only paired A/B.
+
+    ISSUE 18 evidence: at MATCHED N, solve once through the composed
+    ``mesh_chunked_hierarchical_assign_timed`` (8 virtual CPU devices x
+    65,536-row cells — the shape whose compile the composition pins) and
+    once through the single-chip ``chunked_hierarchical_assign_timed`` at
+    the production 524,288-row chunk shape, and report both arms' chunk
+    timings plus a sampled transport-cost ratio (mean best-minus-assigned
+    affinity regret over a fixed 65,536-row sample; the full N x M
+    affinity matrix would be tens of GB at the target scale).
+
+    Always a CPU child: ``force_cpu(8)`` pins the virtual mesh before any
+    backend touch, so this can run while the relay is wedged. TPU rungs
+    stay ``tpu_round.py``-owned.
+    """
+    _arm_watchdog(deadline, EXIT_WATCHDOG)
+    from rio_tpu.utils.jaxenv import force_cpu
+
+    force_cpu(8)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rio_tpu.parallel import make_mesh
+    from rio_tpu.parallel.hierarchical import (
+        chunked_hierarchical_assign_timed,
+        mesh_chunked_hierarchical_assign_timed,
+    )
+
+    d, m, g = 16, 1024, 32
+    n_shards, cell, chunk_rows = 8, 65_536, 524_288
+    assert n_obj % (n_shards * cell) == 0 and n_obj % chunk_rows == 0, n_obj
+    mesh_chunks = n_obj // (n_shards * cell)
+    host_chunks = n_obj // chunk_rows
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(18))
+    obj_feat = jax.random.normal(k1, (n_obj, d), jnp.float32)
+    node_feat = jax.random.normal(k2, (d, m), jnp.float32) * 0.2
+    cap = jnp.ones((m,), jnp.float32)
+    alive = jnp.ones((m,), jnp.float32)
+    mesh = make_mesh(jax.devices()[:n_shards])
+    # Drain the async feature-generation chain before either arm's wall
+    # timer starts: O(N) pending RNG work would otherwise land in the
+    # FIRST arm's wall/first-chunk numbers only, skewing the paired A/B.
+    jax.block_until_ready((obj_feat, node_feat))
+
+    def arm(fn, **kw):
+        t0 = time.perf_counter()
+        res, chunk_ms = fn(obj_feat, node_feat, cap, alive, n_groups=g, **kw)
+        jax.block_until_ready(res.assignment)
+        wall = time.perf_counter() - t0
+        steady = (
+            round(float(np.median(np.asarray(chunk_ms[1:]))), 3)
+            if len(chunk_ms) > 1 else None
+        )
+        stats = {
+            "n_chunks": len(chunk_ms),
+            "first_chunk_ms": chunk_ms[0],
+            "steady_chunk_ms": steady,
+            "wall_s": round(wall, 2),
+            "rate": round(n_obj / wall),
+            "overflow": int(res.overflow),
+            "chunk_ms": chunk_ms,
+        }
+        return np.asarray(res.assignment), stats
+
+    a_mesh, mesh_stats = arm(
+        lambda *a, **kw: mesh_chunked_hierarchical_assign_timed(mesh, *a, **kw),
+        n_chunks=mesh_chunks,
+    )
+    a_chunk, chunk_stats = arm(
+        chunked_hierarchical_assign_timed, n_chunks=host_chunks
+    )
+
+    idx = np.arange(0, n_obj, max(1, n_obj // 65_536))[:65_536]
+    on_s = np.asarray(obj_feat[idx] @ node_feat)
+    best = on_s.max(axis=1)
+    rows = np.arange(len(idx))
+    cost_mesh = float(np.mean(best - on_s[rows, a_mesh[idx]]))
+    cost_chunk = float(np.mean(best - on_s[rows, a_chunk[idx]]))
+    result = {
+        "ok": True,
+        "kind": "hier_mesh_ab",
+        "n_obj": n_obj,
+        "n_nodes": m,
+        "n_groups": g,
+        "devices": n_shards,
+        "cell_rows": cell,
+        "mesh_chunk": mesh_stats,
+        "chunked_only": chunk_stats,
+        "transport_cost": {
+            "mesh_chunk": round(cost_mesh, 5),
+            "chunked_only": round(cost_chunk, 5),
+            "ratio": round(cost_mesh / max(cost_chunk, 1e-12), 4),
+        },
+    }
+    print(json.dumps(result), flush=True)
+
+
+def hier_mesh_ab(n_obj: int = 2_097_152, deadline: float = 900.0) -> dict:
+    """Paired mesh x chunk vs chunked-only A/B at matched N (host stage).
+
+    Runs in a CPU child (``JAX_PLATFORMS=cpu`` + 8 virtual devices, axon
+    sitecustomize bypassed) so the orchestrator's backend state and the
+    relay are never touched — banked into the cpu sidecar under host
+    provenance like every host stage, never carried into a tpu bank.
+    """
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ""
+    cmd = [
+        sys.executable, os.path.abspath(__file__),
+        "--hier", "--mesh-ab", "--tier", str(n_obj),
+        "--platform", "cpu", "--deadline", str(deadline),
+    ]
+    proc = subprocess.run(
+        cmd, stdout=subprocess.PIPE, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        timeout=deadline + 60,
+    )
+    parsed = None
+    for line in proc.stdout.decode(errors="replace").strip().splitlines():
+        try:
+            candidate = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(candidate, dict) and candidate.get("ok"):
+            parsed = candidate
+    if parsed is None:
+        raise RuntimeError(f"hier mesh A/B child failed (rc={proc.returncode})")
+    parsed.pop("ok", None)
+    parsed.pop("kind", None)
+    parsed["host"] = _host_provenance()
+    print(
+        f"# hier mesh A/B ({parsed['n_obj']} x {parsed['n_nodes']}): "
+        f"mesh x chunk first-chunk {parsed['mesh_chunk']['first_chunk_ms']} ms "
+        f"/ wall {parsed['mesh_chunk']['wall_s']} s vs chunked-only "
+        f"first-chunk {parsed['chunked_only']['first_chunk_ms']} ms / wall "
+        f"{parsed['chunked_only']['wall_s']} s; transport-cost ratio "
+        f"{parsed['transport_cost']['ratio']}",
+        file=sys.stderr,
+    )
+    return parsed
+
+
 def run_collapsed_tier(n_obj: int, platform: str, deadline: float) -> None:
     """Child entry for the collapsed-rebalance (fast path) + warm tiers.
 
@@ -2492,6 +2638,10 @@ def main() -> None:
     except Exception as e:
         print(f"# affinity payoff failed: {e!r}", file=sys.stderr)
     try:
+        detail["hier_mesh_ab"] = hier_mesh_ab()
+    except Exception as e:
+        print(f"# hier mesh A/B failed: {e!r}", file=sys.stderr)
+    try:
         detail["scaled_routing"] = scaled_route_hops()
     except Exception as e:
         print(f"# scaled routing failed: {e!r}", file=sys.stderr)
@@ -2629,6 +2779,10 @@ if __name__ == "__main__":
     parser.add_argument("--platform", choices=("tpu", "cpu"), default="tpu")
     parser.add_argument("--deadline", type=float, default=300.0)
     parser.add_argument("--hier", action="store_true")
+    # Child-side marker for the mesh x chunk vs chunked-only paired A/B
+    # (parents spawn it via `--hier --mesh-ab --tier N`); `--hier` with no
+    # --tier runs the parent stage and banks into the cpu sidecar.
+    parser.add_argument("--mesh-ab", action="store_true")
     parser.add_argument("--collapsed", action="store_true")
     # Churn-reaction A/B (full vs delta rebalance). Works without --tier
     # (defaults to the 1M x 64 acceptance shape); CPU rehearsal:
@@ -2805,6 +2959,26 @@ if __name__ == "__main__":
         print(json.dumps(out))
     elif args.delta:
         run_delta_tier(args.tier or 1_048_576, args.platform, args.deadline)
+    elif args.mesh_ab and args.tier is not None:
+        run_hier_mesh_ab_tier(args.tier, args.deadline)
+    elif args.hier and args.tier is None:
+        # Standalone `--hier` (no --tier) runs the ISSUE 18 mesh x chunk
+        # vs chunked-only paired A/B and updates the banked cpu sidecar in
+        # place (the --affinity pattern); the measurement itself runs in a
+        # CPU child, so this is safe while the relay is wedged.
+        _pin_orchestrator_to_cpu()
+        out = hier_mesh_ab()
+        here = os.path.dirname(os.path.abspath(__file__))
+        try:
+            with open(os.path.join(here, "BENCH_DETAIL.cpu.json")) as fh:
+                detail = json.load(fh)
+            if not isinstance(detail, dict):
+                detail = {}
+        except (OSError, ValueError):
+            detail = {}
+        detail["hier_mesh_ab"] = out
+        _write_detail(detail, here)
+        print(json.dumps(out))
     elif args.tier is not None and args.hier:
         run_hier_tier(args.tier, args.deadline, args.platform)
     elif args.tier is not None and args.collapsed:

@@ -280,12 +280,16 @@ def _profiler_trace(name: str):
         return contextlib.nullcontext()
 
 
-# Single-chip hierarchical solves chunk the object axis above this row
-# count (power of two, so it divides every larger po2 bucket): the TPU
-# backend's compile time is superlinear in the flat row count while the
-# chunked lax.map body compiles once at the chunk shape. See
-# parallel/hierarchical.py chunked_hierarchical_assign.
-_HIER_CHUNK_ROWS = 524_288
+# Hierarchical solves chunk the object axis above this row count (power
+# of two, so it divides every larger po2 bucket): the TPU backend's
+# compile time is superlinear in the flat row count while the chunked
+# lax.map body compiles once at the chunk shape. On a mesh the bound
+# applies PER DEVICE — devices divide the rows first, chunks divide each
+# device's slice (parallel/hierarchical.py mesh_chunked_hierarchical_
+# assign); on a single chip it bounds the lax.map chunk directly.
+# RIO_TPU_HIER_CHUNK_ROWS overrides (po2; CI smokes use a tiny value to
+# exercise the composed dispatch at test shapes in seconds).
+_HIER_CHUNK_ROWS = int(os.environ.get("RIO_TPU_HIER_CHUNK_ROWS") or 524_288)
 
 # Flat (collapsed) OT rebalances above this many padded rows route through
 # the hierarchical solve instead: the TPU backend's compile time for the
@@ -293,8 +297,13 @@ _HIER_CHUNK_ROWS = 524_288
 # 10.5M nor 4.2M rows finished a 900 s compile budget (v5e, 2026-07-31)
 # while 1M compiles in ~80 s — and the chunked two-level solve compiles
 # in ~50 s and executes 10.5M in 2.6 s. The threshold is the largest
-# flat bucket actually proven on hardware.
-_FLAT_REBALANCE_MAX_ROWS = 1_048_576
+# flat bucket actually proven on hardware; on a mesh it applies to the
+# per-shard row count, and the routed re-solve lands on the mesh x chunk
+# composed path (never a giant flat compile per shard).
+# RIO_TPU_FLAT_REBALANCE_MAX_ROWS overrides (CI smoke knob).
+_FLAT_REBALANCE_MAX_ROWS = int(
+    os.environ.get("RIO_TPU_FLAT_REBALANCE_MAX_ROWS") or 1_048_576
+)
 
 # Row cap for the affinity refine's subset solve: the communication graph
 # is top-K bounded per node (EdgeSampler), so the edge-touching object set
@@ -313,6 +322,35 @@ def _next_bucket(n: int, minimum: int = 256) -> int:
 
 
 import functools as _functools
+
+
+# Key-chunk size for the streamed obj_feat builder: the feature hook is
+# called on bounded slices and rows land straight in the preallocated
+# final block, so host peak stays O(n_pad x d) + one chunk instead of the
+# 3x the old build-pull-concat pipeline materialized at 10M+ rows.
+_OBJ_FEAT_STREAM_ROWS = int(
+    os.environ.get("RIO_TPU_OBJ_FEAT_STREAM_ROWS") or 262_144
+)
+
+
+def _hier_feature_dtype() -> np.dtype:
+    """Host dtype for the streamed feature block.
+
+    ``RIO_TPU_HIER_FEAT_BF16=1`` stores features as bfloat16 (``ml_dtypes``
+    ships with jax) — half the host memory and half the host->device bytes
+    at 10M+ rows. The solve upcasts to fp32 on device, so only feature
+    PRECISION is traded (8-bit mantissa): fine for the default hashed
+    identity features (quality parity pinned in tests), but keep fp32 for
+    custom feature hooks that encode small learned differences.
+    """
+    if os.environ.get("RIO_TPU_HIER_FEAT_BF16", "0") not in ("", "0"):
+        try:
+            import ml_dtypes
+
+            return np.dtype(ml_dtypes.bfloat16)
+        except Exception:  # pragma: no cover - ml_dtypes rides with jax
+            pass
+    return np.dtype(np.float32)
 
 
 @_functools.lru_cache(maxsize=8)
@@ -475,6 +513,7 @@ def _conv_fields(conv: dict | None) -> dict:
         "exec_ms": float(conv.get("exec_ms", -1.0)),
         "chunks": int(conv.get("chunks", 0)),
         "chunk_ms": [float(x) for x in conv.get("chunk_ms", ())],
+        "devices": int(conv.get("devices", 0)),
     }
 
 
@@ -686,6 +725,10 @@ class SolveStats:
     exec_ms: float = -1.0  # solve_ms minus compile_ms
     chunks: int = 0  # chunked-hierarchical chunk count (0 = unchunked)
     chunk_ms: list = field(default_factory=list)  # per-chunk wall ms
+    # Mesh devices the solve sharded over: 1 = single-chip hierarchical,
+    # 0 = not a hierarchical solve. chunks x devices is the cell count of
+    # a mesh x chunk composed solve (mode suffix "+mesh_chunk").
+    devices: int = 0
     # Bounded record of prior completed solves (most recent last, each with
     # an empty history of its own) — lets the daemon/operators see churn
     # cadence and whether solve/apply cost or move counts drift over time.
@@ -729,6 +772,32 @@ class SolveStats:
         compiles = [float(s.compile_ms) for s in window if s.compile_ms >= 0.0]
         if compiles:
             out["rio.placement_solve.history.compile_ms_total"] = sum(compiles)
+        # Composed-path attribution (mesh x chunk): how wide the last
+        # hierarchical solves ran, and the first-chunk dispatch cost — the
+        # first chunk carries any fresh compile, so a FLAT first_chunk_ms
+        # across growing directories is the compile-pinning invariant made
+        # scrapeable (rising = the jit cache stopped covering the shape).
+        chunked = [s for s in window if int(s.chunks) > 0]
+        if chunked:
+            out["rio.placement_solve.history.chunks_last"] = float(
+                chunked[-1].chunks
+            )
+            out["rio.placement_solve.history.chunks_max"] = float(
+                max(int(s.chunks) for s in chunked)
+            )
+        meshed = [s for s in window if int(getattr(s, "devices", 0)) > 0]
+        if meshed:
+            out["rio.placement_solve.history.devices_last"] = float(
+                meshed[-1].devices
+            )
+        first_chunks = [float(s.chunk_ms[0]) for s in window if s.chunk_ms]
+        if first_chunks:
+            out["rio.placement_solve.history.first_chunk_ms_last"] = (
+                first_chunks[-1]
+            )
+            out["rio.placement_solve.history.first_chunk_ms_max"] = max(
+                first_chunks
+            )
         return out
 
 
@@ -1409,6 +1478,81 @@ class JaxObjectPlacement(ObjectPlacement):
             self._nodes[self._node_order[idx]].load += 1.0
         self._epoch += 1
 
+    def _build_obj_feat(
+        self, keys: list[str], n_pad: int, node_order: list[str],
+        cur_idx, move_cost: float, move_w,
+    ) -> np.ndarray:
+        """Streamed (n_pad, d) object-feature block for a hierarchical solve.
+
+        The old pipeline materialized three full-size intermediates at once
+        (raw features, the stay-put pull, and the padded concat) — 1.9 GB
+        of throwaway peak at 10M x 16 fp32. This builder preallocates the
+        FINAL block once and fills it in bounded key-chunks
+        (``_OBJ_FEAT_STREAM_ROWS``): per chunk it calls the feature hook,
+        sanitizes, applies the stay-put pull, and writes rows in place —
+        peak is the output plus one chunk. ``RIO_TPU_HIER_FEAT_BF16=1``
+        stores the block in bfloat16 (:func:`_hier_feature_dtype`).
+
+        Sanitize is load-bearing, not belt-and-braces: measured load
+        vectors reach the solver only through ``ClusterLoadView``'s
+        sanitization, but feature hooks are user code with no such gate —
+        one NaN row would propagate through the coarse cost std and
+        poison EVERY object's normalized cost. Non-finite entries become
+        0.0 (a zero feature row still spreads correctly under the
+        capacity marginals; copy-on-write, since the hook may hand us its
+        internal buffer).
+
+        Pad rows (``n_pad - n``: po2 bucket padding plus the mesh's
+        shard-multiple round-up) come from the cached deterministic block
+        — they ride the solve as ordinary rows and are sliced off by the
+        caller's ``[:n]``.
+        """
+        n = len(keys)
+        dtype = _hier_feature_dtype()
+        node_emb = None
+        seat = None
+        if move_cost > 0.0 and cur_idx is not None and node_order:
+            # Stay-put pull for routed flat-mode solves (see
+            # _hierarchical_solve docstring). Node embeddings are unit
+            # vectors; cross-affinities of random unit vectors are
+            # ~1/sqrt(d) noise, so adding move_cost of the current seat's
+            # embedding raises the seat's affinity by ~move_cost relative
+            # to everywhere else — the feature-space analog of the flat
+            # path's stay-put diagonal discount.
+            node_emb = np.asarray(self._node_features(node_order), np.float32)
+            seat = np.asarray(cur_idx, np.int64)
+        out: np.ndarray | None = None
+        step = max(1, _OBJ_FEAT_STREAM_ROWS)
+        for start in range(0, n, step):
+            chunk_keys = keys[start : start + step]
+            feats = np.asarray(self._obj_features(chunk_keys), np.float32)
+            if not np.isfinite(feats).all():
+                feats = np.nan_to_num(feats, nan=0.0, posinf=0.0, neginf=0.0)
+            if out is None:
+                out = np.empty((n_pad, feats.shape[1]), dtype)
+            if node_emb is not None:
+                s = seat[start : start + len(chunk_keys)]
+                seated = (s >= 0) & (s < len(node_order))
+                pull = np.zeros_like(feats)
+                pull[seated] = node_emb[s[seated]]
+                if move_w is not None:
+                    # Per-object move prices (object_costs): a hot/heavy
+                    # actor's pull toward its current seat scales with its
+                    # measured weight, mirroring the dense path's scaled
+                    # stay-put discount.
+                    pull *= np.asarray(
+                        move_w[start : start + len(chunk_keys)], np.float32
+                    )[:, None]
+                feats = feats + np.float32(move_cost) * pull
+            out[start : start + len(chunk_keys)] = feats
+        if out is None:  # empty directory: shape from the hook's contract
+            probe = np.asarray(self._obj_features([]), np.float32)
+            d = probe.shape[1] if probe.ndim == 2 else _FEAT_DIM
+            out = np.empty((n_pad, d), dtype)
+        if n_pad > n:
+            out[n:] = _pad_feature_block(n_pad - n, out.shape[1])
+        return out
+
     def _hierarchical_solve(
         self, keys: list[str], node_order: list[str], cap, alive,
         cur_idx=None, move_cost: float = 0.0, move_w=None,
@@ -1437,10 +1581,15 @@ class JaxObjectPlacement(ObjectPlacement):
         this solve's group count. Returns ``(assignment, g, coarse_g,
         conv)``: the flat node potentials are always None here (the
         two-level solve produces group potentials instead), ``coarse_g``
-        is the coarse stage's (n_groups,) potentials — None on the
-        sharded path — and ``conv`` is the convergence record
-        (iterations, residual, warm ratio, per-chunk timings) SolveStats
-        surfaces.
+        is the coarse stage's (n_groups,) potentials — on the mesh paths
+        the pmean across shards, replicated (each shard solves the same
+        capacity proportions, so the mean is a valid seed) — and ``conv``
+        is the convergence record (iterations, residual, warm ratio,
+        chunk/device fan-out, per-chunk timings) SolveStats surfaces.
+        Dispatch composes both scale mechanisms: mesh devices divide the
+        rows first, then per-device chunking bounds what one body
+        compiles (conv gains ``mode_suffix="+mesh_chunk"`` when both are
+        active, surfaced in ``SolveStats.mode``).
         """
         from ..parallel.hierarchical import hierarchical_assign
 
@@ -1479,58 +1628,52 @@ class JaxObjectPlacement(ObjectPlacement):
         # mint a fresh static `bucket` per capacity/liveness change).
         live_cap = (cap_np * alive_np).reshape(n_groups, group_size).sum(axis=1)
         share = live_cap.max() / max(live_cap.sum(), 1e-9)
-        # Chunk the object axis above _HIER_CHUNK_ROWS (single-chip path
-        # only; the mesh path already bounds per-device shapes by
-        # sharding). The TPU backend's compile is superlinear in the flat
-        # row count (v5e: 50 s at 655k, 599 s at 2.6M) — lax.map over
-        # fixed po2 chunks pins compile to the chunk shape. The po2 chunk
-        # divides every po2 bucket_n above it, so n_chunks stays exact.
-        n_chunks = (
-            bucket_n // _HIER_CHUNK_ROWS
-            if self._mesh is None and bucket_n > _HIER_CHUNK_ROWS
-            else 1
-        )
-        # Fine-stage bucket sized from PER-CHUNK rows (each chunk solves
-        # 1/n_chunks of the population against 1/n_chunks capacity).
+        # Chunk the object axis above _HIER_CHUNK_ROWS — on BOTH paths.
+        # The TPU backend's compile is superlinear in the flat row count
+        # (v5e: 50 s at 655k, 599 s at 2.6M), and a mesh only divides the
+        # rows by the device count before each shard compiles its flat
+        # body, hitting the same wall one octave later. So devices divide
+        # first (n_pad -> per_dev), then lax.map chunking bounds what one
+        # body actually compiles at: mesh and chunks COMPOSE
+        # (mesh_chunked_hierarchical_assign) instead of excluding each
+        # other. Doubling n_chunks while halves stay exact keeps every
+        # shape static for any po2 bucket and chunk-row override.
+        n_shards = 1 if self._mesh is None else int(self._mesh.devices.size)
+        n_pad = -(-bucket_n // n_shards) * n_shards
+        per_dev = n_pad // n_shards
+        n_chunks = 1
+        while (
+            per_dev // n_chunks > _HIER_CHUNK_ROWS
+            and (per_dev // n_chunks) % 2 == 0
+        ):
+            n_chunks *= 2
+        # Fine-stage bucket sized from PER-CELL rows: each (device, chunk)
+        # cell solves 1/(n_shards*n_chunks) of the population against the
+        # same fraction of every node's capacity.
+        rows_cell = per_dev // n_chunks
         bucket_sz = _next_bucket(
-            max(8, int(1.3 * (bucket_n // n_chunks) * float(share))), minimum=8
+            max(8, int(1.3 * rows_cell * float(share))), minimum=8
         )
 
-        obj_feat = np.asarray(self._obj_features(keys), np.float32)
+        obj_feat = self._build_obj_feat(
+            keys, n_pad, node_order, cur_idx, move_cost, move_w
+        )
         d_feat = obj_feat.shape[1]
-        if move_cost > 0.0 and cur_idx is not None and node_order:
-            # Stay-put pull for routed flat-mode solves (see docstring).
-            # Node embeddings are unit vectors; cross-affinities of random
-            # unit vectors are ~1/sqrt(d) noise, so adding move_cost of the
-            # current seat's embedding raises the seat's affinity by
-            # ~move_cost relative to everywhere else — the feature-space
-            # analog of the flat path's stay-put diagonal discount.
-            node_emb = np.asarray(self._node_features(node_order), np.float32)
-            seat = np.asarray(cur_idx, np.int64)
-            seated = (seat >= 0) & (seat < len(node_order))
-            pull = np.zeros_like(obj_feat)
-            pull[seated] = node_emb[seat[seated]]
-            if move_w is not None:
-                # Per-object move prices (object_costs): a hot/heavy
-                # actor's pull toward its current seat scales with its
-                # measured weight, mirroring the dense path's scaled
-                # stay-put discount.
-                pull = pull * np.asarray(move_w, np.float32)[:, None]
-            obj_feat = obj_feat + np.float32(move_cost) * pull
-        if bucket_n != n:
-            obj_feat = np.concatenate(
-                [obj_feat, _pad_feature_block(bucket_n - n, d_feat)]
-            )
         node_feat = np.zeros((d_feat, m), np.float32)
         if node_order:
             nf = np.asarray(self._node_features(node_order), np.float32)
             assert nf.shape[1] == d_feat, (
                 f"node feature dim {nf.shape[1]} != object feature dim {d_feat}"
             )
+            if not np.isfinite(nf).all():
+                # Same defensive sanitize as the object side: a garbage
+                # embedding must never poison the cost (copy-on-write —
+                # the hook may have handed us its internal buffer).
+                nf = np.nan_to_num(nf, nan=0.0, posinf=0.0, neginf=0.0)
             node_feat[:, : len(node_order)] = nf.T
         kw = dict(
             n_groups=n_groups,
-            bucket=min(bucket_sz, bucket_n // n_chunks),
+            bucket=min(bucket_sz, rows_cell),
             eps=self._eps,
             coarse_iters=self._n_iters,
             fine_iters=self._n_iters,
@@ -1552,23 +1695,47 @@ class JaxObjectPlacement(ObjectPlacement):
             "solver_iters": 2 * self._n_iters,  # coarse + fine stages
             "warm_ratio": warm_ratio,
             "chunks": n_chunks,
+            "devices": n_shards,
         }
         if self._mesh is not None:
             # Shard the object axis across the mesh (the tier this mode is
-            # for); pad to a shard multiple with zero-feature rows and let
-            # the caller's [:n] slice drop them.
-            from ..parallel.hierarchical import sharded_hierarchical_assign
+            # for); obj_feat was built at n_pad (a shard multiple) so every
+            # device gets per_dev rows, and the caller's [:n] slice drops
+            # the pad. The warm seed threads through shard_map (it used to
+            # be dropped here — PlanState potentials on the mesh path were
+            # write-only) and comes back pmean'd for the next plan.
+            from ..parallel import hierarchical as _hier
 
-            n_shards = int(self._mesh.devices.size)
-            n_pad = -(-bucket_n // n_shards) * n_shards
-            if n_pad != bucket_n:
-                obj_feat = jnp.concatenate(
-                    [obj_feat, jnp.zeros((n_pad - bucket_n, d_feat), jnp.float32)]
+            if n_chunks > 1:
+                # The composed path: lax.map-chunked body INSIDE each
+                # shard, one compile at the (rows_cell, d) cell shape.
+                conv["mode_suffix"] = "+mesh_chunk"
+                if os.environ.get("RIO_TPU_CHUNK_TIMING", "1") != "0":
+                    res, chunk_ms = _hier.mesh_chunked_hierarchical_assign_timed(
+                        self._mesh, jnp.asarray(obj_feat),
+                        jnp.asarray(node_feat),
+                        jnp.asarray(cap_np), jnp.asarray(alive_np),
+                        n_chunks=n_chunks,
+                        coarse_g_init=jnp.asarray(coarse_g_init),
+                        **kw,
+                    )
+                    conv["chunk_ms"] = chunk_ms
+                else:
+                    res = _hier.mesh_chunked_hierarchical_assign(
+                        self._mesh, jnp.asarray(obj_feat),
+                        jnp.asarray(node_feat),
+                        jnp.asarray(cap_np), jnp.asarray(alive_np),
+                        n_chunks=n_chunks,
+                        coarse_g_init=jnp.asarray(coarse_g_init),
+                        **kw,
+                    )
+            else:
+                res = _hier.sharded_hierarchical_assign(
+                    self._mesh, jnp.asarray(obj_feat), jnp.asarray(node_feat),
+                    jnp.asarray(cap_np), jnp.asarray(alive_np),
+                    coarse_g_init=jnp.asarray(coarse_g_init),
+                    **kw,
                 )
-            res = sharded_hierarchical_assign(
-                self._mesh, obj_feat, jnp.asarray(node_feat),
-                jnp.asarray(cap_np), jnp.asarray(alive_np), **kw,
-            )
         elif n_chunks > 1:
             from ..parallel import hierarchical as _hier
 
@@ -2369,7 +2536,11 @@ class JaxObjectPlacement(ObjectPlacement):
             # quality parity is pinned by tests/test_hierarchical.py.
             # Per-shard rows are what the backend actually compiles: a
             # mesh divides the flat shape across devices, a single chip
-            # does not.
+            # does not. On a mesh the routed solve lands on the composed
+            # mesh x chunk dispatch inside _hierarchical_solve — devices
+            # divide the rows, then per-device chunking re-bounds the
+            # compile — so routing never trades the flat wall for a
+            # per-shard one.
             flat_rows = bucket if self._mesh is None else (
                 -(-bucket // int(self._mesh.devices.size))
             )
@@ -2427,6 +2598,10 @@ class JaxObjectPlacement(ObjectPlacement):
                         move_w=obj_w if route_hier else None,
                         coarse_g_init=plan.coarse_g if plan is not None else None,
                     )
+                    # Mesh x chunk composed dispatch stamps its suffix so
+                    # SolveStats.mode attributes the actual executable
+                    # shape (the span above keeps the base mode label).
+                    solved_as = solved_as + conv.pop("mode_suffix", "")
                 elif collapse:
                     # CLASS-COLLAPSED exact solve (ops/structured.py): the
                     # flat cost model is a per-node vector plus a stay-put

@@ -22,7 +22,11 @@ Scaling out: the object axis is embarrassingly parallel — shard objects
 across the mesh and give every shard ``1/n_shards`` of each node's
 capacity (:func:`sharded_hierarchical_assign`); no cross-shard collective
 is needed beyond the initial capacity split, so the solve rides data
-parallelism to any mesh size.
+parallelism to any mesh size. Past the per-shard compile wall, the same
+independence composes with temporal chunking
+(:func:`mesh_chunked_hierarchical_assign`): each (device, chunk) cell
+solves its slice against ``1/(n_shards*n_chunks)`` capacity, so ONE
+compiled body at the cell shape covers 10M-100M rows.
 
 The reference has no counterpart — its placement directory is row-by-row
 SQL (``rio-rs/src/object_placement/sqlite.rs:68-100``) with a random-pick
@@ -51,6 +55,8 @@ __all__ = [
     "chunked_hierarchical_assign",
     "chunked_hierarchical_assign_timed",
     "hierarchical_assign",
+    "mesh_chunked_hierarchical_assign",
+    "mesh_chunked_hierarchical_assign_timed",
     "sharded_hierarchical_assign",
 ]
 
@@ -70,11 +76,10 @@ class HierarchicalResult(NamedTuple):
     coarse_err: jax.Array | None = None
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("n_groups", "bucket", "eps", "coarse_iters", "fine_iters"),
-)
-def hierarchical_assign(
+_HIER_STATIC = ("n_groups", "bucket", "eps", "coarse_iters", "fine_iters")
+
+
+def _hierarchical_assign_impl(
     obj_feat: jax.Array,
     node_feat: jax.Array,
     node_capacity: jax.Array,
@@ -231,6 +236,22 @@ def hierarchical_assign(
     )
 
 
+hierarchical_assign = jax.jit(_hierarchical_assign_impl, static_argnames=_HIER_STATIC)
+
+# Donation twin for the host-looped timed paths: each chunk's feature slab
+# is freshly sliced/built there, so its device buffer can back the result
+# instead of doubling residency for the dispatch. Only engaged off-CPU —
+# the CPU runtime ignores donation with a per-call warning, and the timed
+# twins' bit-parity pins run on CPU against the non-donated executable.
+_hierarchical_assign_donated = jax.jit(
+    _hierarchical_assign_impl, static_argnames=_HIER_STATIC, donate_argnums=(0,)
+)
+
+
+def _donation_profitable(donate: bool) -> bool:
+    return donate and jax.default_backend() != "cpu"
+
+
 @functools.partial(jax.jit, static_argnames=("n_groups", "n_chunks", "bucket", "eps", "coarse_iters", "fine_iters"))
 def chunked_hierarchical_assign(
     obj_feat: jax.Array,
@@ -290,6 +311,7 @@ def chunked_hierarchical_assign_timed(
     n_groups: int,
     n_chunks: int,
     coarse_g_init: jax.Array | None = None,
+    donate: bool = True,
     **kw,
 ) -> tuple[HierarchicalResult, list[float]]:
     """:func:`chunked_hierarchical_assign` with per-chunk host timings.
@@ -306,13 +328,28 @@ def chunked_hierarchical_assign_timed(
     single ``block_until_ready`` on a chained jit result — the pattern
     CLAUDE.md's r4 wedge notes mark safe (sub-ms, unlike eager pulls).
 
+    ``donate`` releases each chunk's feature slab into its own solve
+    (``donate_argnums`` on the chunk body) — the slab is a fresh slice per
+    iteration, so off-CPU this halves the chunk's device residency; on CPU
+    it is a no-op (see ``_hierarchical_assign_donated``).
+
     Returns ``(result, chunk_ms)`` with one wall-ms entry per chunk.
     """
     import time as _time
 
     n = obj_feat.shape[0]
     assert n % n_chunks == 0, (n, n_chunks)
+    solve = (
+        _hierarchical_assign_donated
+        if _donation_profitable(donate)
+        else hierarchical_assign
+    )
     of = jnp.asarray(obj_feat).reshape(n_chunks, n // n_chunks, obj_feat.shape[1])
+    # Sync staged inputs BEFORE the timed loop: dispatch is async, so a
+    # still-pending producer chain (e.g. feature generation, O(N) in total
+    # rows) would otherwise drain inside chunk 0's timer and masquerade as
+    # compile time — chunk_ms must measure the solve, pinned to cell shape.
+    jax.block_until_ready((of, node_feat, node_capacity, alive))
     assignments: list[jax.Array] = []
     groups: list[jax.Array] = []
     overflow = jnp.zeros((), jnp.int32)
@@ -320,7 +357,7 @@ def chunked_hierarchical_assign_timed(
     res = None
     for c in range(n_chunks):
         t0 = _time.perf_counter()
-        res = hierarchical_assign(
+        res = solve(
             of[c], node_feat, node_capacity / n_chunks, alive,
             n_groups=n_groups, coarse_g_init=coarse_g_init, **kw,
         )
@@ -341,6 +378,56 @@ def chunked_hierarchical_assign_timed(
     )
 
 
+def _shard_map_check_kw():
+    """Resolve shard_map plus its replication-check kwarg, disabled.
+
+    The kwarg was renamed across jax versions (check_rep -> check_vma);
+    return ``(shard_map, {that_kwarg: False})`` for whichever this install
+    understands.
+    """
+    import inspect
+
+    from . import shard_map  # version-gated import (top-level vs experimental)
+
+    params = inspect.signature(shard_map).parameters
+    check_kw = next((k for k in ("check_vma", "check_rep") if k in params), None)
+    return shard_map, ({check_kw: False} if check_kw else {})
+
+
+def _mesh_inputs(
+    mesh, obj_feat, node_feat, node_capacity, alive, coarse_g_init, n_groups
+):
+    """Place the solve inputs: object rows sharded, everything else replicated.
+
+    A missing warm seed becomes the zero seed — bitwise the same solve
+    (``v0 = exp(0) = 1`` either way, see ``ops.scaling.scaling_core``) —
+    and an always-an-array seed keeps the traced signature stable instead
+    of minting a second executable on the cold/warm flip.
+    """
+    axes = mesh.axis_names
+    obj_feat = jax.device_put(obj_feat, NamedSharding(mesh, P(axes, None)))
+    rep = NamedSharding(mesh, P())
+    node_feat = jax.device_put(jnp.asarray(node_feat), rep)
+    node_capacity = jax.device_put(jnp.asarray(node_capacity), rep)
+    alive = jax.device_put(jnp.asarray(alive), rep)
+    if coarse_g_init is None:
+        coarse_g_init = jnp.zeros((n_groups,), jnp.float32)
+    coarse_g_init = jax.device_put(jnp.asarray(coarse_g_init, jnp.float32), rep)
+    return obj_feat, node_feat, node_capacity, alive, coarse_g_init
+
+
+def _hier_out_specs(axes):
+    return HierarchicalResult(
+        assignment=P(axes), group=P(axes), overflow=P(),
+        # Coarse potentials/residual come back REPLICATED: every shard
+        # solves the same capacity proportions (its slice vs 1/n_shards of
+        # each node), so the pmean of the per-shard potentials is a valid
+        # warm seed for the next solve — this is what persists into
+        # PlanState on the mesh path (it used to be dropped entirely).
+        coarse_g=P(), coarse_err=P(),
+    )
+
+
 def sharded_hierarchical_assign(
     mesh: Mesh,
     obj_feat: jax.Array,
@@ -349,6 +436,7 @@ def sharded_hierarchical_assign(
     alive: jax.Array,
     *,
     n_groups: int,
+    coarse_g_init: jax.Array | None = None,
     **kw,
 ) -> HierarchicalResult:
     """Data-parallel hierarchical solve: objects sharded over the mesh.
@@ -358,38 +446,223 @@ def sharded_hierarchical_assign(
     same capacity proportions), so no cross-shard collective is needed at
     all — the sort/bucket/scatter machinery stays shard-local instead of
     turning into a global all-to-all. Node-side inputs are replicated
-    (O(M), tiny next to the object axis); the overflow counter is psum'd.
+    (O(M), tiny next to the object axis); the overflow counter is psum'd
+    and the coarse potentials/residual are pmean'd to a replicated warm
+    seed (``coarse_g_init`` threads the previous one back in).
     """
-    import inspect
-
-    from . import shard_map  # version-gated import (top-level vs experimental)
-
+    shard_map, check = _shard_map_check_kw()
     axes = mesh.axis_names
-    obj_feat = jax.device_put(obj_feat, NamedSharding(mesh, P(axes, None)))
-    rep = NamedSharding(mesh, P())
-    node_feat = jax.device_put(node_feat, rep)
-    node_capacity = jax.device_put(node_capacity, rep)
-    alive = jax.device_put(alive, rep)
+    obj_feat, node_feat, node_capacity, alive, coarse_g_init = _mesh_inputs(
+        mesh, obj_feat, node_feat, node_capacity, alive, coarse_g_init, n_groups
+    )
 
-    def local_solve(of, nf, cap, al):
-        res = hierarchical_assign(of, nf, cap, al, n_groups=n_groups, **kw)
+    def local_solve(of, nf, cap, al, g0):
+        res = hierarchical_assign(
+            of, nf, cap, al, n_groups=n_groups, coarse_g_init=g0, **kw
+        )
         return HierarchicalResult(
             assignment=res.assignment,
             group=res.group,
             overflow=jax.lax.psum(res.overflow, axes),
+            coarse_g=jax.lax.pmean(res.coarse_g, axes),
+            coarse_err=jax.lax.pmean(res.coarse_err, axes),
         )
 
-    # The replication-check kwarg was renamed across jax versions
-    # (check_rep -> check_vma); pass whichever this install understands.
-    params = inspect.signature(shard_map).parameters
-    check_kw = next((k for k in ("check_vma", "check_rep") if k in params), None)
     fn = shard_map(
         local_solve,
         mesh=mesh,
-        in_specs=(P(axes, None), P(), P(), P()),
-        out_specs=HierarchicalResult(
-            assignment=P(axes), group=P(axes), overflow=P()
-        ),
-        **({check_kw: False} if check_kw else {}),
+        in_specs=(P(axes, None), P(), P(), P(), P()),
+        out_specs=_hier_out_specs(axes),
+        **check,
     )
-    return fn(obj_feat, node_feat, node_capacity, alive)
+    return fn(obj_feat, node_feat, node_capacity, alive, coarse_g_init)
+
+
+def mesh_chunked_hierarchical_assign(
+    mesh: Mesh,
+    obj_feat: jax.Array,
+    node_feat: jax.Array,
+    node_capacity: jax.Array,
+    alive: jax.Array,
+    *,
+    n_groups: int,
+    n_chunks: int,
+    coarse_g_init: jax.Array | None = None,
+    **kw,
+) -> HierarchicalResult:
+    """Mesh x chunk composed solve: devices AND chunks scale the row count.
+
+    :func:`sharded_hierarchical_assign` divides N by the device count but
+    still compiles one flat body per shard — at TPU-backend compile costs
+    superlinear in the row count (CLAUDE.md r5) that hits the same wall
+    one octave later. This composition runs the ``lax.map``-chunked body
+    *inside* each shard: every (device, chunk) cell solves
+    ``N / (n_shards * n_chunks)`` rows against ``1 / (n_shards *
+    n_chunks)`` of each node's capacity (the same per-slice independence
+    both parents ride), so the ONE compiled body is pinned to the cell
+    shape while rows scale with devices times chunks. Overflow is psum'd;
+    coarse potentials are pmean'd across shards (last chunk per shard,
+    matching :func:`chunked_hierarchical_assign`) into a replicated warm
+    seed.
+    """
+    shard_map, check = _shard_map_check_kw()
+    axes = mesh.axis_names
+    n_shards = int(mesh.devices.size)
+    n = obj_feat.shape[0]
+    assert n % (n_shards * n_chunks) == 0, (n, n_shards, n_chunks)
+    scale = n_shards * n_chunks
+    obj_feat, node_feat, node_capacity, alive, coarse_g_init = _mesh_inputs(
+        mesh, obj_feat, node_feat, node_capacity, alive, coarse_g_init, n_groups
+    )
+
+    def local_solve(of, nf, cap, al, g0):
+        ofc = of.reshape(n_chunks, of.shape[0] // n_chunks, of.shape[1])
+
+        def one(of_c):
+            # Divide by the FULL scale in one step — the timed twin does
+            # the identical division, so the two forms stay comparable to
+            # the last ulp.
+            return hierarchical_assign(
+                of_c, nf, cap / scale, al,
+                n_groups=n_groups, coarse_g_init=g0, **kw,
+            )
+
+        res = jax.lax.map(one, ofc)
+        return HierarchicalResult(
+            assignment=res.assignment.reshape(-1),
+            group=res.group.reshape(-1),
+            overflow=jax.lax.psum(jnp.sum(res.overflow), axes),
+            coarse_g=jax.lax.pmean(res.coarse_g[-1], axes),
+            coarse_err=jax.lax.pmean(res.coarse_err[-1], axes),
+        )
+
+    fn = shard_map(
+        local_solve,
+        mesh=mesh,
+        in_specs=(P(axes, None), P(), P(), P(), P()),
+        out_specs=_hier_out_specs(axes),
+        **check,
+    )
+    return fn(obj_feat, node_feat, node_capacity, alive, coarse_g_init)
+
+
+@functools.lru_cache(maxsize=8)
+def _mesh_cell_solver(mesh: Mesh, scale: int, n_groups: int, kw_key: tuple):
+    """One jitted shard_map cell solver per (mesh, scale, solve config).
+
+    The timed twin dispatches every chunk through this SAME executable —
+    the cache (keyed on hashables only; ``Mesh`` hashes by device/axis
+    layout) is what pins compile cost to the first chunk of the first
+    solve at a given cell shape, across chunks AND across rebalances.
+    """
+    shard_map, check = _shard_map_check_kw()
+    axes = mesh.axis_names
+    kw = dict(kw_key)
+
+    def local_solve(of, nf, cap, al, g0):
+        res = hierarchical_assign(
+            of, nf, cap / scale, al,
+            n_groups=n_groups, coarse_g_init=g0, **kw,
+        )
+        return HierarchicalResult(
+            assignment=res.assignment,
+            group=res.group,
+            overflow=jax.lax.psum(res.overflow, axes),
+            coarse_g=jax.lax.pmean(res.coarse_g, axes),
+            coarse_err=jax.lax.pmean(res.coarse_err, axes),
+        )
+
+    fn = shard_map(
+        local_solve,
+        mesh=mesh,
+        in_specs=(P(axes, None), P(), P(), P(), P()),
+        out_specs=_hier_out_specs(axes),
+        **check,
+    )
+    return jax.jit(fn)
+
+
+def mesh_chunked_hierarchical_assign_timed(
+    mesh: Mesh,
+    obj_feat: jax.Array,
+    node_feat: jax.Array,
+    node_capacity: jax.Array,
+    alive: jax.Array,
+    *,
+    n_groups: int,
+    n_chunks: int,
+    coarse_g_init: jax.Array | None = None,
+    **kw,
+) -> tuple[HierarchicalResult, list[float]]:
+    """:func:`mesh_chunked_hierarchical_assign` with per-chunk host timings.
+
+    Same split as :func:`chunked_hierarchical_assign_timed`: the
+    ``lax.map`` form hides chunk boundaries inside one executable, so this
+    twin loops the chunks on the host — each iteration dispatches one
+    mesh-wide slab (every device solves its own cell of that chunk)
+    through the cached jitted cell solver (:func:`_mesh_cell_solver`) and
+    times dispatch+``block_until_ready``. The slab for chunk ``c`` is
+    exactly the ``lax.map`` form's set of (device, chunk ``c``) cells —
+    same rows per cell, same ``cap / (n_shards * n_chunks)`` division —
+    so the composed result matches the single-executable form. The first
+    chunk's timing carries the one-time compile: the compile-vs-exec
+    signal SolveStats wants, now at mesh scale.
+    """
+    import time as _time
+
+    n_shards = int(mesh.devices.size)
+    n, d = obj_feat.shape
+    assert n % (n_shards * n_chunks) == 0, (n, n_shards, n_chunks)
+    cell = n // (n_shards * n_chunks)
+    solve = _mesh_cell_solver(
+        mesh, n_shards * n_chunks, n_groups, tuple(sorted(kw.items()))
+    )
+    # (shard, chunk, cell, d) view: slab c = every shard's chunk-c cell,
+    # laid out shard-major so P(axes) sharding hands each device its own
+    # cell — the exact row->cell mapping of the lax.map form.
+    of = jnp.asarray(obj_feat).reshape(n_shards, n_chunks, cell, d)
+    # Sync staged inputs BEFORE the timed loop (same reason as the chunked
+    # twin): an async pending producer chain behind obj_feat is O(N) in
+    # TOTAL rows and would drain inside chunk 0's timer, inflating the
+    # "compile" number superlinearly with N — the exact signal the
+    # composed solve exists to keep flat.
+    jax.block_until_ready((of, node_feat, node_capacity, alive))
+    shard_spec = NamedSharding(mesh, P(mesh.axis_names, None))
+    rep_inputs = None
+    assignments: list[jax.Array] = []
+    groups: list[jax.Array] = []
+    overflow = jnp.zeros((), jnp.int32)
+    chunk_ms: list[float] = []
+    res = None
+    for c in range(n_chunks):
+        t0 = _time.perf_counter()
+        slab = of[:, c].reshape(n_shards * cell, d)
+        if rep_inputs is None:
+            slab, nf, cap, al, g0 = _mesh_inputs(
+                mesh, slab, node_feat, node_capacity, alive,
+                coarse_g_init, n_groups,
+            )
+            rep_inputs = (nf, cap, al, g0)
+        else:
+            slab = jax.device_put(slab, shard_spec)
+            nf, cap, al, g0 = rep_inputs
+        res = solve(slab, nf, cap, al, g0)
+        jax.block_until_ready(res.assignment)
+        chunk_ms.append(round((_time.perf_counter() - t0) * 1e3, 3))
+        assignments.append(res.assignment.reshape(n_shards, cell))
+        groups.append(res.group.reshape(n_shards, cell))
+        overflow = overflow + res.overflow
+    # Chunk results stack to (shard, chunk, cell) when interleaved back on
+    # axis 1 — the shard-major global row order the input was reshaped from.
+    asn = jnp.stack(assignments, axis=1).reshape(-1)
+    grp = jnp.stack(groups, axis=1).reshape(-1)
+    return (
+        HierarchicalResult(
+            assignment=asn,
+            group=grp,
+            overflow=overflow,
+            coarse_g=res.coarse_g,
+            coarse_err=res.coarse_err,
+        ),
+        chunk_ms,
+    )

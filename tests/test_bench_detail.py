@@ -547,3 +547,47 @@ def test_committed_cpu_capture_banks_spans_with_provenance():
     assert spans["slo_ms"] <= 250.0  # priced at/below the shipping default
     assert set(spans["host"]) == {"cpu_count", "sched_affinity", "loadavg"}
     assert set(spans["msgs_per_sec"]) == {"off", "on"}
+
+
+def test_hier_mesh_ab_banks_to_cpu_sidecar_and_never_carries(tmp_path):
+    """The mesh x chunk vs chunked-only paired A/B is a host stage: banked
+    beside its own session's host provenance, never carried into a later
+    tpu bank (absolute host solve times drift between sessions; only the
+    paired in-session ratio means anything)."""
+    stage = {
+        "n_obj": 2_097_152,
+        "devices": 8,
+        "cell_rows": 65_536,
+        "mesh_chunk": {"first_chunk_ms": 8937.0, "wall_s": 24.5},
+        "chunked_only": {"first_chunk_ms": 9627.0, "wall_s": 25.4},
+        "transport_cost": {"ratio": 1.01},
+        "host": {"cpu_count": 1, "sched_affinity": [0], "loadavg": [0, 0, 0]},
+    }
+    _write_detail(
+        {"solve_tier": {"platform": "cpu"}, "hier_mesh_ab": stage},
+        here=str(tmp_path),
+    )
+    banked = _read(tmp_path, "BENCH_DETAIL.cpu.json")
+    assert banked["hier_mesh_ab"] == stage
+    # A later tpu run must not inherit it.
+    _write_detail({"solve_tier": {"platform": "tpu"}}, here=str(tmp_path))
+    tpu = _read(tmp_path, "BENCH_DETAIL.tpu.json")
+    assert "hier_mesh_ab" not in tpu and "hier_mesh_ab_carried" not in tpu
+
+
+def test_committed_cpu_capture_banks_hier_mesh_ab_with_provenance():
+    """The repo's banked cpu sidecar carries the ISSUE 18 paired A/B:
+    mesh x chunk vs chunked-only at MATCHED N on the 8-virtual-device
+    mesh, quality parity on disk (transport-cost ratio <= 1.05), both
+    arms' chunk timings present (first chunk carries the compile), and
+    the stage stamped with the host conditions it ran under."""
+    committed = Path(__file__).resolve().parent.parent / "BENCH_DETAIL.cpu.json"
+    ab = json.loads(committed.read_text())["hier_mesh_ab"]
+    assert ab["devices"] == 8
+    assert ab["n_obj"] == ab["mesh_chunk"]["n_chunks"] * 8 * ab["cell_rows"]
+    assert ab["transport_cost"]["ratio"] <= 1.05
+    for arm in ("mesh_chunk", "chunked_only"):
+        assert ab[arm]["overflow"] == 0
+        assert len(ab[arm]["chunk_ms"]) == ab[arm]["n_chunks"] > 1
+        assert ab[arm]["first_chunk_ms"] >= max(ab[arm]["chunk_ms"][1:])
+    assert set(ab["host"]) == {"cpu_count", "sched_affinity", "loadavg"}

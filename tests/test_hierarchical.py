@@ -308,3 +308,218 @@ def test_chunked_timed_twin_matches_lax_map_form_exactly():
     # The first chunk pays the one-time compile — the compile-vs-execute
     # signal the telemetry wants is visible in the timings themselves.
     assert chunk_ms[0] >= max(chunk_ms[1:])
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_mesh_chunked_matches_flat_and_chunked_quality():
+    """mesh x chunk = the sharded design AND the chunked design composed.
+
+    Each of the n_shards * n_chunks cells solves its slice against
+    1/(n_shards * n_chunks) of every node's capacity, so the same
+    per-slice-independence argument that makes each parent match the flat
+    solve applies to the composition: per-node loads exact to CELL
+    granularity, dead nodes empty, zero overflow, affinity quality on par
+    with both the flat and the chunked-only solve."""
+    from rio_tpu.parallel.hierarchical import (
+        chunked_hierarchical_assign,
+        mesh_chunked_hierarchical_assign,
+    )
+
+    n, d, m, g, chunks = 16384, 16, 64, 8, 2
+    obj, node = _features(jax.random.PRNGKey(42), n, d, m)
+    cap = jnp.ones((m,), jnp.float32)
+    alive = jnp.ones((m,), jnp.float32).at[5].set(0.0).at[50].set(0.0)
+    mesh = make_mesh(jax.devices()[:8])
+    cells = 8 * chunks
+
+    flat = hierarchical_assign(obj, node, cap, alive, n_groups=g)
+    chunked = chunked_hierarchical_assign(
+        obj, node, cap, alive, n_groups=g, n_chunks=chunks
+    )
+    composed = mesh_chunked_hierarchical_assign(
+        mesh, obj, node, cap, alive, n_groups=g, n_chunks=chunks
+    )
+    a = np.asarray(composed.assignment)
+    assert a.shape == (n,)
+    assert a.min() >= 0 and a.max() < m
+    assert not np.any(np.isin(a, [5, 50]))
+    assert int(composed.overflow) == 0
+    # Load exactness to cell granularity (each cell repairs to exact
+    # largest-remainder quotas of its slice).
+    cf = np.bincount(np.asarray(flat.assignment), minlength=m)
+    cm = np.bincount(a, minlength=m)
+    assert np.abs(cm - cf).max() <= cells
+    # Quality within 2% of a cost-spread of BOTH parents (calibrated:
+    # measured gaps 0.007/0.010 spreads at this shape).
+    on = np.asarray(obj @ node)
+    q_flat = on[np.arange(n), np.asarray(flat.assignment)].mean()
+    q_chunk = on[np.arange(n), np.asarray(chunked.assignment)].mean()
+    q_mesh = on[np.arange(n), a].mean()
+    spread = on.std()
+    assert q_mesh >= q_flat - 0.02 * spread, (q_mesh, q_flat, spread)
+    assert q_mesh >= q_chunk - 0.02 * spread, (q_mesh, q_chunk, spread)
+    # The composed solve returns REPLICATED finite coarse potentials (the
+    # warm seed the placement layer persists into PlanState).
+    cg = np.asarray(composed.coarse_g)
+    assert cg.shape == (g,) and np.isfinite(cg).all()
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_mesh_chunked_survives_wide_cost_ranges():
+    """The per-row gauge shift must survive the composition: with raw
+    affinities scaled 1000x (cost-range/eps >> 88, the regime where a
+    GLOBAL shift underflows tail rows and the solve silently diverges —
+    CLAUDE.md r3), the composed solve still balances, excludes the dead
+    node, and matches flat quality."""
+    from rio_tpu.parallel.hierarchical import mesh_chunked_hierarchical_assign
+
+    n, d, m, g, chunks = 8192, 16, 32, 4, 2
+    obj, node = _features(jax.random.PRNGKey(3), n, d, m)
+    obj = obj * 1e3
+    cap = jnp.ones((m,), jnp.float32)
+    alive = jnp.ones((m,), jnp.float32).at[7].set(0.0)
+    mesh = make_mesh(jax.devices()[:8])
+
+    res = mesh_chunked_hierarchical_assign(
+        mesh, obj, node, cap, alive, n_groups=g, n_chunks=chunks
+    )
+    a = np.asarray(res.assignment)
+    assert not np.any(a == 7)
+    assert int(res.overflow) == 0
+    counts = np.bincount(a, minlength=m)
+    live = np.setdiff1d(np.arange(m), [7])
+    fair = n / len(live)
+    assert counts[live].min() >= 0.9 * fair and counts[live].max() <= 1.1 * fair
+    flat = hierarchical_assign(obj, node, cap, alive, n_groups=g)
+    on = np.asarray(obj @ node)
+    q_flat = on[np.arange(n), np.asarray(flat.assignment)].mean()
+    q_mesh = on[np.arange(n), a].mean()
+    assert q_mesh >= q_flat - 0.02 * on.std(), (q_mesh, q_flat)
+    assert np.isfinite(np.asarray(res.coarse_g)).all()
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_mesh_chunked_timed_twin_matches_lax_map_form_exactly():
+    """The host-loop twin dispatches each chunk's mesh-wide slab through
+    the SAME cell solve (identical single-step ``cap / (shards * chunks)``
+    division, exact row->cell mapping), so assignment/group/overflow are
+    bit-identical to the ``lax.map`` form — and the per-chunk wall timings
+    expose the first-chunk compile for SolveStats."""
+    from rio_tpu.parallel.hierarchical import (
+        mesh_chunked_hierarchical_assign,
+        mesh_chunked_hierarchical_assign_timed,
+    )
+
+    n, d, m, g, chunks = 2048, 8, 8, 4, 4
+    obj, node = _features(jax.random.PRNGKey(7), n, d, m)
+    cap = jnp.ones((m,), jnp.float32)
+    alive = jnp.ones((m,), jnp.float32).at[3].set(0.0)
+    mesh = make_mesh(jax.devices()[:8])
+
+    mapped = mesh_chunked_hierarchical_assign(
+        mesh, obj, node, cap, alive, n_groups=g, n_chunks=chunks
+    )
+    timed, chunk_ms = mesh_chunked_hierarchical_assign_timed(
+        mesh, obj, node, cap, alive, n_groups=g, n_chunks=chunks
+    )
+    assert np.array_equal(np.asarray(mapped.assignment),
+                          np.asarray(timed.assignment))
+    assert np.array_equal(np.asarray(mapped.group), np.asarray(timed.group))
+    assert int(mapped.overflow) == int(timed.overflow)
+    assert len(chunk_ms) == chunks
+    assert all(ms > 0.0 for ms in chunk_ms)
+    # First chunk pays the one-time cell compile.
+    assert chunk_ms[0] >= max(chunk_ms[1:])
+    # Warm-seed roundtrip: feeding the replicated potentials back in is
+    # accepted by the same cached executable (no retrace on cold/warm flip)
+    # and still yields a valid solve.
+    timed2, chunk_ms2 = mesh_chunked_hierarchical_assign_timed(
+        mesh, obj, node, cap, alive,
+        n_groups=g, n_chunks=chunks, coarse_g_init=timed.coarse_g,
+    )
+    assert not np.any(np.asarray(timed2.assignment) == 3)
+    assert int(timed2.overflow) == 0
+    # Cached executable: the warm re-solve's first chunk pays no compile.
+    assert chunk_ms2[0] < chunk_ms[0]
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    not os.environ.get("RIO_TPU_SCALE_MESH"),
+    reason="opt-in (RIO_TPU_SCALE_MESH=1): 10M x 1024 composed solve, minutes",
+)
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_mesh_chunked_10m_x_1024_compile_pinned_and_parity():
+    """ISSUE 18 acceptance rung: 10,485,760 x 1024 through the composed
+    mesh x chunk solve on the 8-virtual-device CPU mesh.
+
+    The point of the composition is that compile cost pins to the CELL
+    shape, not N: the 1M rung (8 shards x 2 chunks) and the 10M rung
+    (8 shards x 20 chunks) use the SAME 65,536-row cell, so the 10M first
+    chunk's compile must come in flat — within 1.5x of the 1M rung's
+    (each rung compiles its own executable: the capacity scale constant
+    differs, so this measures a genuine fresh compile at matched shape).
+    Quality is checked against the chunked-only solve at the SAME N via a
+    sampled transport-cost ratio (mean best-minus-assigned affinity
+    regret) <= 1.05."""
+    from rio_tpu.parallel.hierarchical import (
+        chunked_hierarchical_assign,
+        mesh_chunked_hierarchical_assign_timed,
+    )
+
+    d, m, g = 16, 1024, 32
+    cell = 65_536
+    mesh = make_mesh(jax.devices()[:8])
+    cap = jnp.ones((m,), jnp.float32)
+    dead = [7, 300, 512, 900]
+    alive = jnp.ones((m,), jnp.float32)
+    for i in dead:
+        alive = alive.at[i].set(0.0)
+    kw = dict(coarse_iters=16, fine_iters=16)
+
+    # Rung A: 1M = 8 shards x 2 chunks x 65,536-row cells (cold compile).
+    n1 = 8 * 2 * cell
+    obj1, node = _features(jax.random.PRNGKey(23), n1, d, m)
+    res1, ms1 = mesh_chunked_hierarchical_assign_timed(
+        mesh, obj1, node, cap, alive, n_groups=g, n_chunks=2, **kw
+    )
+    assert int(res1.overflow) == 0
+
+    # Rung B: 10M = 8 shards x 20 chunks x the SAME cell shape.
+    n10 = 8 * 20 * cell
+    obj10, _ = _features(jax.random.PRNGKey(29), n10, d, m)
+    res10, ms10 = mesh_chunked_hierarchical_assign_timed(
+        mesh, obj10, node, cap, alive, n_groups=g, n_chunks=20, **kw
+    )
+    a = np.asarray(res10.assignment)
+    assert a.shape == (n10,)
+    assert not np.any(np.isin(a, dead))
+    assert int(res10.overflow) == 0
+    loads = np.bincount(a, minlength=m)
+    live_loads = loads[np.asarray(alive) > 0]
+    fair = n10 / (m - len(dead))
+    assert live_loads.max() <= 1.1 * fair and live_loads.min() >= 0.9 * fair
+    # THE acceptance gate: first-chunk compile flat in N.
+    assert ms10[0] <= 1.5 * ms1[0], (ms10[0], ms1[0])
+    # Steady-state chunks never recompile.
+    assert max(ms10[1:]) < ms10[0], (ms10[0], max(ms10[1:]))
+
+    # Chunked-only comparator at matched N (single-chip dispatch shape:
+    # 20 chunks of 524,288 rows = _HIER_CHUNK_ROWS).
+    comp = chunked_hierarchical_assign(
+        obj10, node, cap, alive, n_groups=g, n_chunks=20, **kw
+    )
+    ac = np.asarray(comp.assignment)
+    # Sampled transport cost: mean regret (best live affinity minus the
+    # assigned affinity) over a fixed 65,536-row sample — the (N x M)
+    # affinity matrix at 10M x 1024 would be 40 GB, the sample is 256 MB.
+    idx = np.arange(0, n10, n10 // 65_536)[:65_536]
+    on_s = np.asarray(obj10[idx] @ node)
+    on_s_live = np.where(np.asarray(alive)[None, :] > 0, on_s, -np.inf)
+    best = on_s_live.max(axis=1)
+    cost_mesh = float(np.mean(best - on_s[np.arange(len(idx)), a[idx]]))
+    cost_chunk = float(np.mean(best - on_s[np.arange(len(idx)), ac[idx]]))
+    assert cost_mesh <= 1.05 * cost_chunk, (cost_mesh, cost_chunk)
+    print(f"\n10M x 1024 mesh x chunk: first-chunk {ms10[0]:.0f} ms "
+          f"(1M rung {ms1[0]:.0f} ms), steady {np.median(ms10[1:]):.0f} ms, "
+          f"transport-cost ratio {cost_mesh / cost_chunk:.4f}")

@@ -118,3 +118,96 @@ async def test_history_gauges_carry_convergence_trend():
     ):
         assert g["rio.placement_solve.history.compile_ms_total"] >= 0.0
     assert g["rio.placement_solve.history.delta_fraction"] > 0.0
+
+
+async def test_mesh_hierarchical_second_solve_warm_starts():
+    """ISSUE 18 satellite: the mesh branch used to drop ``coarse_g_init``
+    on the floor AND never return the potentials, so mesh solves could
+    never warm-start. Now the seed threads in and the pmean'd replicated
+    potentials persist: a second full solve on an UNCHANGED cluster
+    reports a positive warm ratio."""
+    from rio_tpu.parallel import make_mesh
+
+    p = JaxObjectPlacement(mode="hierarchical", n_iters=8, mesh=make_mesh())
+    p.sync_members(_members(12))
+    await p.assign_batch([ObjectId("WarmT", str(i)) for i in range(3000)])
+    await p.rebalance(delta=False)
+    first = p.stats
+    assert first.mode.startswith("hierarchical")
+    assert first.warm_ratio <= 0.0  # nothing to seed from yet
+    await p.rebalance(delta=False)
+    second = p.stats
+    assert second.mode.startswith("hierarchical")
+    assert second.warm_ratio > 0.0, second
+    _assert_converged(second, residual=False)
+
+
+async def test_mesh_chunked_composed_solve_records_chunk_telemetry(monkeypatch):
+    """The composed mesh x chunk dispatch stamps its shape onto SolveStats:
+    ``+mesh_chunk`` mode suffix, chunk count, device count, and per-chunk
+    wall timings (first chunk carries the compile)."""
+    from rio_tpu.object_placement import jax_placement as jp
+    from rio_tpu.parallel import make_mesh
+
+    monkeypatch.setattr(jp, "_HIER_CHUNK_ROWS", 64)
+    p = JaxObjectPlacement(mode="hierarchical", n_iters=8, mesh=make_mesh())
+    p.sync_members(_members(12))
+    await p.assign_batch([ObjectId("ChunkT", str(i)) for i in range(3000)])
+    await p.rebalance(delta=False)
+    stats = p.stats
+    assert stats.mode == "hierarchical+mesh_chunk"
+    assert stats.chunks > 1
+    assert stats.devices == 8
+    assert len(stats.chunk_ms) == stats.chunks
+    assert all(ms > 0.0 for ms in stats.chunk_ms)
+    # Compile-vs-exec split: the first chunk pays the one-time compile.
+    assert stats.chunk_ms[0] >= max(stats.chunk_ms[1:])
+    g = stats.history_gauges()
+    assert g["rio.placement_solve.history.chunks_last"] == float(stats.chunks)
+    assert g["rio.placement_solve.history.chunks_max"] >= float(stats.chunks)
+    assert g["rio.placement_solve.history.devices_last"] == 8.0
+    assert (
+        g["rio.placement_solve.history.first_chunk_ms_last"]
+        == stats.chunk_ms[0]
+    )
+    assert (
+        g["rio.placement_solve.history.first_chunk_ms_max"]
+        >= stats.chunk_ms[0]
+    )
+
+
+async def test_mesh_chunk_gauges_export_through_fake_otel(monkeypatch):
+    """The new telemetry flows to the exporter with zero otel changes:
+    ``stats_gauges`` flattens the ``devices`` scalar automatically and the
+    history summary carries the chunk fields."""
+    from . import fake_otel
+    from rio_tpu.object_placement import jax_placement as jp
+    from rio_tpu.parallel import make_mesh
+
+    monkeypatch.setattr(jp, "_HIER_CHUNK_ROWS", 64)
+    p = JaxObjectPlacement(mode="hierarchical", n_iters=8, mesh=make_mesh())
+    p.sync_members(_members(12))
+    await p.assign_batch([ObjectId("OtelT", str(i)) for i in range(3000)])
+    await p.rebalance(delta=False)
+
+    handle = fake_otel.install()
+    try:
+        from rio_tpu.otel import otlp_metrics_exporter, stats_gauges
+
+        def snapshot():
+            return {
+                **stats_gauges(placement_solve=p.stats),
+                **p.stats.history_gauges(),
+            }
+
+        provider = otlp_metrics_exporter(snapshot, interval=9999.0)
+        exporter = handle.metric_exporters[-1]
+        provider.force_flush()
+        exported = exporter.exported[-1]
+        assert exported["rio.placement_solve.devices"] == 8.0
+        assert exported["rio.placement_solve.chunks"] > 1.0
+        assert exported["rio.placement_solve.history.chunks_last"] > 1.0
+        assert exported["rio.placement_solve.history.devices_last"] == 8.0
+        assert exported["rio.placement_solve.history.first_chunk_ms_last"] > 0.0
+    finally:
+        fake_otel.uninstall(handle)
