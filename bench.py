@@ -2135,6 +2135,46 @@ def faults_overhead() -> dict:
     return out
 
 
+def autoscale_stage() -> dict:
+    """Elastic autoscaling evidence, two halves in one stage. (1) Idle
+    cost: the RPC loop A/B'd with autoscaling absent vs armed-but-pinned
+    (min_nodes == max_nodes — the controller ticks, aggregates gauges and
+    evaluates trend rules but can never act); disabled is additionally
+    asserted structurally free (``server.autoscale is None``). (2) The
+    ramp soak: offered load ~10x up and back down against a supervisor
+    with a SubprocessProvisioner, under storage blips plus a real SIGKILL
+    mid-scale-in drain — zero lost acked writes, bounded p99, node count
+    tracking load, and the journal's alarm → SCALE → drain → retire chain
+    are all asserted inside the measurement (a violated bar raises, so a
+    banked number IS a passed soak)."""
+    import asyncio
+
+    from rio_tpu.utils.autoscale_live import (
+        measure_autoscale_idle_overhead,
+        measure_autoscale_ramp,
+    )
+
+    out: dict = {"idle": asyncio.run(measure_autoscale_idle_overhead())}
+    out["ramp"] = asyncio.run(measure_autoscale_ramp())
+    out["host"] = _host_provenance()
+    idle, ramp = out["idle"], out["ramp"]
+    m = idle["msgs_per_sec"]
+    print(
+        f"# autoscale idle overhead ({idle['batches']} interleaved batches "
+        f"x {idle['n_requests_per_batch']} reqs, median paired ratio): off "
+        f"{m['off']:,.0f}/s, on {m['on']:,.0f}/s "
+        f"({idle['autoscale_overhead_pct']:+}%, {idle['controller_ticks_on']} "
+        f"controller ticks); ramp soak {ramp['seconds']:.0f}s: "
+        f"{ramp['scale_outs']} out / {ramp['scale_ins']} in, "
+        f"{ramp['acked_writes']} acked writes lost={ramp['lost']} "
+        f"(dups {ramp['duplicates']}), p99 {ramp['p99_ms']:.0f} ms, "
+        f"SIGKILL mid-drain {ramp['killed_mid_drain'] or 'NONE'}, "
+        f"{ramp['storage_blips']} storage blips",
+        file=sys.stderr,
+    )
+    return out
+
+
 def streams_throughput() -> dict:
     """Durable-stream data-path rates, A/B'd in the SAME session: the
     redelivery backstop idle (no reminders — delivery rides the publish
@@ -2634,6 +2674,10 @@ def main() -> None:
     except Exception as e:
         print(f"# streams throughput failed: {e!r}", file=sys.stderr)
     try:
+        detail["autoscale"] = autoscale_stage()
+    except Exception as e:
+        print(f"# autoscale stage failed: {e!r}", file=sys.stderr)
+    try:
         detail["affinity"] = affinity_payoff()
     except Exception as e:
         print(f"# affinity payoff failed: {e!r}", file=sys.stderr)
@@ -2823,6 +2867,10 @@ if __name__ == "__main__":
     # stage alone and bank it into the cpu sidecar (in-process clusters;
     # CPU-safe).
     parser.add_argument("--affinity", action="store_true")
+    # Run the autoscale idle A/B + ramp soak alone and bank it into the
+    # cpu sidecar (in-process + subprocess clusters on loopback;
+    # CPU-safe).
+    parser.add_argument("--autoscale", action="store_true")
     args = parser.parse_args()
     if args.migration:
         _pin_orchestrator_to_cpu()
@@ -2937,6 +2985,24 @@ if __name__ == "__main__":
         except (OSError, ValueError):
             detail = {}
         detail["streams"] = out
+        _write_detail(detail, here)
+        print(json.dumps(out))
+    elif args.autoscale:
+        # Standalone --autoscale updates the banked cpu sidecar in place
+        # (the --streams pattern): both halves carry their own paired
+        # baseline / inline assertions, so the stage can refresh
+        # independently of the other host stages.
+        _pin_orchestrator_to_cpu()
+        out = autoscale_stage()
+        here = os.path.dirname(os.path.abspath(__file__))
+        try:
+            with open(os.path.join(here, "BENCH_DETAIL.cpu.json")) as fh:
+                detail = json.load(fh)
+            if not isinstance(detail, dict):
+                detail = {}
+        except (OSError, ValueError):
+            detail = {}
+        detail["autoscale"] = out
         _write_detail(detail, here)
         print(json.dumps(out))
     elif args.affinity:

@@ -748,6 +748,69 @@ async def _cli_cluster(args: Any):
     from .client import Client
     from .cluster.storage import LocalStorage, Member
 
+    if args.demo and getattr(args, "cmd", "") == "scale":
+        # The scale demo needs an autoscale-enabled cluster: one supervisor
+        # with an in-process provisioner, pushed over its high band until
+        # the controller has a real decision to show.
+        import asyncio
+        import time as _time
+
+        from .autoscale import AutoscaleConfig, ScalePolicy
+        from .autoscale.provision import InProcessProvisioner
+        from .cluster.membership_protocol import LocalClusterProvider
+        from .object_placement import LocalObjectPlacement
+        from .server import Server
+        from .utils.routing_live import Echo, EchoActor, build_echo_registry
+
+        members = LocalStorage()
+        placement = LocalObjectPlacement()
+        provisioner = InProcessProvisioner(
+            members,
+            placement,
+            registry_builder=build_echo_registry,
+            server_kwargs={"load_interval": 0.1},
+        )
+        policy = ScalePolicy(
+            min_nodes=1, max_nodes=2, high_pressure=50.0, low_pressure=8.0,
+            sustain=2, inflight_weight=0.0, lag_weight=0.0, rate_weight=1.0,
+            shed_weight=0.0, out_cooldown_s=5.0,
+        )
+        supervisor = Server(
+            address="127.0.0.1:0",
+            registry=build_echo_registry(),
+            cluster_provider=LocalClusterProvider(members),
+            object_placement_provider=placement,
+            load_interval=0.1,
+            autoscale_config=AutoscaleConfig(
+                provisioner=provisioner, policy=policy, interval=0.1
+            ),
+        )
+        await supervisor.prepare()
+        await supervisor.bind()
+        serve = asyncio.ensure_future(supervisor.run())
+        client = Client(members)
+        deadline = _time.monotonic() + 30.0
+        i = 0
+        while (
+            supervisor.autoscale.scale_outs < 1
+            and _time.monotonic() < deadline
+        ):
+            i += 1
+            try:
+                await client.send(
+                    EchoActor, f"w{i % 8}", Echo(value=i), returns=Echo
+                )
+            except Exception:  # noqa: BLE001 — demo load, keep pushing
+                await asyncio.sleep(0.01)
+
+        async def cleanup() -> None:
+            client.close()
+            serve.cancel()
+            await asyncio.gather(serve, return_exceptions=True)
+            await provisioner.close()
+
+        return client, members, cleanup
+
     if args.demo:
         import asyncio
 
@@ -845,6 +908,7 @@ async def _cli_main(argv: Sequence[str] | None = None) -> int:
     import argparse
     import asyncio
     import json
+    import time
 
     parser = argparse.ArgumentParser(
         prog="python -m rio_tpu.admin",
@@ -929,6 +993,17 @@ async def _cli_main(argv: Sequence[str] | None = None) -> int:
     )
     trace_p.add_argument(
         "--limit", type=int, default=256, help="spans scraped per node"
+    )
+
+    scale_p = _common(
+        sub.add_parser(
+            "scale",
+            help="autoscale controller state: policy bands, cooldowns, "
+            "recent decisions",
+        )
+    )
+    scale_p.add_argument(
+        "--limit", type=int, default=16, help="decision rows shown (newest)"
     )
 
     args = parser.parse_args(argv)
@@ -1116,6 +1191,95 @@ async def _cli_main(argv: Sequence[str] | None = None) -> int:
                     print(format_waterfall(tid, tree))
                 print(f"[trace] {len(trees)} trace(s), {len(records)} span(s)")
             return 0 if (snapshots or records) else 1
+        if args.cmd == "scale":
+            from .autoscale import (
+                AUTOSCALE_ID,
+                AUTOSCALE_TYPE,
+                ScaleSnapshot,
+                ScaleStatus,
+            )
+
+            try:
+                snap = await client.send(
+                    AUTOSCALE_TYPE,
+                    AUTOSCALE_ID,
+                    ScaleStatus(limit=args.limit),
+                    returns=ScaleSnapshot,
+                )
+            except Exception as e:
+                print(f"scale: controller unreachable ({e.__class__.__name__})")
+                return 1
+            if not snap.address:
+                # The singleton answered from a node with autoscaling off —
+                # no runtime means no policy state worth rendering.
+                print("scale: no autoscale runtime on the controller's node")
+                return 1
+            if args.json:
+                print(json.dumps({
+                    "controller": snap.address,
+                    "pressure": snap.pressure,
+                    "nodes": snap.nodes,
+                    "over_streak": snap.over_streak,
+                    "under_streak": snap.under_streak,
+                    "cooldown_s": snap.cooldown_s,
+                    "pending": snap.pending,
+                    "scale_outs": snap.scale_outs,
+                    "scale_ins": snap.scale_ins,
+                    "ticks": snap.ticks,
+                    "alerts": snap.alerts,
+                    "policy": snap.policy,
+                    "decisions": [
+                        {
+                            "wall_ts": d[0],
+                            "action": d[1],
+                            "node": d[2],
+                            "rule": d[3],
+                            "pressure": d[4],
+                            "nodes": d[5],
+                            "detail": d[6],
+                        }
+                        for d in snap.decisions
+                    ],
+                }))
+                return 0
+            pol = snap.policy
+            print(
+                f"controller {snap.address}: pressure={snap.pressure:.2f} "
+                f"nodes={snap.nodes} over={snap.over_streak} "
+                f"under={snap.under_streak} ticks={snap.ticks}"
+            )
+            print(
+                f"policy: band=[{pol.get('low_pressure', 0):g}, "
+                f"{pol.get('high_pressure', 0):g}] "
+                f"sustain={pol.get('sustain', 0):g} "
+                f"nodes=[{pol.get('min_nodes', 0):g}, "
+                f"{pol.get('max_nodes', 0):g}] "
+                f"cooldowns out/in={pol.get('out_cooldown_s', 0):g}/"
+                f"{pol.get('in_cooldown_s', 0):g}s "
+                f"drain_timeout={pol.get('drain_timeout_s', 0):g}s"
+            )
+            print(
+                f"now: cooldown={snap.cooldown_s:.1f}s "
+                f"pending={snap.pending or '-'} "
+                f"alerts={','.join(snap.alerts) or '-'} "
+                f"outs={snap.scale_outs} ins={snap.scale_ins}"
+            )
+            if snap.decisions:
+                print("decisions (newest last):")
+                for d in snap.decisions:
+                    ts = time.strftime(
+                        "%H:%M:%S", time.localtime(float(d[0]))
+                    )
+                    print(
+                        f"  {ts} {d[1]:<10} {d[2]:<22} rule={d[3]} "
+                        f"pressure={d[4]:.2f} nodes={d[5]}"
+                        + (f" ({d[6]})" if d[6] else "")
+                    )
+            print(
+                f"[scale] controller={snap.address} "
+                f"{len(snap.decisions)} decision(s)"
+            )
+            return 0
         # watch: the trend table (one shot with --once/--json, else looped).
         while True:
             snapshots = await scrape_series(client, nodes, limit=args.window)

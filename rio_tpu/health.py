@@ -34,7 +34,13 @@ from fnmatch import fnmatchcase
 from typing import Any, Callable, Iterable
 
 from .journal import HEALTH, Journal
-from .timeseries import GaugeSeries, SeriesSample, rising_streak, series_values
+from .timeseries import (
+    GaugeSeries,
+    SeriesSample,
+    falling_streak,
+    rising_streak,
+    series_values,
+)
 
 __all__ = ["TrendRule", "HealthAlert", "HealthWatch", "default_rules"]
 
@@ -48,6 +54,9 @@ class TrendRule:
 
     * ``rising`` — the gauge rose ``windows`` consecutive samples, each
       step by more than ``min_delta`` (jitter floor).
+    * ``falling`` — the mirror: the gauge FELL ``windows`` consecutive
+      samples, each step by more than ``min_delta`` (scale-in style
+      signals: "load has been dropping for K windows").
     * ``delta`` — the gauge moved by more than ``min_delta`` across the
       window (monotonic counters: journal drops, busy sheds).
     * ``drift`` — the newest value exceeds ``factor`` × the window mean
@@ -57,7 +66,7 @@ class TrendRule:
 
     name: str
     gauge: str
-    kind: str = "rising"  # rising | delta | drift
+    kind: str = "rising"  # rising | falling | delta | drift
     windows: int = 3  # K consecutive samples (rising) / lookback (others)
     min_delta: float = 0.0
     factor: float = 2.0  # drift multiplier
@@ -135,6 +144,17 @@ def default_rules(
             windows=windows,
             factor=solve_drift_factor,
             min_delta=5.0,  # ignore drift below 5 ms absolute
+        ),
+        TrendRule(
+            name="cluster_load_falling",
+            gauge="rio.cluster.loop_lag_mean_ms",
+            kind="falling",
+            windows=windows,
+            # The scale-in style signal (ISSUE 19): cluster-mean loop lag
+            # dropping K consecutive windows means offered load is
+            # receding — informational here; the autoscale policy runs
+            # its own copy over the controller's pressure series.
+            min_delta=lag_min_delta_ms,
         ),
         TrendRule(
             name="cross_node_bytes_rising",
@@ -227,6 +247,11 @@ class HealthWatch:
             if streak < rule.windows:
                 return None
             detail = f"rose {streak} consecutive windows to {vals[-1]:g}"
+        elif rule.kind == "falling":
+            streak = falling_streak(vals, rule.min_delta)
+            if streak < rule.windows:
+                return None
+            detail = f"fell {streak} consecutive windows to {vals[-1]:g}"
         elif rule.kind == "delta":
             lookback = vals[-(rule.windows + 1) :]
             moved = lookback[-1] - lookback[0]

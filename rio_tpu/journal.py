@@ -83,6 +83,8 @@ FAULT = "fault"  # fault-injection schedule transition (scripted outage edges)
 STREAM = "stream"  # durable-stream transition (publish/deliver/commit edges)
 SAGA = "saga"  # saga step/compensation transition (workflow story)
 
+SCALE = "scale"  # autoscale decision/actuation edge (resize story)
+
 EVENT_KINDS: tuple[str, ...] = (
     MEMBER_UP,
     MEMBER_DOWN,
@@ -112,6 +114,7 @@ EVENT_KINDS: tuple[str, ...] = (
     FAULT,
     STREAM,
     SAGA,
+    SCALE,
 )
 
 

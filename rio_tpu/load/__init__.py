@@ -93,9 +93,14 @@ class LoadVector:
     req_rate: float = 0.0  # served requests/sec, EMA
     state_bytes: float = 0.0  # migration volatile bytes moved (cumulative)
     epoch: float = 0.0  # unix seconds the sample was taken
+    sheds: float = 0.0  # requests refused with ServerBusy (cumulative)
 
+    # Wire order. Append-only: new fields go at the END (after ``epoch``,
+    # even though that reads oddly) so legacy 6-field rows still decode
+    # and older readers simply never see the tail.
     _FIELDS = ("loop_lag_ms", "inflight", "registry_objects",
-               "req_rate", "state_bytes", "epoch")
+               "req_rate", "state_bytes", "epoch", "sheds")
+    _MIN_FIELDS = 6  # rows this short are the pre-`sheds` legacy format
 
     def encode(self) -> str:
         """Compact comma-joined form for the heartbeat row.
@@ -115,10 +120,13 @@ class LoadVector:
         if not raw:
             return None
         parts = str(raw).split(",")
-        if len(parts) != len(cls._FIELDS):
+        # Tolerant append-only growth: short legacy rows fill missing
+        # trailing fields with their defaults; extra trailing fields from
+        # a newer sender are ignored.
+        if len(parts) < cls._MIN_FIELDS:
             return None
         try:
-            values = [float(p) for p in parts]
+            values = [float(p) for p in parts[: len(cls._FIELDS)]]
         except ValueError:
             return None
         return cls(**dict(zip(cls._FIELDS, values)))
@@ -133,6 +141,7 @@ class LoadVector:
             req_rate=_finite(self.req_rate, hi=1e9),
             state_bytes=_finite(self.state_bytes),
             epoch=_finite(self.epoch),
+            sheds=_finite(self.sheds, hi=1e12),
         )
 
 
@@ -244,10 +253,45 @@ class ClusterLoadView:
             out[f"{base}.registry_objects"] = e.load.registry_objects
             out[f"{base}.req_rate"] = e.load.req_rate
             out[f"{base}.state_bytes"] = e.load.state_bytes
+            out[f"{base}.sheds"] = e.load.sheds
             out[f"{base}.staleness"] = (
                 -1.0 if math.isinf(e.staleness) else e.staleness
             )
             out[f"{base}.derate"] = e.derate
+        out.update(self.aggregate_gauges())
+        return out
+
+    def aggregate_gauges(self) -> dict[str, float]:
+        """Cluster-wide rollups (``rio.cluster.*``), the gauges trend rules
+        and the autoscale policy select with fnmatch like any per-node one.
+
+        Only FRESH entries contribute to means/totals — a node whose
+        heartbeat vector went stale (monitor died, partition froze the
+        row) must neither drag the mean down nor pin a total up; it is
+        counted separately in ``rio.cluster.nodes_stale``.
+        """
+        fresh = [e for e in self.entries.values() if not e.stale]
+        out = {
+            "rio.cluster.nodes": float(len(fresh)),
+            "rio.cluster.nodes_stale": float(len(self.entries) - len(fresh)),
+            "rio.cluster.loop_lag_mean_ms": 0.0,
+            "rio.cluster.loop_lag_max_ms": 0.0,
+            "rio.cluster.inflight_total": 0.0,
+            "rio.cluster.req_rate_total": 0.0,
+            "rio.cluster.registry_objects_total": 0.0,
+            "rio.cluster.sheds_total": 0.0,
+        }
+        if not fresh:
+            return out
+        lags = [e.load.loop_lag_ms for e in fresh]
+        out["rio.cluster.loop_lag_mean_ms"] = sum(lags) / len(lags)
+        out["rio.cluster.loop_lag_max_ms"] = max(lags)
+        out["rio.cluster.inflight_total"] = sum(e.load.inflight for e in fresh)
+        out["rio.cluster.req_rate_total"] = sum(e.load.req_rate for e in fresh)
+        out["rio.cluster.registry_objects_total"] = sum(
+            e.load.registry_objects for e in fresh
+        )
+        out["rio.cluster.sheds_total"] = sum(e.load.sheds for e in fresh)
         return out
 
     def __len__(self) -> int:
@@ -464,6 +508,7 @@ class LoadMonitor:
             req_rate=s.req_rate,
             state_bytes=s.state_bytes,
             epoch=time.time(),
+            sheds=float(s.sheds),
         )
 
     def encoded_snapshot(self) -> str:

@@ -123,6 +123,11 @@ def server_gauges(server: Any) -> dict[str, float]:
         # Trend-alarm state (rio.health.*): active/total alert counts plus
         # one 0/1 gauge per configured rule.
         gauges.update(health.gauges())
+    autoscale = getattr(server, "autoscale", None)
+    if autoscale is not None:
+        # Autoscale controller state (rio.autoscale.*): pressure EMA,
+        # band counters, decision totals, cooldown remaining.
+        gauges.update(autoscale.gauges())
     storage = getattr(server, "storage_health", None)
     if storage is not None:
         # Rendezvous-storage outage ledger (rio.storage.*): error/degraded

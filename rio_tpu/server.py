@@ -119,6 +119,7 @@ class Server:
         affinity_sampler: bool = True,
         affinity_stride: int = 8,
         affinity_top_k: int = 512,
+        autoscale_config=None,
     ) -> None:
         if transport not in ("asyncio", "native", "auto"):
             raise ValueError(f"unknown transport {transport!r}")
@@ -171,6 +172,12 @@ class Server:
         # replication_config — the replicas ARE the read capacity).
         self.read_scale_config = read_scale_config
         self.read_scale_manager = None  # created at bind() (needs the address)
+        # Elastic autoscaling (rio_tpu/autoscale): opt-in via an
+        # AutoscaleConfig (policy + NodeProvisioner). Disabled is FREE —
+        # no runtime, no poke task, no controller actor; only the
+        # getattr-None checks in otel/run remain.
+        self.autoscale_config = autoscale_config
+        self.autoscale = None  # created at bind() (needs the address)
         self._admin = AdminSender()
         self._internal = InternalClientSender()
         self._draining = ServerDraining()
@@ -459,6 +466,21 @@ class Server:
                 )
             )
             self.registry.add_type(AdminControl)
+        if self.autoscale_config is not None and self.autoscale is None:
+            # Elastic-autoscale control plane: the per-node runtime (in
+            # AppData — the singleton actor resolves it on whichever
+            # enabled node the directory seats it) plus the actor type.
+            from .autoscale import AutoscaleControl, AutoscaleRuntime
+
+            self.autoscale = AutoscaleRuntime(
+                address=self._local_addr,
+                members_storage=self.members_storage,
+                config=self.autoscale_config,
+                app_data=self.app_data,
+                journal=self.journal,
+            )
+            self.app_data.set(self.autoscale)
+            self.registry.add_type(AutoscaleControl)
         from .streams import StreamStorage
 
         if StreamStorage in self.app_data:
@@ -829,6 +851,10 @@ class Server:
             tasks.append(asyncio.ensure_future(self.load_monitor.run()))
         if self.replication_manager is not None:
             tasks.append(asyncio.ensure_future(self.replication_manager.run()))
+        if self.autoscale is not None:
+            # Every enabled node pokes the rio.Autoscale singleton each
+            # interval; only the current owner's poke ticks the policy.
+            tasks.append(asyncio.ensure_future(self.autoscale.poke_loop()))
         if self.placement_daemon_enabled:
             from .placement_daemon import PlacementDaemon
 
@@ -895,6 +921,9 @@ class Server:
                 self.replication_manager.close()
             if self.read_scale_manager is not None:
                 self.read_scale_manager.close()
+            if self.autoscale is not None:
+                with contextlib.suppress(Exception):
+                    await self.autoscale.close()
             # Leaving the cluster: mark self inactive so peers stop routing here.
             with contextlib.suppress(Exception):
                 host, _, port = self.local_address.rpartition(":")

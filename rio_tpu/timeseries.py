@@ -24,7 +24,8 @@ The ring is drained over the wire by ``rio.Admin``'s ``DumpSeries``
 message (see ``rio_tpu/admin.py`` for the cluster scrape and the
 ``watch`` CLI); :class:`~rio_tpu.health.HealthWatch` evaluates trend
 rules over it locally. The trend helpers at the bottom (``series_values``,
-``rising_streak``, ``trend_arrow``) are shared by both consumers.
+``rising_streak``, ``falling_streak``, ``trend_arrow``) are shared by
+both consumers.
 """
 
 from __future__ import annotations
@@ -39,6 +40,7 @@ __all__ = [
     "merge_series",
     "series_values",
     "rising_streak",
+    "falling_streak",
     "trend_arrow",
 ]
 
@@ -222,6 +224,23 @@ def rising_streak(values: Sequence[float], min_delta: float = 0.0) -> int:
     streak = 0
     for i in range(len(values) - 1, 0, -1):
         if values[i] - values[i - 1] > min_delta:
+            streak += 1
+        else:
+            break
+    return streak
+
+
+def falling_streak(values: Sequence[float], min_delta: float = 0.0) -> int:
+    """Length of the strictly-falling run ending at the newest value.
+
+    Mirror of :func:`rising_streak` for scale-in style rules ("load has
+    been dropping for K windows"): ``min_delta`` is the minimum per-step
+    DECREASE that counts, so a flat or jittering gauge never reads as
+    falling.
+    """
+    streak = 0
+    for i in range(len(values) - 1, 0, -1):
+        if values[i - 1] - values[i] > min_delta:
             streak += 1
         else:
             break

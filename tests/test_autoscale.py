@@ -1,0 +1,593 @@
+"""Elastic autoscaling (ISSUE 19): policy units, the controller state
+machine against deterministic fakes, and the directory-reseat integration.
+
+The deterministic tier drives :class:`AutoscaleRuntime.tick` directly with
+a fake membership view and a fake provisioner — every hysteresis /
+cooldown / pending-drain branch is exercised without timers or real
+servers. The integration tier boots real servers through
+``run_integration_test`` and kills the controller's owner to prove the
+``rio.Autoscale`` seat reseats through the standard dead-owner branch.
+
+The chaos tier (SIGKILL mid-drain under storage faults, all three fake
+backends) lives in tests/test_autoscale_chaos.py.
+"""
+
+import asyncio
+import time
+
+from rio_tpu import AppData, Registry
+from rio_tpu.autoscale import (
+    AUTOSCALE_ID,
+    AUTOSCALE_TYPE,
+    AutoscaleConfig,
+    AutoscaleRuntime,
+    NodeProvisioner,
+    ScalePolicy,
+    ScaleSnapshot,
+    ScaleStatus,
+)
+from rio_tpu.cluster.storage import Member
+from rio_tpu.journal import HEALTH, SCALE, Journal
+from rio_tpu.load import ClusterLoadView, LoadVector
+
+from .server_utils import run_integration_test
+
+# ---------------------------------------------------------------------------
+# Deterministic fakes
+# ---------------------------------------------------------------------------
+
+
+class FakeMembers:
+    """Membership view the tests script per tick: address → load fields.
+
+    ``active_members`` stamps a fresh epoch on every read, so the derived
+    :class:`ClusterLoadView` always sees the rows as live heartbeats.
+    """
+
+    def __init__(self) -> None:
+        self.rows: dict[str, dict] = {}
+
+    def set(self, address: str, **fields) -> None:
+        self.rows[address] = fields
+
+    def drop(self, address: str) -> None:
+        self.rows.pop(address, None)
+
+    async def active_members(self):
+        return [
+            Member.from_address(
+                addr,
+                active=True,
+                load=LoadVector(epoch=time.time(), **fields).encode(),
+            )
+            for addr, fields in self.rows.items()
+        ]
+
+
+class FakeProvisioner(NodeProvisioner):
+    """Records actuations; provisioned nodes appear in the fake membership."""
+
+    def __init__(self, members: FakeMembers, managed=()) -> None:
+        self.members = members
+        self._managed = list(managed)
+        self.provisions: list[str] = []
+        self.retires: list[tuple[str, bool]] = []
+        self.fail_provision = False
+        self._n = 0
+
+    async def provision(self) -> str:
+        if self.fail_provision:
+            raise RuntimeError("provisioning backend down")
+        self._n += 1
+        address = f"10.0.0.{self._n}:7000"
+        self._managed.append(address)
+        self.members.set(address, inflight=0.0)
+        self.provisions.append(address)
+        return address
+
+    async def retire(self, address: str, *, force: bool = False) -> None:
+        self.retires.append((address, force))
+        if address in self._managed:
+            self._managed.remove(address)
+        self.members.drop(address)
+
+    def managed(self):
+        return list(self._managed)
+
+
+SELF = "127.0.0.1:9000"
+
+
+def make_runtime(
+    members: FakeMembers,
+    provisioner: FakeProvisioner,
+    *,
+    policy: ScalePolicy | None = None,
+) -> AutoscaleRuntime:
+    policy = policy or ScalePolicy(
+        min_nodes=1,
+        max_nodes=4,
+        high_pressure=100.0,
+        low_pressure=10.0,
+        sustain=2,
+        ema_alpha=1.0,  # raw signal: the tests script exact pressures
+        inflight_weight=1.0,
+        lag_weight=0.0,
+        rate_weight=0.0,
+        shed_weight=0.0,
+        out_cooldown_s=5.0,
+        in_cooldown_s=5.0,
+        drain_timeout_s=60.0,
+    )
+    runtime = AutoscaleRuntime(
+        address=SELF,
+        members_storage=members,
+        config=AutoscaleConfig(provisioner=provisioner, policy=policy),
+        app_data=AppData(),
+        journal=Journal(node=SELF),
+    )
+    # Units never exercise the wire drain; record the request instead of
+    # opening a real client against the fake storage.
+    runtime.drain_requests = []
+
+    async def _fake_drain(victim: str) -> None:
+        runtime.drain_requests.append(victim)
+        runtime._journal("drain_requested", victim, ok=True, detail="fake")
+
+    runtime._request_drain = _fake_drain
+    return runtime
+
+
+def scale_events(runtime: AutoscaleRuntime) -> list:
+    return list(runtime.journal.events(kinds=[SCALE]))
+
+
+# ---------------------------------------------------------------------------
+# ScalePolicy units
+# ---------------------------------------------------------------------------
+
+
+def test_policy_pressure_blends_per_node_terms():
+    policy = ScalePolicy(
+        inflight_weight=2.0, lag_weight=3.0, rate_weight=0.5, shed_weight=10.0
+    )
+    agg = {
+        "rio.cluster.nodes": 4.0,
+        "rio.cluster.inflight_total": 40.0,  # 10/node
+        "rio.cluster.loop_lag_mean_ms": 5.0,  # already a mean, not divided
+        "rio.cluster.req_rate_total": 200.0,  # 50/node
+    }
+    got = policy.pressure_of(agg, shed_rate_per_node=3.0)
+    assert got == (10.0 * 2.0 + 5.0 * 3.0 + 50.0 * 0.5 + 3.0 * 10.0)
+    # An empty cluster never divides by zero.
+    assert policy.pressure_of({}) == 0.0
+
+
+def test_policy_rules_encode_sustain_as_trend_windows():
+    policy = ScalePolicy(sustain=4)
+    rules = {r.name: r for r in policy.rules()}
+    assert set(rules) == {
+        "scale_out_sustained",
+        "scale_in_sustained",
+        "pressure_rising",
+        "pressure_falling",
+    }
+    out, under = rules["scale_out_sustained"], rules["scale_in_sustained"]
+    assert out.gauge == "rio.autoscale.overload" and out.kind == "rising"
+    assert under.gauge == "rio.autoscale.underload" and under.kind == "rising"
+    assert out.windows == 4 and under.windows == 4
+    assert rules["pressure_falling"].kind == "falling"
+
+
+def test_policy_as_dict_carries_operator_knobs():
+    d = ScalePolicy(out_cooldown_s=7.0, drain_timeout_s=33.0).as_dict()
+    for key in (
+        "min_nodes",
+        "max_nodes",
+        "high_pressure",
+        "low_pressure",
+        "sustain",
+        "out_cooldown_s",
+        "in_cooldown_s",
+        "cooldown_max_s",
+        "drain_timeout_s",
+    ):
+        assert key in d, key
+    assert d["out_cooldown_s"] == 7.0 and d["drain_timeout_s"] == 33.0
+
+
+# ---------------------------------------------------------------------------
+# Controller state machine (deterministic ticks)
+# ---------------------------------------------------------------------------
+
+
+def test_scale_out_requires_sustained_overload():
+    async def main():
+        members = FakeMembers()
+        provisioner = FakeProvisioner(members)
+        runtime = make_runtime(members, provisioner)
+        members.set(SELF, inflight=50.0)  # in-band baseline sample
+        await runtime.tick()
+        members.set(SELF, inflight=500.0)  # pressure 500 >> band high 100
+
+        first = await runtime.tick()
+        assert not first.acted and provisioner.provisions == []
+
+        second = await runtime.tick()
+        assert second.acted and second.action == "scale_out"
+        assert len(provisioner.provisions) == 1
+        assert runtime.scale_outs == 1
+
+        # Causality: the sustain alarm is journaled as a HEALTH event and
+        # the decision's SCALE event names that rule as its trigger.
+        health = [
+            e for e in runtime.journal.events(kinds=[HEALTH])
+            if e.key == "scale_out_sustained"
+        ]
+        assert health, "sustain alarm must journal before the decision"
+        outs = [e for e in scale_events(runtime) if e.attrs["action"] == "scale_out"]
+        assert outs and outs[0].attrs["rule"] == "scale_out_sustained"
+        assert outs[0].key == provisioner.provisions[0]
+
+    asyncio.run(main())
+
+
+def test_single_spike_never_resizes():
+    async def main():
+        members = FakeMembers()
+        provisioner = FakeProvisioner(members)
+        runtime = make_runtime(members, provisioner)
+
+        members.set(SELF, inflight=500.0)  # one spiky sample...
+        await runtime.tick()
+        members.set(SELF, inflight=50.0)  # ...back inside the band
+        for _ in range(6):
+            ack = await runtime.tick()
+            assert not ack.acted
+        assert provisioner.provisions == [] and provisioner.retires == []
+        assert scale_events(runtime) == []
+
+    asyncio.run(main())
+
+
+def test_scale_out_respects_max_nodes():
+    async def main():
+        members = FakeMembers()
+        provisioner = FakeProvisioner(members)
+        policy = ScalePolicy(
+            min_nodes=1, max_nodes=1, high_pressure=100.0, low_pressure=10.0,
+            sustain=2, ema_alpha=1.0, inflight_weight=1.0, lag_weight=0.0,
+            shed_weight=0.0,
+        )
+        runtime = make_runtime(members, provisioner, policy=policy)
+        members.set(SELF, inflight=500.0)
+        for _ in range(5):
+            ack = await runtime.tick()
+            assert not ack.acted
+        assert provisioner.provisions == []
+
+    asyncio.run(main())
+
+
+def test_scale_in_respects_min_nodes():
+    async def main():
+        members = FakeMembers()
+        provisioner = FakeProvisioner(members, managed=["10.0.0.1:7000"])
+        members.set("10.0.0.1:7000", inflight=0.0)
+        policy = ScalePolicy(
+            min_nodes=2, max_nodes=4, high_pressure=100.0, low_pressure=10.0,
+            sustain=2, ema_alpha=1.0, inflight_weight=1.0, lag_weight=0.0,
+            shed_weight=0.0,
+        )
+        runtime = make_runtime(members, provisioner, policy=policy)
+        members.set(SELF, inflight=0.0)  # deeply underloaded, but 2 == min
+        for _ in range(5):
+            ack = await runtime.tick()
+            assert not ack.acted
+        assert provisioner.retires == []
+
+    asyncio.run(main())
+
+
+def test_cooldown_blocks_back_to_back_decisions():
+    async def main():
+        members = FakeMembers()
+        provisioner = FakeProvisioner(members)
+        runtime = make_runtime(members, provisioner)
+        members.set(SELF, inflight=50.0)  # in-band baseline sample
+        await runtime.tick()
+        members.set(SELF, inflight=500.0)
+        await runtime.tick()
+        ack = await runtime.tick()
+        assert ack.action == "scale_out"
+
+        # Overload persists, but the decorrelated-jitter cooldown holds.
+        for _ in range(4):
+            ack = await runtime.tick()
+            assert not ack.acted
+            assert "cooldown" in ack.detail
+        assert len(provisioner.provisions) == 1
+
+        # Cooldown expiry re-opens the band; streaks were reset by the
+        # decision, so it takes a fresh sustain run to act again.
+        runtime._cooldown_until = 0.0
+        acted = False
+        for _ in range(4):
+            ack = await runtime.tick()
+            acted = acted or ack.acted
+        assert acted and len(provisioner.provisions) == 2
+
+    asyncio.run(main())
+
+
+def test_scale_in_drains_then_retires_on_departure():
+    async def main():
+        members = FakeMembers()
+        victim = "10.0.0.1:7000"
+        provisioner = FakeProvisioner(members, managed=[victim])
+        members.set(SELF, inflight=50.0)  # in-band baseline sample
+        members.set(victim, inflight=50.0)
+        runtime = make_runtime(members, provisioner)
+        await runtime.tick()
+        members.set(SELF, inflight=1.0)
+        members.set(victim, inflight=1.0)
+
+        await runtime.tick()
+        ack = await runtime.tick()
+        assert ack.acted and ack.action == "scale_in" and ack.detail == victim
+        assert runtime.pending == victim
+        assert runtime.drain_requests == [victim]
+
+        # Still a member: the pending drain owns the controller.
+        ack = await runtime.tick()
+        assert not ack.acted and "draining" in ack.detail
+        assert provisioner.retires == []
+
+        # The victim leaves membership (drain completed) → retire, un-forced.
+        members.drop(victim)
+        ack = await runtime.tick()
+        assert ack.acted and ack.action == "retired"
+        assert provisioner.retires == [(victim, False)]
+        assert runtime.scale_ins == 1 and runtime.pending == ""
+
+        actions = [e.attrs["action"] for e in scale_events(runtime)]
+        assert actions == ["scale_in", "drain_requested", "retired"]
+        retired = scale_events(runtime)[-1]
+        assert retired.attrs["forced"] is False
+        assert retired.attrs["rule"] == "scale_in_sustained"
+
+    asyncio.run(main())
+
+
+def test_drain_deadline_forces_the_retire():
+    async def main():
+        members = FakeMembers()
+        victim = "10.0.0.1:7000"
+        provisioner = FakeProvisioner(members, managed=[victim])
+        members.set(SELF, inflight=1.0)
+        members.set(victim, inflight=1.0)
+        policy = ScalePolicy(
+            min_nodes=1, max_nodes=4, high_pressure=100.0, low_pressure=10.0,
+            sustain=2, ema_alpha=1.0, inflight_weight=1.0, lag_weight=0.0,
+            shed_weight=0.0, drain_timeout_s=0.0,
+        )
+        runtime = make_runtime(members, provisioner, policy=policy)
+        members.set(SELF, inflight=50.0)  # in-band baseline sample
+        members.set(victim, inflight=50.0)
+        await runtime.tick()
+        members.set(SELF, inflight=1.0)
+        members.set(victim, inflight=1.0)
+        await runtime.tick()
+        ack = await runtime.tick()
+        assert ack.action == "scale_in"
+
+        # Victim never leaves membership; the zero deadline has already
+        # passed by the next tick → forced retire.
+        ack = await runtime.tick()
+        assert ack.acted and ack.action == "retired"
+        assert provisioner.retires == [(victim, True)]
+        retired = [
+            e for e in scale_events(runtime) if e.attrs["action"] == "retired"
+        ][-1]
+        assert retired.attrs["forced"] is True
+
+    asyncio.run(main())
+
+
+def test_pending_scale_in_suppresses_new_decisions():
+    async def main():
+        members = FakeMembers()
+        victim = "10.0.0.1:7000"
+        provisioner = FakeProvisioner(members, managed=[victim])
+        members.set(SELF, inflight=1.0)
+        members.set(victim, inflight=50.0)
+        members.set(SELF, inflight=50.0)  # in-band baseline sample
+        runtime = make_runtime(members, provisioner)
+        await runtime.tick()
+        members.set(SELF, inflight=1.0)
+        members.set(victim, inflight=1.0)
+        await runtime.tick()
+        ack = await runtime.tick()
+        assert ack.action == "scale_in"
+
+        # Load whipsaws to overload mid-drain: the pending scale-in still
+        # owns the controller — no overlapping scale-out.
+        members.set(SELF, inflight=500.0)
+        for _ in range(4):
+            ack = await runtime.tick()
+            assert not ack.acted and "draining" in ack.detail
+        assert provisioner.provisions == []
+
+    asyncio.run(main())
+
+
+def test_victim_pick_is_managed_only_and_never_self():
+    members = FakeMembers()
+    provisioner = FakeProvisioner(members, managed=["10.0.0.9:7000"])
+    runtime = make_runtime(members, provisioner)
+
+    def view_of(rows: dict[str, float]) -> ClusterLoadView:
+        ms = [
+            Member.from_address(
+                a, active=True,
+                load=LoadVector(inflight=v, epoch=time.time()).encode(),
+            )
+            for a, v in rows.items()
+        ]
+        return ClusterLoadView.from_members(ms)
+
+    # The unmanaged idle node is NOT eligible; the busier managed one is.
+    rows = {SELF: 0.0, "10.0.0.9:7000": 30.0, "10.0.0.2:7000": 0.0}
+    got = runtime._pick_victim(view_of(rows), set(rows))
+    assert got == "10.0.0.9:7000"
+
+    # With nothing managed, any peer qualifies — lowest load, never self.
+    provisioner._managed = []
+    got = runtime._pick_victim(view_of({SELF: 0.0, "10.0.0.2:7000": 5.0,
+                                        "10.0.0.3:7000": 1.0}),
+                               {SELF, "10.0.0.2:7000", "10.0.0.3:7000"})
+    assert got == "10.0.0.3:7000"
+
+    # A cluster of one (only self) has no eligible victim.
+    assert runtime._pick_victim(view_of({SELF: 0.0}), {SELF}) is None
+
+
+def test_scale_out_failure_journals_and_arms_cooldown():
+    async def main():
+        members = FakeMembers()
+        provisioner = FakeProvisioner(members)
+        provisioner.fail_provision = True
+        runtime = make_runtime(members, provisioner)
+        members.set(SELF, inflight=50.0)  # in-band baseline sample
+        await runtime.tick()
+        members.set(SELF, inflight=500.0)
+        await runtime.tick()
+        ack = await runtime.tick()
+        assert ack.action == "scale_out" and not ack.acted
+        assert runtime.scale_outs == 0
+        failed = [
+            e for e in scale_events(runtime)
+            if e.attrs["action"] == "scale_out_failed"
+        ]
+        assert failed and "down" in failed[0].attrs["error"]
+        # The failure armed the cooldown — no hot retry loop against a
+        # dead provisioning backend.
+        ack = await runtime.tick()
+        assert "cooldown" in ack.detail
+
+    asyncio.run(main())
+
+
+def test_status_snapshot_shape_and_decision_rows():
+    async def main():
+        members = FakeMembers()
+        provisioner = FakeProvisioner(members)
+        runtime = make_runtime(members, provisioner)
+        members.set(SELF, inflight=50.0)  # in-band baseline sample
+        await runtime.tick()
+        members.set(SELF, inflight=500.0)
+        await runtime.tick()
+        await runtime.tick()
+
+        s = runtime.status(limit=8)
+        for key in (
+            "address", "pressure", "nodes", "over_streak", "under_streak",
+            "cooldown_s", "pending", "scale_outs", "scale_ins", "ticks",
+            "alerts", "policy", "decisions",
+        ):
+            assert key in s, key
+        assert s["address"] == SELF and s["scale_outs"] == 1
+        # Positional decision rows: [wall_ts, action, node, rule, pressure,
+        # nodes, detail] — append-only, the admin CLI indexes them.
+        row = s["decisions"][-1]
+        assert len(row) == 7
+        assert row[1] == "scale_out" and row[3] == "scale_out_sustained"
+        assert row[2] == provisioner.provisions[0]
+        assert isinstance(row[0], float) and row[0] > 0
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# Integration: the seat reseats when its owner dies
+# ---------------------------------------------------------------------------
+
+
+def test_controller_reseats_after_owner_death():
+    """Kill whichever node the directory seated ``rio.Autoscale`` on; the
+    survivor's next poke takes the standard dead-owner branch and the
+    controller answers from its new host — the framework's own failover,
+    no autoscale-specific reseat code."""
+    from rio_tpu.utils.routing_live import build_echo_registry
+
+    def build_registry() -> Registry:
+        return build_echo_registry()
+
+    async def body(cluster):
+        client = cluster.client()
+        try:
+            snap = None
+            deadline = asyncio.get_event_loop().time() + 15.0
+            while asyncio.get_event_loop().time() < deadline:
+                try:
+                    snap = await client.send(
+                        AUTOSCALE_TYPE, AUTOSCALE_ID,
+                        ScaleStatus(limit=4), returns=ScaleSnapshot,
+                    )
+                    if snap.address and snap.ticks > 0:
+                        break
+                except Exception:
+                    pass
+                await asyncio.sleep(0.05)
+            assert snap is not None and snap.address, "controller never seated"
+            owner = snap.address
+
+            victims = [s for s in cluster.servers if s.local_address == owner]
+            assert victims, f"owner {owner} is not one of our servers"
+            victim = victims[0]
+            idx = cluster.servers.index(victim)
+            # Abrupt owner death (no drain): cancel its serve task — run()'s
+            # teardown marks the member inactive, like a crashed process.
+            cluster.tasks[idx].cancel()
+
+            deadline = asyncio.get_event_loop().time() + 20.0
+            reseated = ""
+            while asyncio.get_event_loop().time() < deadline:
+                try:
+                    snap = await client.send(
+                        AUTOSCALE_TYPE, AUTOSCALE_ID,
+                        ScaleStatus(limit=4), returns=ScaleSnapshot,
+                    )
+                    if snap.address and snap.address != owner:
+                        reseated = snap.address
+                        break
+                except Exception:
+                    pass
+                await asyncio.sleep(0.1)
+            assert reseated, "controller never reseated after owner death"
+            assert reseated != owner
+        finally:
+            client.close()
+
+    # Both nodes are autoscale-enabled with a pinned min==max policy: the
+    # controller ticks (so the test can observe it) but never has a
+    # decision to make — this test is about the SEAT, not the policy. The
+    # trait base suffices as the provisioner: it never actuates.
+    server_kwargs = {
+        "load_interval": 0.1,
+        "autoscale_config": AutoscaleConfig(
+            provisioner=NodeProvisioner(),
+            policy=ScalePolicy(min_nodes=2, max_nodes=2),
+            interval=0.1,
+        ),
+    }
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_registry,
+            num_servers=2,
+            timeout=45.0,
+            server_kwargs=server_kwargs,
+        )
+    )

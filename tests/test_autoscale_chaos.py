@@ -1,0 +1,383 @@
+"""Autoscale chaos: SIGKILL mid-scale-in drain, under storage fire (ISSUE 19).
+
+The capstone scenario for the elastic subsystem: a supervisor + one
+elastic node serve acked counter traffic over a SHARED durable state
+provider while the controller decides a scale-in. The moment the drain
+starts, the test kills the victim abruptly (the in-process analogue of
+SIGKILL) AND blips the membership+placement storage behind a seeded
+:class:`~rio_tpu.faults.FaultSchedule`. The contract:
+
+* **zero lost acked writes** — every ``add`` the client saw acked is in
+  the reloaded counter state (ack-after-save: duplicates are possible and
+  tolerated, loss is not);
+* **rows reseat on survivors** — keys that lived on the victim answer
+  from the supervisor after the retire;
+* **the scale-in state machine absorbs the kill** — drain interrupted by
+  death converts into the membership-departure (or drain-deadline) branch
+  and still journals ``scale_in → retired``;
+* **the journal carries the whole causal story** — HEALTH sustain alarm,
+  SCALE decision edges, STORAGE degraded/recovered edges for the blips.
+
+Runs against all three storage fakes: sqlite files, the DBAPI-level
+Postgres fake (tests/fake_pg.py), and the RESP2 Redis fake
+(tests/fake_redis.py) — the trait-level fault wrappers inject on top of
+each real backend, so their error paths execute too. The long ramp soak
+(real OS processes, real SIGKILL, offered-load ramp) is the slow lane.
+"""
+
+import asyncio
+import contextlib
+import os
+import time
+
+import pytest
+
+from rio_tpu import AppData, Client
+from rio_tpu.autoscale import AutoscaleConfig, ScalePolicy
+from rio_tpu.autoscale.provision import InProcessProvisioner
+from rio_tpu.cluster.membership_protocol import LocalClusterProvider
+from rio_tpu.errors import (
+    Disconnect,
+    RetryExhausted,
+    ServerBusy,
+    ServerNotAvailable,
+)
+from rio_tpu.faults import (
+    FaultSchedule,
+    FaultyMembershipStorage,
+    FaultyObjectPlacement,
+    StorageHealth,
+)
+from rio_tpu.journal import HEALTH, SCALE
+from rio_tpu.server import Server
+from rio_tpu.state import StateProvider
+from rio_tpu.state.sqlite import SqliteState
+from rio_tpu.utils import ExponentialBackoff
+from rio_tpu.utils.autoscale_live import (
+    Add,
+    Get,
+    SoakCounter,
+    Total,
+    build_soak_registry,
+)
+
+RETRYABLE = (RetryExhausted, ServerBusy, ServerNotAvailable, Disconnect, OSError)
+
+
+# ---------------------------------------------------------------------------
+# Backend matrix: real storage implementations under the fault wrappers
+# ---------------------------------------------------------------------------
+
+
+async def _open_backend(name: str, tmp_path):
+    """Returns ``(members_inner, placement_inner, cleanup)`` for one of the
+    three storage fakes; prepare() runs fault-free (bring-up is not the
+    scenario under test — the drain is)."""
+    if name == "sqlite":
+        from rio_tpu.cluster.storage.sqlite import SqliteMembershipStorage
+        from rio_tpu.object_placement.sqlite import SqliteObjectPlacement
+
+        members = SqliteMembershipStorage(str(tmp_path / "members.db"))
+        placement = SqliteObjectPlacement(str(tmp_path / "placement.db"))
+
+        async def cleanup():
+            pass
+
+        return members, placement, cleanup
+
+    if name == "pg":
+        from tests import fake_pg
+
+        fake_pg.install()
+        fake_pg.reset()
+        from rio_tpu.cluster.storage.postgres import PostgresMembershipStorage
+        from rio_tpu.object_placement.postgres import PostgresObjectPlacement
+
+        dsn = "postgresql://fake-pg/autoscale_chaos"
+        members = PostgresMembershipStorage(dsn)
+        placement = PostgresObjectPlacement(dsn)
+
+        async def cleanup():
+            fake_pg.reset()
+
+        return members, placement, cleanup
+
+    if name == "redis":
+        from rio_tpu.cluster.storage.redis import RedisMembershipStorage
+        from rio_tpu.object_placement.redis import RedisObjectPlacement
+        from rio_tpu.utils.resp import RedisClient
+
+        from .fake_redis import FakeRedisServer
+
+        server = await FakeRedisServer().start()
+        members = RedisMembershipStorage(
+            RedisClient("127.0.0.1", server.port), key_prefix="as_m"
+        )
+        placement = RedisObjectPlacement(
+            RedisClient("127.0.0.1", server.port), key_prefix="as_p"
+        )
+
+        async def cleanup():
+            with contextlib.suppress(Exception):
+                await server.stop()
+
+        return members, placement, cleanup
+
+    raise AssertionError(f"unknown backend {name}")
+
+
+# ---------------------------------------------------------------------------
+# The scenario
+# ---------------------------------------------------------------------------
+
+
+async def _drain_under_fire(backend: str, tmp_path) -> None:
+    members_inner, placement_inner, backend_cleanup = await _open_backend(
+        backend, tmp_path
+    )
+    schedule = FaultSchedule(seed=2024)
+    storage_health = StorageHealth()
+    members = FaultyMembershipStorage(members_inner, schedule, storage_health)
+    placement = FaultyObjectPlacement(
+        placement_inner, schedule, storage_health
+    )
+    await members.prepare()
+    await placement.prepare()
+
+    # One durable state provider shared by every node: ack-after-save on
+    # the counter means a SIGKILLed node loses nothing the client saw.
+    state = SqliteState(os.path.join(str(tmp_path), "chaos-state.db"))
+    await state.prepare()
+
+    def app_data_builder() -> AppData:
+        ad = AppData()
+        ad.set(state, as_type=StateProvider)
+        return ad
+
+    provisioner = InProcessProvisioner(
+        members,
+        placement,
+        registry_builder=build_soak_registry,
+        server_kwargs={"load_interval": 0.05},
+        app_data_builder=app_data_builder,
+    )
+    # Deep-underload band: the idle cluster is always below low_pressure,
+    # so the sustain rule arms as soon as the controller may act.
+    policy = ScalePolicy(
+        min_nodes=1,
+        max_nodes=2,
+        high_pressure=1e9,
+        low_pressure=1e8,
+        sustain=2,
+        ema_alpha=1.0,
+        out_cooldown_s=0.1,
+        in_cooldown_s=0.1,
+        cooldown_max_s=0.5,
+        drain_timeout_s=4.0,
+    )
+    supervisor = Server(
+        address="127.0.0.1:0",
+        registry=build_soak_registry(),
+        cluster_provider=LocalClusterProvider(members),
+        object_placement_provider=placement,
+        app_data=app_data_builder(),
+        load_interval=0.05,
+        autoscale_config=AutoscaleConfig(
+            provisioner=provisioner, policy=policy, interval=0.05
+        ),
+    )
+    await supervisor.prepare()
+    supervisor_addr = await supervisor.bind()
+    runtime = supervisor.autoscale
+    assert runtime is not None
+    # Freeze decisions (a real mechanism: the cooldown gate) until the
+    # traffic is seeded — the scenario needs seated keys on the victim
+    # BEFORE the controller is allowed to retire it.
+    runtime._cooldown_until = time.monotonic() + 3600.0
+    serve = asyncio.ensure_future(supervisor.run())
+
+    client = Client(
+        members,
+        backoff=ExponentialBackoff(initial=0.01, cap=0.1, max_retries=6),
+    )
+    acked: dict[str, int] = {}
+    seat: dict[str, str] = {}
+    stop_writing = asyncio.Event()
+    write_failures = 0
+
+    async def acked_add(key: str) -> bool:
+        nonlocal write_failures
+        try:
+            got = await client.send(SoakCounter, key, Add(n=1), returns=Total)
+        except RETRYABLE:
+            write_failures += 1
+            return False
+        acked[key] = acked.get(key, 0) + 1
+        seat[key] = got.address
+        return True
+
+    async def writer() -> None:
+        i = 0
+        while not stop_writing.is_set():
+            await acked_add(f"k{i % len(keys)}")
+            i += 1
+            await asyncio.sleep(0.01)
+
+    try:
+        # Seat pinned first: with one node up, the controller seats on the
+        # supervisor; the victim provisioned after can only serve keys.
+        deadline = time.monotonic() + 15.0
+        while runtime.ticks < 1:
+            assert time.monotonic() < deadline, "controller never ticked"
+            await asyncio.sleep(0.02)
+        victim = await provisioner.provision()
+        assert victim != supervisor_addr
+
+        # Fresh allocations seat on the serving node, so pre-seat half the
+        # keys on the victim through the directory (the faults_live
+        # identical-pre-seating idiom) — the scenario NEEDS rows on the
+        # node about to die.
+        from rio_tpu.object_placement import ObjectId, ObjectPlacementItem
+
+        keys = [f"k{i}" for i in range(16)]
+        for i, key in enumerate(keys):
+            await placement.update(
+                ObjectPlacementItem(
+                    object_id=ObjectId("SoakCounter", key),
+                    server_address=victim if i % 2 else supervisor_addr,
+                )
+            )
+        for key in keys:
+            ok = False
+            for _ in range(40):
+                if await acked_add(key):
+                    ok = True
+                    break
+                await asyncio.sleep(0.05)
+            assert ok, f"{key} never acked during seeding"
+        assert set(seat.values()) == {victim, supervisor_addr}, seat
+        victims_keys = [k for k, a in seat.items() if a == victim]
+        assert victims_keys, "no key seated on the victim"
+
+        # Live traffic for the rest of the scenario.
+        writing = asyncio.ensure_future(writer())
+
+        # Unfreeze: the sustained-underload alarm is already armed, so the
+        # next tick decides the scale-in and requests the drain.
+        runtime._cooldown_until = 0.0
+        deadline = time.monotonic() + 15.0
+        while runtime.pending != victim:
+            assert time.monotonic() < deadline, "scale-in never began"
+            await asyncio.sleep(0.01)
+
+        # Mid-drain chaos: storage blip + abrupt victim death.
+        schedule.fail_all("membership.*")
+        schedule.fail_all("placement.*")
+        provisioner.kill(victim)
+        await asyncio.sleep(0.3)
+        schedule.heal()
+
+        # The state machine must still converge: departure (or the drain
+        # deadline) turns the pending scale-in into a retire.
+        deadline = time.monotonic() + 30.0
+        while runtime.scale_ins < 1:
+            assert time.monotonic() < deadline, "victim never retired"
+            await asyncio.sleep(0.05)
+
+        stop_writing.set()
+        await writing
+
+        # Zero lost acked writes; the victim's keys answer from a survivor.
+        lost = []
+        for key in keys:
+            want = acked.get(key, 0)
+            if want == 0:
+                continue
+            # Reseat can wait on the drain deadline + membership
+            # convergence after the mid-blip kill — retry on a deadline,
+            # not a count.
+            got = None
+            read_deadline = time.monotonic() + 20.0
+            while time.monotonic() < read_deadline:
+                try:
+                    got = await client.send(
+                        SoakCounter, key, Get(), returns=Total
+                    )
+                    break
+                except RETRYABLE:
+                    await asyncio.sleep(0.1)
+            assert got is not None, f"{key} unreachable after retire"
+            if got.value < want:
+                lost.append((key, want, got.value))
+            if key in victims_keys:
+                assert got.address == supervisor_addr, (
+                    f"{key} did not reseat on the survivor: {got.address}"
+                )
+        assert not lost, f"LOST acked writes: {lost}"
+
+        # The causal journal story, in one merged stream.
+        health_rules = {
+            e.key for e in supervisor.journal.events(kinds=[HEALTH])
+        }
+        assert "scale_in_sustained" in health_rules
+        scale_actions = [
+            e.attrs["action"] for e in supervisor.journal.events(kinds=[SCALE])
+        ]
+        assert "scale_in" in scale_actions and "retired" in scale_actions
+        assert scale_actions.index("scale_in") < scale_actions.index("retired")
+        # The seeded schedule really fired mid-drain: the controller's
+        # own 50 ms membership reads cannot miss a 300 ms blip.
+        assert schedule.injected_errors > 0, "the blip injected nothing"
+    finally:
+        stop_writing.set()
+        with contextlib.suppress(Exception):
+            client.close()
+        from rio_tpu.commands import AdminCommand
+
+        with contextlib.suppress(Exception):
+            supervisor.admin_sender().send(AdminCommand.server_exit())
+        with contextlib.suppress(Exception, asyncio.CancelledError):
+            await asyncio.wait_for(asyncio.shield(serve), timeout=10.0)
+        if not serve.done():
+            serve.cancel()
+            with contextlib.suppress(Exception, asyncio.CancelledError):
+                await serve
+        with contextlib.suppress(Exception):
+            await provisioner.close()
+        with contextlib.suppress(Exception):
+            await runtime.close()
+        for closer in (state, members, placement):
+            with contextlib.suppress(Exception):
+                close = getattr(closer, "close", None)
+                if close is not None:
+                    out = close()
+                    if asyncio.iscoroutine(out):
+                        await out
+        await backend_cleanup()
+
+
+@pytest.mark.parametrize("backend", ["sqlite", "pg", "redis"])
+def test_drain_under_fire(backend, tmp_path):
+    asyncio.run(_drain_under_fire(backend, tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# Nightly: the full ramp soak (real OS processes, real SIGKILL)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_autoscale_ramp_soak_long():
+    from rio_tpu.utils.autoscale_live import measure_autoscale_ramp
+
+    out = asyncio.run(
+        measure_autoscale_ramp(
+            warm_secs=5.0,
+            high_timeout=120.0,
+            settle_timeout=240.0,
+        )
+    )
+    assert out["lost"] == 0
+    assert out["scale_outs"] >= 1 and out["scale_ins"] >= 1
+    assert out["killed_mid_drain"]
+    assert out["final_nodes"] <= 2

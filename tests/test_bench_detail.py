@@ -591,3 +591,36 @@ def test_committed_cpu_capture_banks_hier_mesh_ab_with_provenance():
         assert len(ab[arm]["chunk_ms"]) == ab[arm]["n_chunks"] > 1
         assert ab[arm]["first_chunk_ms"] >= max(ab[arm]["chunk_ms"][1:])
     assert set(ab["host"]) == {"cpu_count", "sched_affinity", "loadavg"}
+
+
+def test_autoscale_banks_to_cpu_sidecar_and_never_carries(tmp_path):
+    """The autoscale stage (idle-overhead A/B + ramp soak) is a host
+    stage: banked under host provenance — a banked ramp IS a passed soak —
+    and never carried into a later tpu bank (the paired off/on ratio and
+    the soak's latencies only mean anything under that run's box weather)."""
+    stage = {
+        "idle": {
+            "msgs_per_sec": {"off": 18000.0, "on": 17900.0},
+            "autoscale_overhead_pct": 0.55,
+            "controller_ticks_on": 48,
+        },
+        "ramp": {
+            "scale_outs": 1,
+            "scale_ins": 1,
+            "lost": 0,
+            "killed_mid_drain": "127.0.0.1:39525",
+            "p99_ms": 36.1,
+        },
+        "host": {"cpu_count": 4, "sched_affinity": [0, 1, 2, 3],
+                 "loadavg": [0.5, 0.4, 0.3]},
+    }
+    _write_detail(
+        {"solve_tier": {"platform": "cpu"}, "autoscale": stage},
+        here=str(tmp_path),
+    )
+    banked = _read(tmp_path, "BENCH_DETAIL.cpu.json")
+    assert banked["autoscale"] == stage
+    # A later tpu run must not inherit it.
+    _write_detail({"solve_tier": {"platform": "tpu"}}, here=str(tmp_path))
+    tpu = _read(tmp_path, "BENCH_DETAIL.tpu.json")
+    assert "autoscale" not in tpu and "autoscale_carried" not in tpu

@@ -52,7 +52,7 @@ def test_load_vector_roundtrip():
 
 
 def test_load_vector_decode_tolerates_garbage():
-    for raw in (None, "", "legacy", "1,2,3", "a,b,c,d,e,f", "1,2,3,4,5,6,7"):
+    for raw in (None, "", "legacy", "1,2,3", "a,b,c,d,e,f"):
         assert LoadVector.decode(raw) is None
     # Parseable but insane values decode, then sanitize to something safe.
     v = LoadVector.decode("nan,-5,1e99,inf,-1,0")
@@ -62,6 +62,20 @@ def test_load_vector_decode_tolerates_garbage():
     assert s.inflight == 0.0  # negative -> clamped
     assert math.isfinite(s.registry_objects)
     assert s.req_rate == 0.0  # inf -> default
+
+
+def test_load_vector_sheds_append_only_growth():
+    # Current 7-field rows round-trip the sheds counter...
+    v = LoadVector(inflight=2, sheds=17.0, epoch=1700000000.0)
+    d = LoadVector.decode(v.encode())
+    assert d is not None and d.sheds == 17.0
+    # ...pre-sheds 6-field legacy rows still decode (sheds defaults 0)...
+    legacy = ",".join(v.encode().split(",")[:6])
+    d6 = LoadVector.decode(legacy)
+    assert d6 is not None and d6.sheds == 0.0 and d6.inflight == 2.0
+    # ...and extra trailing fields from a NEWER sender are ignored.
+    d8 = LoadVector.decode(v.encode() + ",99")
+    assert d8 is not None and d8.sheds == 17.0
 
 
 def test_capacity_derate_monotone_and_bounded():
@@ -125,6 +139,40 @@ def test_cluster_view_staleness_and_garbage():
     assert g["rio.cluster_load.10.0.0.1:1.inflight"] == 512.0
     assert g["rio.cluster_load.10.0.0.3:1.staleness"] == -1.0
     assert all(isinstance(x, float) and not math.isnan(x) for x in g.values())
+
+
+def test_cluster_aggregate_gauges_roll_up_fresh_entries_only():
+    now = time.time()
+    a = LoadVector(loop_lag_ms=2.0, inflight=10, req_rate=100.0,
+                   registry_objects=5, sheds=3.0, epoch=now - 1.0).encode()
+    b = LoadVector(loop_lag_ms=6.0, inflight=30, req_rate=300.0,
+                   registry_objects=15, sheds=4.0, epoch=now - 2.0).encode()
+    stale = LoadVector(loop_lag_ms=999.0, inflight=999, req_rate=9999.0,
+                       epoch=now - 10 * DEFAULT_MAX_STALENESS).encode()
+    view = ClusterLoadView.from_members(
+        [_member("10.0.0.1:1", a), _member("10.0.0.2:1", b),
+         _member("10.0.0.3:1", stale)],
+        now=now,
+    )
+    g = view.aggregate_gauges()
+    assert g["rio.cluster.nodes"] == 2.0
+    assert g["rio.cluster.nodes_stale"] == 1.0
+    # The stale node's insane vector is excluded from every rollup.
+    assert g["rio.cluster.loop_lag_mean_ms"] == 4.0
+    assert g["rio.cluster.loop_lag_max_ms"] == 6.0
+    assert g["rio.cluster.inflight_total"] == 40.0
+    assert g["rio.cluster.req_rate_total"] == 400.0
+    assert g["rio.cluster.registry_objects_total"] == 20.0
+    assert g["rio.cluster.sheds_total"] == 7.0
+    # The rollups ride the ordinary gauge scrape (fnmatch-selectable).
+    assert view.gauges()["rio.cluster.req_rate_total"] == 400.0
+
+
+def test_cluster_aggregate_gauges_empty_view_is_all_zero():
+    view = ClusterLoadView.from_members([], now=time.time())
+    g = view.aggregate_gauges()
+    assert g["rio.cluster.nodes"] == 0.0
+    assert all(v == 0.0 for v in g.values())
 
 
 def test_cluster_view_chaos_vectors_all_bounded():

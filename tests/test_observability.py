@@ -603,3 +603,86 @@ def test_otel_auto_registration_picks_up_health_gauges():
     asyncio.run(
         run_integration_test(body, registry_builder=build_registry, num_servers=2)
     )
+
+
+def test_cluster_aggregate_gauges_export_through_otel_bridge():
+    """ISSUE 19: the rio.cluster.* rollups ClusterLoadView derives from the
+    membership heartbeats must surface through server_gauges — fnmatch
+    selectors in HealthWatch/ScalePolicy rules and the OTel auto-register
+    re-scan both read that one snapshot, so no dedicated wiring exists."""
+    import fnmatch
+
+    from . import fake_otel
+    from rio_tpu.otel import otlp_metrics_exporter, server_gauges
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        try:
+            for i in range(8):
+                await client.send(Observed, f"agg{i}", Hit(), returns=Echo)
+            server = cluster.servers[0]
+            # The load monitor publishes vectors on load_interval and the
+            # view refreshes from membership on the same cadence — poll
+            # until both servers' heartbeats are FRESH in the rollup.
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while True:
+                gauges = server_gauges(server)
+                if (
+                    gauges.get("rio.cluster.nodes", 0.0) >= 2.0
+                    # Per-node object counts are SAMPLED per load tick, so
+                    # a pre-seating heartbeat can be fresh yet still carry
+                    # zero — wait for the post-seating sample to publish.
+                    and gauges.get("rio.cluster.registry_objects_total", 0.0)
+                    >= 8.0
+                ):
+                    break
+                if asyncio.get_event_loop().time() > deadline:
+                    seen = sorted(fnmatch.filter(gauges, "rio.cluster.*"))
+                    raise AssertionError(
+                        f"rio.cluster.* never rolled up both nodes: {seen}"
+                    )
+                await asyncio.sleep(0.05)
+
+            # The full aggregate family is selectable the way trend rules
+            # select gauges — one fnmatch pattern, no per-key registration.
+            family = set(fnmatch.filter(gauges, "rio.cluster.*"))
+            for want in (
+                "rio.cluster.nodes",
+                "rio.cluster.nodes_stale",
+                "rio.cluster.loop_lag_mean_ms",
+                "rio.cluster.loop_lag_max_ms",
+                "rio.cluster.inflight_total",
+                "rio.cluster.req_rate_total",
+                "rio.cluster.registry_objects_total",
+                "rio.cluster.sheds_total",
+            ):
+                assert want in family, f"missing aggregate gauge {want}"
+            # The 8 seated handler objects are visible cluster-wide.
+            assert gauges["rio.cluster.registry_objects_total"] >= 8.0
+
+            # And the OTel bridge discovers them via the observable-gauge
+            # re-scan — no one calls a registration hook for rio.cluster.*.
+            handle = fake_otel.install()
+            try:
+                provider = otlp_metrics_exporter(
+                    lambda: server_gauges(server), interval=9999.0
+                )
+                exporter = handle.metric_exporters[-1]
+                provider.force_flush()
+                provider.force_flush()
+                exported = exporter.exported[-1]
+                assert exported["rio.cluster.nodes"] >= 2.0
+                assert exported["rio.cluster.registry_objects_total"] >= 8.0
+            finally:
+                fake_otel.uninstall(handle)
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_registry,
+            num_servers=2,
+            server_kwargs={"load_interval": 0.1},
+        )
+    )

@@ -2,8 +2,8 @@
 
 Pure host-side units: the ring's overwrite/window/projection contract,
 the wire row's tolerant decode, the shared trend helpers, and the
-HealthWatch rule kinds (rising / delta / drift) with journal fire,
-cooldown, and exemplar-trace attach.
+HealthWatch rule kinds (rising / falling / delta / drift) with journal
+fire, cooldown, and exemplar-trace attach.
 """
 
 from __future__ import annotations
@@ -18,6 +18,7 @@ from rio_tpu.journal import HEALTH, Journal
 from rio_tpu.timeseries import (
     GaugeSeries,
     SeriesSample,
+    falling_streak,
     merge_series,
     rising_streak,
     series_values,
@@ -123,6 +124,17 @@ def test_rising_streak_and_min_delta():
     assert rising_streak([1.0, 2.0, 3.1], min_delta=0.5) == 2
 
 
+def test_falling_streak_and_min_delta():
+    # Mirror of the rising cases: the streak ends at the newest value.
+    assert falling_streak([4, 3, 2, 1]) == 3
+    assert falling_streak([1, 5, 4, 3]) == 2
+    assert falling_streak([1, 2, 3]) == 0
+    assert falling_streak([1]) == 0
+    # The jitter floor: -0.4 steps don't count against min_delta=0.5.
+    assert falling_streak([1.8, 1.4, 1.0], min_delta=0.5) == 0
+    assert falling_streak([3.1, 2.0, 1.0], min_delta=0.5) == 2
+
+
 def test_trend_arrow_dead_band():
     assert trend_arrow([10, 10, 10, 10.2]) == "→"  # within ±5% of mean
     assert trend_arrow([10, 10, 10, 12]) == "↑"
@@ -187,6 +199,44 @@ def test_rising_rule_respects_jitter_floor():
                   min_delta=0.5)])
     assert hw.tick() == []
     assert hw.gauges()["rio.health.alert.r"] == 0.0
+
+
+def test_falling_rule_fires_and_journals_health_event():
+    # Mirror of the rising case: "load has been dropping for K windows"
+    # (the scale-in trigger shape).
+    series = _fed_series({"rio.cluster.loop_lag_mean_ms": [4.0, 3.0, 2.0, 1.0]})
+    journal = Journal(node="n1")
+    hw = HealthWatch(
+        series,
+        journal=journal,
+        rules=[TrendRule(name="load_falling",
+                         gauge="rio.cluster.loop_lag_mean_ms",
+                         kind="falling", windows=3, min_delta=0.5)],
+    )
+    active = hw.tick()
+    assert [a.rule for a in active] == ["load_falling"]
+    assert active[0].gauge == "rio.cluster.loop_lag_mean_ms"
+    assert active[0].value == 1.0
+    events = [e for e in journal.events() if e.kind == HEALTH]
+    assert len(events) == 1
+    assert events[0].key == "load_falling"
+    assert events[0].attrs["windows"] == 3
+
+
+def test_falling_rule_respects_jitter_floor():
+    series = _fed_series({"g": [1.3, 1.2, 1.1, 1.0]})  # falling, but tiny
+    hw = HealthWatch(series, rules=[
+        TrendRule(name="f", gauge="g", kind="falling", windows=3,
+                  min_delta=0.5)])
+    assert hw.tick() == []
+    assert hw.gauges()["rio.health.alert.f"] == 0.0
+
+
+def test_falling_rule_ignores_rising_series():
+    series = _fed_series({"g": [1.0, 2.0, 3.0, 4.0]})
+    hw = HealthWatch(series, rules=[
+        TrendRule(name="f", gauge="g", kind="falling", windows=3)])
+    assert hw.tick() == []
 
 
 def test_delta_rule_fires_on_counter_growth():
@@ -310,13 +360,14 @@ def test_default_rules_cover_the_stock_alarm_set():
     assert names == {
         "p99_rising", "loop_lag_rising", "journal_dropped", "shed_rate",
         "residual_diverging", "storage_errors", "solve_ms_drift",
-        "cross_node_bytes_rising",
+        "cluster_load_falling", "cross_node_bytes_rising",
     }
     kinds = {r.name: r.kind for r in default_rules()}
     assert kinds["journal_dropped"] == "delta"
     assert kinds["storage_errors"] == "delta"
     assert kinds["solve_ms_drift"] == "drift"
     assert kinds["cross_node_bytes_rising"] == "rising"
+    assert kinds["cluster_load_falling"] == "falling"
 
 
 def test_health_alert_defaults():
