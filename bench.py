@@ -2204,6 +2204,38 @@ def streams_throughput() -> dict:
     return out
 
 
+def qos_stage() -> dict:
+    """Both QoS promises priced in the SAME session (ISSUE 20): the
+    uniform half A/Bs the RPC loop with the scheduler off vs the default
+    ``QosConfig`` under identical unclassified echo traffic (median
+    paired ratio; bar <= ~2%), and the flood half A/Bs interactive p99
+    while a bulk tenant floods one hot object (per-object serialized
+    execution is the contention; bars: >= 3x better with QoS on, zero
+    interactive sheds)."""
+    import asyncio
+
+    from rio_tpu.utils.qos_live import measure_qos
+
+    out = asyncio.run(measure_qos())
+    out["host"] = _host_provenance()
+    u, f = out["uniform"], out["flood"]
+    m = u["msgs_per_sec"]
+    print(
+        f"# qos ({u['batches']} interleaved batches x "
+        f"{u['n_requests_per_batch']} echoes, 2 servers/mode): uniform "
+        f"off {m['off']:,.0f}/s, on {m['on']:,.0f}/s "
+        f"({u['qos_overhead_pct']:+}% median paired); flood "
+        f"({f['bulk_workers']} bulk workers on one hot object, "
+        f"max_concurrent {f['max_concurrent_on']}): interactive p99 "
+        f"off {f['off']['interactive_p99_ms']} ms -> on "
+        f"{f['on']['interactive_p99_ms']} ms "
+        f"({f['interactive_p99_improvement']}x), "
+        f"{f['interactive_sheds_on']} interactive sheds",
+        file=sys.stderr,
+    )
+    return out
+
+
 def affinity_payoff() -> dict:
     """Affinity-aware placement payoff + sampler cost, A/B'd in the SAME
     session. Payoff: an adversarial multi-hop pipeline (producer + stream
@@ -2682,6 +2714,10 @@ def main() -> None:
     except Exception as e:
         print(f"# affinity payoff failed: {e!r}", file=sys.stderr)
     try:
+        detail["qos"] = qos_stage()
+    except Exception as e:
+        print(f"# qos stage failed: {e!r}", file=sys.stderr)
+    try:
         detail["hier_mesh_ab"] = hier_mesh_ab()
     except Exception as e:
         print(f"# hier mesh A/B failed: {e!r}", file=sys.stderr)
@@ -2867,6 +2903,9 @@ if __name__ == "__main__":
     # stage alone and bank it into the cpu sidecar (in-process clusters;
     # CPU-safe).
     parser.add_argument("--affinity", action="store_true")
+    # Run the QoS uniform-overhead + flood-protection A/B alone and bank
+    # it into the cpu sidecar (in-process clusters; CPU-safe).
+    parser.add_argument("--qos", action="store_true")
     # Run the autoscale idle A/B + ramp soak alone and bank it into the
     # cpu sidecar (in-process + subprocess clusters on loopback;
     # CPU-safe).
@@ -3021,6 +3060,24 @@ if __name__ == "__main__":
         except (OSError, ValueError):
             detail = {}
         detail["affinity"] = out
+        _write_detail(detail, here)
+        print(json.dumps(out))
+    elif args.qos:
+        # Standalone --qos updates the banked cpu sidecar in place (the
+        # --streams pattern): both halves carry their own paired
+        # baseline, so the stage can refresh independently of the other
+        # host stages.
+        _pin_orchestrator_to_cpu()
+        out = qos_stage()
+        here = os.path.dirname(os.path.abspath(__file__))
+        try:
+            with open(os.path.join(here, "BENCH_DETAIL.cpu.json")) as fh:
+                detail = json.load(fh)
+            if not isinstance(detail, dict):
+                detail = {}
+        except (OSError, ValueError):
+            detail = {}
+        detail["qos"] = out
         _write_detail(detail, here)
         print(json.dumps(out))
     elif args.delta:

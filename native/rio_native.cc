@@ -325,6 +325,50 @@ uint8_t* rn_encode_request_frame_traced(const uint8_t* ht, uint32_t htl,
   return finish_frame(w, out_len);
 }
 
+// QoS variant: payload = 0x00 kind byte + msgpack [handler_type, handler_id,
+// message_type, payload, trace_slot, tenant, priority?, deadline_ms?] — the
+// appended QoS classification fields (protocol.py RequestEnvelope, ISSUE 20).
+// trace_slot is nil when sampled < 0 (untraced) or the [trace_id, span_id,
+// sampled] triple otherwise; trailing default QoS fields are truncated
+// exactly like the Python encoder (deadline_ms==0 dropped, then priority==0)
+// so both codecs stay byte-identical. Callers with ALL QoS fields default
+// use the legacy/traced encoders above instead (those frames must remain
+// byte-identical to pre-QoS layouts).
+uint8_t* rn_encode_request_frame_qos(const uint8_t* ht, uint32_t htl,
+                                     const uint8_t* hid, uint32_t hidl,
+                                     const uint8_t* mt, uint32_t mtl,
+                                     const uint8_t* pay, uint32_t pl,
+                                     const uint8_t* tid, uint32_t tidl,
+                                     const uint8_t* sid, uint32_t sidl,
+                                     int32_t sampled, const uint8_t* tenant,
+                                     uint32_t tenantl, uint64_t priority,
+                                     uint64_t deadline_ms, uint32_t* out_len) {
+  Writer w;
+  w.u8(0x00);
+  uint8_t n = 8;
+  if (deadline_ms == 0) {
+    n = 7;
+    if (priority == 0) n = 6;
+  }
+  w.fixarray(n);
+  w.str(ht, htl);
+  w.str(hid, hidl);
+  w.str(mt, mtl);
+  w.bin(pay, pl);
+  if (sampled < 0) {
+    w.u8(0xc0);  // nil trace slot holds position 4
+  } else {
+    w.fixarray(3);
+    w.str(tid, tidl);
+    w.str(sid, sidl);
+    w.boolean(sampled != 0);
+  }
+  w.str(tenant, tenantl);
+  if (n >= 7) w.uint(priority);
+  if (n >= 8) w.uint(deadline_ms);
+  return finish_frame(w, out_len);
+}
+
 // Frame payload = 0x01 kind byte + msgpack [handler_type, handler_id].
 uint8_t* rn_encode_subscribe_frame(const uint8_t* ht, uint32_t htl,
                                    const uint8_t* hid, uint32_t hidl,
@@ -476,6 +520,47 @@ int rn_decode_inbound(const uint8_t* buf, uint32_t len, uint32_t* offs,
     return 2;
   }
   return -1;
+}
+
+// QoS-aware server-side decode of one frame payload. Same contract as
+// rn_decode_inbound plus the appended QoS fields: requests may carry 4-8
+// elements — position 4 is the trace slot (nil OR the [trace_id, span_id,
+// sampled] triple; nil leaves *sampled = -1), [6] = tenant (empty when
+// absent), qos[0] = priority, qos[1] = deadline_ms (0 when absent).
+// offs/lens must hold 7 slots; qos must hold 2.
+int rn_decode_inbound_qos(const uint8_t* buf, uint32_t len, uint32_t* offs,
+                          uint32_t* lens, int32_t* sampled, uint64_t* qos) {
+  if (len == 0) return -1;
+  *sampled = -1;
+  offs[6] = lens[6] = 0;
+  qos[0] = qos[1] = 0;
+  Parser pr(buf, len);
+  uint8_t kind = *pr.p++;
+  if (kind == 0x00) {
+    int n = pr.array_header();
+    if (n < 4 || n > 8) return -1;
+    for (int i = 0; i < 4; ++i)
+      if (!pr.str_or_bin(&offs[i], &lens[i])) return -1;
+    if (n >= 5) {
+      if (pr.need(1) && *pr.p == 0xc0) {
+        ++pr.p;  // nil trace slot (QoS-classified but untraced)
+      } else {
+        if (pr.array_header() != 3) return -1;
+        if (!pr.str_or_bin(&offs[4], &lens[4])) return -1;
+        if (!pr.str_or_bin(&offs[5], &lens[5])) return -1;
+        bool s;
+        if (!pr.boolean(&s)) return -1;
+        *sampled = s ? 1 : 0;
+      }
+    }
+    if (n >= 6 && !pr.str_or_bin(&offs[6], &lens[6])) return -1;
+    if (n >= 7 && !pr.uint_(&qos[0])) return -1;
+    if (n >= 8 && !pr.uint_(&qos[1])) return -1;
+    return 0;
+  }
+  // Subscribe/command frames carry no QoS fields; delegate to the legacy
+  // decoder so the two paths can never drift.
+  return rn_decode_inbound(buf, len, offs, lens, sampled);
 }
 
 // Client-side decode of a ResponseEnvelope payload.

@@ -31,7 +31,8 @@ Operator CLI (see ``_cli_main``)::
     python -m rio_tpu.admin stats   --nodes host:p,host:p
     python -m rio_tpu.admin trace   --nodes host:p,host:p TRACE_ID
     python -m rio_tpu.admin edges   --nodes host:p,host:p [--limit K]
-    python -m rio_tpu.admin --demo {tail|explain|stats|watch|trace|edges}
+    python -m rio_tpu.admin qos     --nodes host:p,host:p [--limit K]
+    python -m rio_tpu.admin --demo {tail|explain|stats|watch|trace|edges|qos}
 
 A fourth wire pair serves the request-waterfall plane: :class:`DumpSpans`
 → :class:`SpansSnapshot` returns the node's retained request spans
@@ -211,6 +212,42 @@ class EdgesSnapshot:
     cross_bytes_per_s: float = 0.0  # EMA byte rate of non-local traffic
 
 
+@message(name="rio.DumpQos")
+@dataclass
+class DumpQos:
+    """Ask a node for its request-QoS scheduler state (``rio_tpu/qos``).
+
+    ``limit`` bounds the per-(tenant, class) RED rows to the busiest
+    tenants by request count (0 = every row the scheduler retained).
+    """
+
+    limit: int = 64
+
+
+@message(name="rio.QosSnapshot")
+@dataclass
+class QosSnapshot:
+    """One node's QoS scheduler state. ``enabled`` is False (and every
+    counter zero) on nodes built without a ``qos_config`` — a mixed
+    cluster scrapes uniformly."""
+
+    address: str = ""
+    enabled: bool = False
+    running: int = 0
+    queued: int = 0
+    admitted: int = 0
+    sheds: int = 0
+    deadline_drops: int = 0
+    interactive_admitted: int = 0
+    interactive_sheds: int = 0
+    # Class label -> parked depth right now ("p2", "fair", ...).
+    queue_depths: dict = field(default_factory=dict)
+    # Per-(tenant, class) RED rows: [tenant, class, requests, errors,
+    # avg_ms, avg_queue_ms, sheds, deadline_drops]. Rows may only ever
+    # GROW by appending trailing fields.
+    tenants: list = field(default_factory=list)
+
+
 @message(name="rio.AdminRequest")
 @dataclass
 class AdminRequest:
@@ -364,6 +401,34 @@ class AdminControl(ServiceObject):
         )
 
     @handler
+    async def dump_qos(self, msg: DumpQos, ctx: AppData) -> QosSnapshot:
+        from .commands import ServerInfo
+        from .qos import QosScheduler
+
+        info = ctx.try_get(ServerInfo)
+        address = info.address if info else ""
+        qos = ctx.try_get(QosScheduler)
+        if qos is None:
+            return QosSnapshot(address=address)
+        rows = qos.tenant_rows()
+        if msg.limit > 0 and len(rows) > msg.limit:
+            rows = sorted(rows, key=lambda r: -r[2])[: msg.limit]
+        s = qos.stats
+        return QosSnapshot(
+            address=address,
+            enabled=True,
+            running=qos.running,
+            queued=qos.queued,
+            admitted=s.admitted,
+            sheds=s.sheds,
+            deadline_drops=s.deadline_drops,
+            interactive_admitted=s.interactive_admitted,
+            interactive_sheds=s.interactive_sheds,
+            queue_depths=qos.queue_depths(),
+            tenants=rows,
+        )
+
+    @handler
     async def admin(self, msg: AdminRequest, ctx: AppData) -> AdminAck:
         sender = ctx.try_get(AdminSender)
         if sender is None:
@@ -482,6 +547,29 @@ async def scrape_edges(
     for address in await _node_addresses(nodes):
         try:
             snap = await client.send(ADMIN_TYPE, address, msg, returns=EdgesSnapshot)
+        except Exception:
+            continue
+        snapshots.append(snap)
+    return snapshots
+
+
+async def scrape_qos(
+    client: Any,
+    nodes: Any,
+    *,
+    limit: int = 64,
+) -> list[QosSnapshot]:
+    """One :class:`DumpQos` round trip per live node; dead nodes skipped.
+
+    Nodes predating the QoS subsystem answer the admin envelope with an
+    error (unknown message) — they are skipped like unreachable nodes, so
+    a mixed-version cluster still yields the survivors' snapshots.
+    """
+    msg = DumpQos(limit=limit)
+    snapshots: list[QosSnapshot] = []
+    for address in await _node_addresses(nodes):
+        try:
+            snap = await client.send(ADMIN_TYPE, address, msg, returns=QosSnapshot)
         except Exception:
             continue
         snapshots.append(snap)
@@ -811,6 +899,52 @@ async def _cli_cluster(args: Any):
 
         return client, members, cleanup
 
+    if args.demo and getattr(args, "cmd", "") == "qos":
+        # The qos demo needs a scheduler-enabled cluster: a weighted
+        # interactive tenant plus a rate-limited bulk tenant driven past
+        # its admission bucket, so the scrape has sheds and RED rows to
+        # render.
+        import asyncio
+
+        from .errors import ClientError
+        from .qos import QosConfig
+        from .utils.routing_live import Echo, EchoActor, boot_echo_cluster
+
+        members, placement, tasks, servers = await boot_echo_cluster(
+            2,
+            server_kwargs=dict(
+                qos_config=QosConfig(
+                    tenant_weights={"frontend": 4.0},
+                    tenant_rates={"bulk": (200.0, 8.0)},
+                )
+            ),
+        )
+        client = Client(members)
+        for i in range(40):
+            try:
+                # A short budget caps each shed's retry ladder so the
+                # flood finishes promptly; spent budgets surface here as
+                # DeadlineExceeded and simply count.
+                await client.send(
+                    EchoActor, f"b{i % 8}", Echo(value=i), returns=Echo,
+                    tenant="bulk", deadline_ms=250,
+                )
+            except ClientError:
+                pass
+        for i in range(10):
+            await client.send(
+                EchoActor, f"f{i % 4}", Echo(value=i), returns=Echo,
+                tenant="frontend", priority=2, deadline_ms=2000,
+            )
+
+        async def cleanup() -> None:
+            client.close()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+        return client, members, cleanup
+
     if args.demo:
         import asyncio
 
@@ -993,6 +1127,18 @@ async def _cli_main(argv: Sequence[str] | None = None) -> int:
     )
     trace_p.add_argument(
         "--limit", type=int, default=256, help="spans scraped per node"
+    )
+
+    qos_p = _common(
+        sub.add_parser(
+            "qos",
+            help="request-QoS scheduler state: queue depths, per-tenant "
+            "RED rows, shed and deadline-drop counters",
+        )
+    )
+    qos_p.add_argument(
+        "--limit", type=int, default=64,
+        help="per-(tenant, class) rows shown per node (busiest first)",
     )
 
     scale_p = _common(
@@ -1191,6 +1337,65 @@ async def _cli_main(argv: Sequence[str] | None = None) -> int:
                     print(format_waterfall(tid, tree))
                 print(f"[trace] {len(trees)} trace(s), {len(records)} span(s)")
             return 0 if (snapshots or records) else 1
+        if args.cmd == "qos":
+            snapshots = await scrape_qos(client, nodes, limit=args.limit)
+            if args.json:
+                print(json.dumps({
+                    s.address: {
+                        "enabled": s.enabled,
+                        "running": s.running,
+                        "queued": s.queued,
+                        "admitted": s.admitted,
+                        "sheds": s.sheds,
+                        "deadline_drops": s.deadline_drops,
+                        "interactive_admitted": s.interactive_admitted,
+                        "interactive_sheds": s.interactive_sheds,
+                        "queue_depths": s.queue_depths,
+                        "tenants": [
+                            {
+                                "tenant": r[0],
+                                "class": r[1],
+                                "requests": r[2],
+                                "errors": r[3],
+                                "avg_ms": r[4],
+                                "avg_queue_ms": r[5],
+                                "sheds": r[6],
+                                "deadline_drops": r[7],
+                            }
+                            for r in s.tenants
+                        ],
+                    }
+                    for s in sorted(snapshots, key=lambda s: s.address)
+                }))
+                return 0 if snapshots else 1
+            header = (
+                f"{'tenant':<14} {'class':<6} {'reqs':>7} {'errs':>6} "
+                f"{'avg_ms':>8} {'queue_ms':>9} {'sheds':>6} {'ddrops':>7}"
+            )
+            for snap in sorted(snapshots, key=lambda s: s.address):
+                if not snap.enabled:
+                    print(f"{snap.address}: qos off")
+                    continue
+                depths = (
+                    " ".join(f"{k}={v}" for k, v in sorted(snap.queue_depths.items()))
+                    or "-"
+                )
+                print(
+                    f"{snap.address}: admitted={snap.admitted} "
+                    f"sheds={snap.sheds} deadline_drops={snap.deadline_drops} "
+                    f"running={snap.running} queued={snap.queued} [{depths}]"
+                )
+                if snap.tenants:
+                    print(header)
+                    print("-" * len(header))
+                    for r in snap.tenants:
+                        print(
+                            f"{(r[0] or 'default'):<14} {r[1]:<6} {r[2]:>7} "
+                            f"{r[3]:>6} {r[4]:>8.2f} {r[5]:>9.2f} "
+                            f"{r[6]:>6} {r[7]:>7}"
+                        )
+            print(f"[qos] {len(snapshots)} node(s)")
+            return 0 if snapshots else 1
         if args.cmd == "scale":
             from .autoscale import (
                 AUTOSCALE_ID,

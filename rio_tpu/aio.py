@@ -127,6 +127,7 @@ class ServerConnProtocol(asyncio.Protocol):
         "_flush_scheduled",
         "_spans",
         "_affinity",
+        "_qos",
         "_ph_tick",
     )
 
@@ -140,6 +141,7 @@ class ServerConnProtocol(asyncio.Protocol):
         self._service: Service | None = None
         self._spans = None  # SpanRing (resolved from the service at accept)
         self._affinity = None  # EdgeSampler (TCP byte counters), same resolve
+        self._qos = None  # QosScheduler (admission + start grants), same resolve
         self._ph_tick = -1  # 1-in-8 phase-clock stride for untraced traffic
         self._frames = FrameReader()
         # Inbound work: decoded envelopes / _BadFrame markers (batch-decode
@@ -167,6 +169,7 @@ class ServerConnProtocol(asyncio.Protocol):
         self._service = self._service_factory()
         self._spans = getattr(self._service, "spans", None)
         self._affinity = getattr(self._service, "affinity", None)
+        self._qos = getattr(self._service, "qos", None)
         self._worker = asyncio.ensure_future(self._run())
         if self._on_task is not None:
             self._on_task(self._worker)
@@ -464,6 +467,22 @@ class ServerConnProtocol(asyncio.Protocol):
                     )
                     continue
                 if type(inbound) is RequestEnvelope:
+                    qos = self._qos
+                    dispatched = None
+                    if qos is not None:
+                        # One synchronous admission + grant step between
+                        # decode and dispatch: a shed (token bucket / full
+                        # class queue) rides the ordinary FIFO response
+                        # path as a pre-resolved future — the handler never
+                        # starts (_BadFrame pattern, so ordering is
+                        # preserved). Otherwise ``dispatched`` is the
+                        # awaitable that runs the handler under its grant.
+                        dispatched = qos.dispatch(service.call, inbound)
+                        if type(dispatched) is ResponseError:
+                            fut = loop.create_future()
+                            fut.set_result(ResponseEnvelope.err(dispatched))
+                            self._push_response(fut)
+                            continue
                     ph = (
                         inbound.__dict__.get("_phases")
                         if self._spans is not None
@@ -480,7 +499,13 @@ class ServerConnProtocol(asyncio.Protocol):
                         # behind a slow head regardless of execution model).
                         if ph is not None:
                             ph.queue = ph.handler_start = _perf()
-                        resp = await service.call(inbound)
+                        if dispatched is None:
+                            resp = await service.call(inbound)
+                        else:
+                            # Under contention the grant may park
+                            # (weighted-fair / strict tiers) or resolve to
+                            # DEADLINE_EXCEEDED without running the handler.
+                            resp = await dispatched
                         if ph is not None:
                             ph.handler_end = _perf()
                         if not self._broken:
@@ -507,7 +532,11 @@ class ServerConnProtocol(asyncio.Protocol):
                     while len(self._resp_q) >= self.MAX_CONCURRENT and not self._eof:
                         self._room = loop.create_future()
                         await self._room
-                    task = loop.create_task(service.call(inbound))
+                    task = loop.create_task(
+                        service.call(inbound)
+                        if dispatched is None
+                        else dispatched
+                    )
                     if ph is not None:
                         # Pipelined path: handler runs in its own task;
                         # queue-exit/handler-start stamp here, handler-end in

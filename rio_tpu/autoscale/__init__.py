@@ -161,13 +161,22 @@ class ScalePolicy:
     lag_weight: float = 1.0
     rate_weight: float = 0.0  # opt-in: req_rate/node term (demo/soak use it)
     shed_weight: float = 10.0  # sheds are the loudest overload signal
+    # Opt-in (like rate_weight): interactive-class QoS pain — priority>0
+    # admission sheds + deadline drops per node per second, from the
+    # heartbeat vector's qos_interactive counter. Weighting it makes the
+    # cluster grow for the latency-sensitive class specifically, even
+    # while bulk-tenant throughput looks healthy.
+    interactive_weight: float = 0.0
     out_cooldown_s: float = 5.0  # jitter base after a scale-out
     in_cooldown_s: float = 15.0  # jitter base after a completed scale-in
     cooldown_max_s: float = 120.0  # jitter cap, both directions
     drain_timeout_s: float = 60.0  # victim grace before forced retire
 
     def pressure_of(
-        self, agg: dict[str, float], shed_rate_per_node: float = 0.0
+        self,
+        agg: dict[str, float],
+        shed_rate_per_node: float = 0.0,
+        interactive_rate_per_node: float = 0.0,
     ) -> float:
         """Blend one ``ClusterLoadView.aggregate_gauges()`` snapshot."""
         nodes = max(1.0, agg.get("rio.cluster.nodes", 0.0))
@@ -176,6 +185,7 @@ class ScalePolicy:
             + agg.get("rio.cluster.loop_lag_mean_ms", 0.0) * self.lag_weight
             + agg.get("rio.cluster.req_rate_total", 0.0) / nodes * self.rate_weight
             + shed_rate_per_node * self.shed_weight
+            + interactive_rate_per_node * self.interactive_weight
         )
 
     def rules(self) -> list[TrendRule]:
@@ -337,6 +347,8 @@ class AutoscaleRuntime:
         self._prev_sheds: float | None = None
         self._prev_mono: float | None = None
         self._shed_rate = 0.0
+        self._prev_interactive: float | None = None
+        self._interactive_rate = 0.0
         self._ticking = False
         self._client = None  # lazy rio_tpu.Client for drain requests
 
@@ -363,13 +375,19 @@ class AutoscaleRuntime:
         # Shed *rate* from the monotonic cluster total (the gauge itself
         # only ever rises; the policy wants pressure, not history).
         sheds = agg.get("rio.cluster.sheds_total", 0.0)
+        interactive = agg.get("rio.cluster.qos_interactive_total", 0.0)
         if self._prev_mono is not None and now > self._prev_mono:
             delta = max(0.0, sheds - self._prev_sheds)
             self._shed_rate = delta / (now - self._prev_mono)
+            idelta = max(0.0, interactive - (self._prev_interactive or 0.0))
+            self._interactive_rate = idelta / (now - self._prev_mono)
         self._prev_sheds, self._prev_mono = sheds, now
+        self._prev_interactive = interactive
 
         raw = self.policy.pressure_of(
-            agg, shed_rate_per_node=self._shed_rate / max(1, nodes)
+            agg,
+            shed_rate_per_node=self._shed_rate / max(1, nodes),
+            interactive_rate_per_node=self._interactive_rate / max(1, nodes),
         )
         alpha = min(1.0, max(0.01, self.policy.ema_alpha))
         self.pressure = (

@@ -23,6 +23,7 @@ from ..cluster.storage import MembershipStorage
 from ..errors import (
     ClientBuilderError,
     ClientError,
+    DeadlineExceeded,
     Disconnect,
     RetryExhausted,
     ServerBusy,
@@ -176,6 +177,8 @@ class ClientStats:
     busy_retries: int = 0  # SERVER_BUSY sheds answered with backoff + re-route
     standby_routes: int = 0  # read attempts sent to a standby seat (readscale)
     shard_routes: int = 0  # attempts direct-dialed via the adopted shard map
+    deadline_exceeded: int = 0  # DEADLINE_EXCEEDED verdicts (server or client)
+    qos_sheds: int = 0  # SERVER_BUSY sheds issued by a server's QoS scheduler
 
 
 class Client:
@@ -207,11 +210,20 @@ class Client:
         transport_faults: Any | None = None,
         identity: str = "",
         shard_aware: bool = False,
+        tenant: str = "",
+        priority: int = 0,
+        deadline_ms: int = 0,
     ) -> None:
         if transport not in ("asyncio", "native", "auto"):
             raise ValueError(f"unknown transport {transport!r}")
         self.members_storage = members_storage
         self.stats = ClientStats()
+        # QoS defaults stamped on every send unless the call overrides them.
+        # All-default (""/0/0) keeps frames byte-identical to the pre-QoS
+        # wire — safe against servers that predate the QoS fields.
+        self.tenant = tenant
+        self.priority = priority
+        self.deadline_ms = deadline_ms
         # Shard-aware routing: adopt the ShardMap a sharded node publishes
         # through its membership rows (rio_tpu/sharded.py) and compute
         # crc32 % N locally on a cache miss — the owning worker's identity
@@ -441,8 +453,24 @@ class Client:
     # -- request path (reference tower_services.rs:96-226) -------------------
 
     async def send_raw(
-        self, handler_type: str, handler_id: str, message_type: str, payload: bytes
+        self,
+        handler_type: str,
+        handler_id: str,
+        message_type: str,
+        payload: bytes,
+        *,
+        tenant: str | None = None,
+        priority: int | None = None,
+        deadline_ms: int | None = None,
     ) -> bytes:
+        # Per-call QoS classification falls back to the client defaults;
+        # the resolved triple rides the envelope (omitted from the wire
+        # when all-default, so legacy frames stay byte-identical).
+        qos = (
+            self.tenant if tenant is None else tenant,
+            self.priority if priority is None else priority,
+            self.deadline_ms if deadline_ms is None else deadline_ms,
+        )
         # Trace-context resolution, cheapest case first: with no active
         # trace and sampling off this is two function calls, then straight
         # into the untraced (legacy-wire-identical) path.
@@ -451,11 +479,11 @@ class Client:
             # Already inside a trace (a server-side forward, or application
             # code under a span): forward it — never re-sample.
             return await self._send_raw(
-                handler_type, handler_id, message_type, payload, ctx
+                handler_type, handler_id, message_type, payload, ctx, qos
             )
         if not head_sampled():
             return await self._send_raw(
-                handler_type, handler_id, message_type, payload, None
+                handler_type, handler_id, message_type, payload, None, qos
             )
         from .. import tracing
 
@@ -464,7 +492,8 @@ class Client:
             # has its client-side timing, and propagate its ids.
             with span("client_request", object=handler_type, id=handler_id):
                 return await self._send_raw(
-                    handler_type, handler_id, message_type, payload, outbound_ctx()
+                    handler_type, handler_id, message_type, payload,
+                    outbound_ctx(), qos,
                 )
         # Sampled but unsinked locally (e.g. only servers export): ship
         # fresh ids without allocating a Span.
@@ -474,6 +503,7 @@ class Client:
             message_type,
             payload,
             (new_trace_id(), new_span_id(), True),
+            qos,
         )
 
     async def _send_raw(
@@ -483,13 +513,15 @@ class Client:
         message_type: str,
         payload: bytes,
         trace_ctx: tuple[str, str, bool] | None,
+        qos: tuple[str, int, int] = ("", 0, 0),
     ) -> bytes:
         ring = client_ring()
         if ring is None:
             # Retention disarmed (the default): one module-global read, then
             # the pre-waterfall request path unchanged.
             return await self._send_attempts(
-                handler_type, handler_id, message_type, payload, trace_ctx
+                handler_type, handler_id, message_type, payload, trace_ctx,
+                qos=qos,
             )
         if trace_ctx is None:
             # Untraced: sample the phase clock on the 1-in-8 stride so the
@@ -497,7 +529,8 @@ class Client:
             self._ph_tick = tick = (self._ph_tick + 1) & 7
             if tick:
                 return await self._send_attempts(
-                    handler_type, handler_id, message_type, payload, trace_ctx
+                    handler_type, handler_id, message_type, payload, trace_ctx,
+                    qos=qos,
                 )
         hop = {"await_us": 0}
         t0 = _perf()
@@ -505,7 +538,8 @@ class Client:
         status = ""
         try:
             return await self._send_attempts(
-                handler_type, handler_id, message_type, payload, trace_ctx, hop
+                handler_type, handler_id, message_type, payload, trace_ctx, hop,
+                qos=qos,
             )
         except BaseException as e:
             status = type(e).__name__
@@ -553,14 +587,41 @@ class Client:
         payload: bytes,
         trace_ctx: tuple[str, str, bool] | None,
         hop: dict | None = None,
+        qos: tuple[str, int, int] = ("", 0, 0),
     ) -> bytes:
+        tenant, priority, deadline_ms = qos
+        if not tenant or priority == 0 or deadline_ms <= 0:
+            # Hop propagation: a Client used INSIDE a handler (stream-cursor
+            # remote delivery, saga fan-out) inherits the request's QoS scope
+            # for whatever wasn't set explicitly — the deadline forwards as
+            # the strictly-decremented remaining budget, and a spent budget
+            # refuses the send instead of fanning out doomed work. Outside a
+            # handler the scope is empty and nothing changes.
+            from ..qos import current_scope, scope_budget_ms
+
+            s_tenant, s_priority, _ = current_scope()
+            if not tenant:
+                tenant = s_tenant
+            if priority == 0:
+                priority = s_priority
+            if deadline_ms <= 0:
+                budget = scope_budget_ms()
+                if budget < 0:
+                    self.stats.deadline_exceeded += 1
+                    raise DeadlineExceeded("", "inherited deadline budget spent")
+                deadline_ms = budget
         env = RequestEnvelope(
-            handler_type, handler_id, message_type, payload, trace_ctx
+            handler_type, handler_id, message_type, payload, trace_ctx,
+            tenant=tenant, priority=priority, deadline_ms=deadline_ms,
         )
         # Encoded ONCE before the retry loop: redirect-follow and busy
         # retries reuse the same frame, so one trace_ctx spans every hop
-        # this request takes.
+        # this request takes. A deadline changes that — each attempt
+        # re-encodes with the REMAINING budget (time already burned on
+        # earlier attempts and backoff sleeps must not be granted again
+        # server-side), and the loop stops once the budget is spent.
         frame_bytes = encode_request_frame(env)
+        deadline_t0 = time.monotonic() if deadline_ms > 0 else 0.0
         key = (handler_type, handler_id)
         self.stats.requests += 1
         last: BaseException | None = None
@@ -577,6 +638,23 @@ class Client:
         jitter: DecorrelatedJitter | None = None
         for delay in self._backoff.delays():
             attempts += 1
+            if deadline_ms > 0:
+                from ..qos import remaining_budget_ms
+
+                remaining = remaining_budget_ms(
+                    deadline_ms, time.monotonic() - deadline_t0
+                )
+                if remaining <= 0:
+                    # Budget spent client-side (backoff sleeps + earlier
+                    # attempts): retrying is doomed work — every further
+                    # hop would shed it anyway.
+                    self.stats.deadline_exceeded += 1
+                    raise DeadlineExceeded(
+                        "", f"budget spent after {attempts - 1} attempts"
+                    )
+                if remaining != env.deadline_ms:
+                    env.deadline_ms = remaining
+                    frame_bytes = encode_request_frame(env)
             address = None
             via_seat = False
             try:
@@ -651,6 +729,10 @@ class Client:
                 # is NOT invalidated.
                 last = ServerBusy(address or "", err.detail)
                 self.stats.busy_retries += 1
+                if err.detail.startswith("qos:"):
+                    # Shed by the server's QoS admission layer (token
+                    # bucket / full class queue), not the load monitor.
+                    self.stats.qos_sheds += 1
                 if address is not None:
                     avoid.add(address)
                 seats = []
@@ -681,6 +763,22 @@ class Client:
                     )
                 await asyncio.sleep(jitter.next())
                 continue
+            if err.kind == ErrorKind.DEADLINE_EXCEEDED:
+                # A server dropped the request as doomed (budget expired
+                # before its handler started). Retryable exactly like
+                # SERVER_BUSY — but only while budget remains: the
+                # top-of-loop check raises once it is spent.
+                last = DeadlineExceeded(address or "", err.detail)
+                self.stats.deadline_exceeded += 1
+                if address is not None:
+                    avoid.add(address)
+                self._placement.pop(key)
+                if jitter is None:
+                    jitter = DecorrelatedJitter(
+                        base=self._backoff.initial, cap=self._backoff.cap
+                    )
+                await asyncio.sleep(jitter.next())
+                continue
             if err.kind in (ErrorKind.DEALLOCATE, ErrorKind.ALLOCATE):
                 last = ClientError(f"{err.kind.name}: {err.detail}")
                 self._placement.pop(key)
@@ -698,10 +796,25 @@ class Client:
         handler_id: str,
         msg: Any,
         returns: Any = Any,
+        *,
+        tenant: str | None = None,
+        priority: int | None = None,
+        deadline_ms: int | None = None,
     ) -> Any:
-        """Typed request: serialize ``msg``, await and decode the response."""
+        """Typed request: serialize ``msg``, await and decode the response.
+
+        ``tenant``/``priority``/``deadline_ms`` classify the request for
+        QoS-enabled servers (``None`` = the client's configured defaults):
+        ``priority > 0`` dispatches in strict tiers above the fair ring,
+        ``deadline_ms`` is the remaining time budget — the server sheds
+        the request (retryable ``DEADLINE_EXCEEDED``) rather than run a
+        handler whose caller already gave up.
+        """
         tname = handler_type if isinstance(handler_type, str) else type_id(handler_type)
-        raw = await self.send_raw(tname, handler_id, type_id(type(msg)), codec.serialize(msg))
+        raw = await self.send_raw(
+            tname, handler_id, type_id(type(msg)), codec.serialize(msg),
+            tenant=tenant, priority=priority, deadline_ms=deadline_ms,
+        )
         return codec.deserialize(raw, returns)
 
     # -- control-plane commands (streams/sagas, KIND_COMMAND frames) ---------
@@ -1044,9 +1157,19 @@ class ClientBuilder:
         self._shard_aware_flag = enabled
         return self
 
+    def qos(
+        self, *, tenant: str = "", priority: int = 0, deadline_ms: int = 0
+    ) -> "ClientBuilder":
+        """Default QoS classification for every request this client sends
+        (per-call ``send(..., tenant=, priority=, deadline_ms=)`` overrides).
+        All-default keeps the wire byte-identical to a pre-QoS client."""
+        self._qos_defaults = (tenant, priority, deadline_ms)
+        return self
+
     def build(self) -> Client:
         if self._storage is None:
             raise ClientBuilderError("members_storage is required")
+        tenant, priority, deadline_ms = getattr(self, "_qos_defaults", ("", 0, 0))
         return Client(
             self._storage,
             placement_cache_size=self._lru,
@@ -1059,4 +1182,7 @@ class ClientBuilder:
             read_scale=getattr(self, "_read_scale_config", None),
             standby_resolver=getattr(self, "_standby_resolver_fn", None),
             shard_aware=getattr(self, "_shard_aware_flag", False),
+            tenant=tenant,
+            priority=priority,
+            deadline_ms=deadline_ms,
         )

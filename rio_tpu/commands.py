@@ -210,6 +210,12 @@ class SendCommand:
     # snapshotted at enqueue for the same reason as trace_ctx. Rides the
     # replayed RequestEnvelope in-process only — never the wire.
     source: str = ""
+    # QoS scope of the sending handler (tenant, priority, monotonic
+    # deadline expiry; 0.0 = none), snapshotted at enqueue like trace_ctx:
+    # the consumer decrements the remaining budget into the replayed
+    # envelope, or answers DEADLINE_EXCEEDED without dispatching when the
+    # budget is already spent (rio_tpu/qos scope propagation).
+    qos_scope: tuple = ("", 0, 0.0)
 
 
 class InternalClientSender:
@@ -229,6 +235,7 @@ class InternalClientSender:
     ) -> bytes:
         """Enqueue a request and await the (serialized) response."""
         from .affinity import current_source
+        from .qos import current_scope
         from .tracing import outbound_ctx
 
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
@@ -237,6 +244,7 @@ class InternalClientSender:
                 handler_type, handler_id, message_type, payload, fut,
                 trace_ctx=outbound_ctx(),
                 source=current_source(),
+                qos_scope=current_scope(),
             )
         )
         return await fut

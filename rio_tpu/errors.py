@@ -148,6 +148,23 @@ class ServerBusy(ClientError):
         self.detail = detail
 
 
+class DeadlineExceeded(ClientError):
+    """The request's deadline budget expired (``ErrorKind.DEADLINE_EXCEEDED``).
+
+    Raised when a server sheds a request whose remaining ``deadline_ms``
+    budget ran out before the handler started (doomed-work shedding,
+    rio_tpu/qos), or client-side when the budget is spent before another
+    retry attempt could be sent. Retryable only while budget remains.
+    """
+
+    def __init__(self, address: str = "", detail: str = ""):
+        super().__init__(
+            f"deadline exceeded at {address or 'client'}: {detail or 'budget spent'}"
+        )
+        self.address = address
+        self.detail = detail
+
+
 class RequestTimeout(ClientError):
     """The request did not complete within the configured deadline."""
 

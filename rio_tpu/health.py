@@ -124,6 +124,27 @@ def default_rules(
             min_delta=0.0,
         ),
         TrendRule(
+            name="qos_shed_rising",
+            gauge="rio.qos.sheds",
+            kind="delta",
+            windows=windows,
+            # Any growth in QoS admission sheds (token bucket / full class
+            # queue) is signal: some tenant is being turned away at the
+            # door — check `admin qos` for who and rebalance weights/rates.
+            min_delta=0.0,
+        ),
+        TrendRule(
+            name="deadline_exceeded_rising",
+            gauge="rio.qos.deadline_drops",
+            kind="delta",
+            windows=windows,
+            # Budgets expiring before handler start means queue wait is
+            # eating callers' deadlines — the node is slower than its
+            # clients assume (capacity, or a bulk tenant starving the
+            # fair ring despite weighting).
+            min_delta=0.0,
+        ),
+        TrendRule(
             name="residual_diverging",
             gauge="rio.placement_solve.residual",
             kind="rising",

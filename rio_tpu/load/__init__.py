@@ -94,12 +94,16 @@ class LoadVector:
     state_bytes: float = 0.0  # migration volatile bytes moved (cumulative)
     epoch: float = 0.0  # unix seconds the sample was taken
     sheds: float = 0.0  # requests refused with ServerBusy (cumulative)
+    # Interactive-class QoS pain (cumulative): priority>0 admission sheds
+    # plus deadline drops on this node. 0 on nodes without a scheduler.
+    qos_interactive: float = 0.0
 
     # Wire order. Append-only: new fields go at the END (after ``epoch``,
     # even though that reads oddly) so legacy 6-field rows still decode
     # and older readers simply never see the tail.
     _FIELDS = ("loop_lag_ms", "inflight", "registry_objects",
-               "req_rate", "state_bytes", "epoch", "sheds")
+               "req_rate", "state_bytes", "epoch", "sheds",
+               "qos_interactive")
     _MIN_FIELDS = 6  # rows this short are the pre-`sheds` legacy format
 
     def encode(self) -> str:
@@ -142,6 +146,7 @@ class LoadVector:
             state_bytes=_finite(self.state_bytes),
             epoch=_finite(self.epoch),
             sheds=_finite(self.sheds, hi=1e12),
+            qos_interactive=_finite(self.qos_interactive, hi=1e12),
         )
 
 
@@ -254,6 +259,7 @@ class ClusterLoadView:
             out[f"{base}.req_rate"] = e.load.req_rate
             out[f"{base}.state_bytes"] = e.load.state_bytes
             out[f"{base}.sheds"] = e.load.sheds
+            out[f"{base}.qos_interactive"] = e.load.qos_interactive
             out[f"{base}.staleness"] = (
                 -1.0 if math.isinf(e.staleness) else e.staleness
             )
@@ -280,6 +286,7 @@ class ClusterLoadView:
             "rio.cluster.req_rate_total": 0.0,
             "rio.cluster.registry_objects_total": 0.0,
             "rio.cluster.sheds_total": 0.0,
+            "rio.cluster.qos_interactive_total": 0.0,
         }
         if not fresh:
             return out
@@ -292,6 +299,9 @@ class ClusterLoadView:
             e.load.registry_objects for e in fresh
         )
         out["rio.cluster.sheds_total"] = sum(e.load.sheds for e in fresh)
+        out["rio.cluster.qos_interactive_total"] = sum(
+            e.load.qos_interactive for e in fresh
+        )
         return out
 
     def __len__(self) -> int:
@@ -424,6 +434,11 @@ class LoadMonitor:
         # (rio_tpu.readscale.ReadScaleManager), ticked once per sample so
         # dynamic replica counts ride the existing loop — no new task.
         self.hotness_detector: Any = None
+        # Optional QoS scheduler handle (rio_tpu.qos.QosScheduler, wired by
+        # the Server when both subsystems are enabled): its interactive
+        # shed/drop counters ride the heartbeat vector so the autoscale
+        # policy can weight pressure by interactive-class pain.
+        self.qos: Any = None
         # Sync per-sample callbacks riding the same cadence (the series
         # sampler and HealthWatch, wired by Server.run); each is isolated
         # like the hotness tick — a failing ticker must not stop sampling.
@@ -501,6 +516,11 @@ class LoadMonitor:
     def snapshot(self) -> LoadVector:
         """The node's current vector (what the heartbeat publishes)."""
         s = self.stats
+        qos = self.qos
+        qos_interactive = 0.0
+        if qos is not None:
+            qs = qos.stats
+            qos_interactive = float(qs.interactive_sheds + qs.deadline_drops)
         return LoadVector(
             loop_lag_ms=s.loop_lag_ms,
             inflight=float(self.inflight),
@@ -509,6 +529,7 @@ class LoadMonitor:
             state_bytes=s.state_bytes,
             epoch=time.time(),
             sheds=float(s.sheds),
+            qos_interactive=qos_interactive,
         )
 
     def encoded_snapshot(self) -> str:

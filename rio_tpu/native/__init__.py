@@ -128,6 +128,26 @@ class NativeLib:
             ctypes.c_char_p, _U32, _U32P, _U32P, ctypes.POINTER(ctypes.c_int32),
         ]
         dll.rn_decode_inbound.restype = ctypes.c_int
+
+        try:
+            # QoS request frames (tenant/priority/deadline_ms, ISSUE 20):
+            # absent from env-pinned prebuilt libraries, which then report
+            # has_qos=False — callers stay on the Python codec and the
+            # parity tests skip.
+            dll.rn_encode_request_frame_qos.argtypes = (
+                [ctypes.c_char_p, _U32] * 6
+                + [ctypes.c_int32, ctypes.c_char_p, _U32,
+                   ctypes.c_uint64, ctypes.c_uint64, _U32P]
+            )
+            dll.rn_encode_request_frame_qos.restype = _U8P
+            dll.rn_decode_inbound_qos.argtypes = [
+                ctypes.c_char_p, _U32, _U32P, _U32P,
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint64),
+            ]
+            dll.rn_decode_inbound_qos.restype = ctypes.c_int
+            self.has_qos = True
+        except AttributeError:
+            self.has_qos = False
         for name in ("rn_decode_response", "rn_decode_subresponse"):
             fn = getattr(dll, name)
             fn.argtypes = [ctypes.c_char_p, _U32, _U32P, _U32P, _U32P]
@@ -233,6 +253,23 @@ class NativeLib:
             raise SerializationError("rn_encode_command_frame_traced: frame too large")
         return self._take(ptr, n.value)
 
+    def encode_request_frame_qos(
+        self, ht: bytes, hid: bytes, mt: bytes, payload: bytes,
+        trace_id: bytes, span_id: bytes, sampled: int,
+        tenant: bytes, priority: int, deadline_ms: int,
+    ) -> bytes:
+        """QoS-classified request frame; ``sampled`` < 0 means untraced
+        (the wire carries a nil trace slot to hold position)."""
+        n = _U32(0)
+        ptr = self._dll.rn_encode_request_frame_qos(
+            ht, len(ht), hid, len(hid), mt, len(mt), payload, len(payload),
+            trace_id, len(trace_id), span_id, len(span_id), sampled,
+            tenant, len(tenant), priority, deadline_ms, ctypes.byref(n),
+        )
+        if not ptr:
+            raise SerializationError("rn_encode_request_frame_qos: frame too large")
+        return self._take(ptr, n.value)
+
     def encode_subscribe_frame(self, ht: bytes, hid: bytes) -> bytes:
         n = _U32(0)
         ptr = self._dll.rn_encode_subscribe_frame(ht, len(ht), hid, len(hid), ctypes.byref(n))
@@ -289,6 +326,45 @@ class NativeLib:
         n_fields = 4 if rc == 0 else 3 if rc == 2 else 2
         spans = [payload[offs[i] : offs[i] + lens[i]] for i in range(n_fields)]
         if rc in (0, 2) and sampled.value >= 0:
+            spans.extend(
+                (
+                    payload[offs[4] : offs[4] + lens[4]],
+                    payload[offs[5] : offs[5] + lens[5]],
+                    bool(sampled.value),
+                )
+            )
+        return (rc, *spans)
+
+    def decode_inbound_qos(self, payload: bytes):
+        """QoS-aware inbound decode. For requests, always returns the full
+        11-tuple ``(0, ht, hid, mt, body, tid, sid, sampled, tenant,
+        priority, deadline_ms)`` where ``sampled`` is None on untraced
+        frames; other kinds match :meth:`decode_inbound`. None on error."""
+        offs = (_U32 * 7)()
+        lens = (_U32 * 7)()
+        sampled = ctypes.c_int32(-1)
+        qos = (ctypes.c_uint64 * 2)()
+        rc = self._dll.rn_decode_inbound_qos(
+            payload, len(payload), offs, lens, ctypes.byref(sampled), qos
+        )
+        if rc < 0:
+            return None
+        if rc == 0:
+            spans = [payload[offs[i] : offs[i] + lens[i]] for i in range(4)]
+            traced = sampled.value >= 0
+            return (
+                0,
+                *spans,
+                payload[offs[4] : offs[4] + lens[4]] if traced else b"",
+                payload[offs[5] : offs[5] + lens[5]] if traced else b"",
+                bool(sampled.value) if traced else None,
+                payload[offs[6] : offs[6] + lens[6]],
+                int(qos[0]),
+                int(qos[1]),
+            )
+        n_fields = 3 if rc == 2 else 2
+        spans = [payload[offs[i] : offs[i] + lens[i]] for i in range(n_fields)]
+        if rc == 2 and sampled.value >= 0:
             spans.extend(
                 (
                     payload[offs[4] : offs[4] + lens[4]],

@@ -395,6 +395,8 @@ class NativeServerTransport:
         self._spans_resolved = False
         # EdgeSampler (node-wide TCP byte counters), same lazy resolve.
         self._affinity = None
+        # QosScheduler (admission + handler-start grants), same lazy resolve.
+        self._qos = None
         self._conns: dict[int, _ConnState] = {}
         self._workers: set[asyncio.Task] = set()
         self._loop: asyncio.AbstractEventLoop | None = None
@@ -602,6 +604,7 @@ class NativeServerTransport:
             self._spans_resolved = True
             self._spans = getattr(service, "spans", None)
             self._affinity = getattr(service, "affinity", None)
+            self._qos = getattr(service, "qos", None)
         loop = asyncio.get_running_loop()
         cancelled = False
         try:
@@ -652,12 +655,28 @@ class NativeServerTransport:
                 if t_recv and type(inbound) is RequestEnvelope:
                     ph = self._stamp_inbound(state, inbound, t_recv)
                 if type(inbound) is RequestEnvelope:
+                    qos = self._qos
+                    dispatched = None
+                    if qos is not None:
+                        # One synchronous admission + grant step between
+                        # decode and dispatch: sheds ride the FIFO response
+                        # path as pre-resolved futures — the handler never
+                        # starts (same design as the asyncio transport).
+                        dispatched = qos.dispatch(service.call, inbound)
+                        if type(dispatched) is ResponseError:
+                            fut = loop.create_future()
+                            fut.set_result(ResponseEnvelope.err(dispatched))
+                            self._push_response(conn, state, fut)
+                            continue
                     if not state.resp_q and not state.queue:
                         # Sole in-flight request on this connection:
                         # dispatch inline (no task), the common case.
                         if ph is not None:
                             ph.queue = ph.handler_start = _perf()
-                        resp = await service.call(inbound)
+                        if dispatched is None:
+                            resp = await service.call(inbound)
+                        else:
+                            resp = await dispatched
                         if ph is not None:
                             ph.handler_end = _perf()
                         if not state.broken:
@@ -678,7 +697,11 @@ class NativeServerTransport:
                     while len(state.resp_q) >= _MAX_CONCURRENT and not state.eof:
                         state.room = loop.create_future()
                         await state.room
-                    task = loop.create_task(service.call(inbound))
+                    task = loop.create_task(
+                        service.call(inbound)
+                        if dispatched is None
+                        else dispatched
+                    )
                     if ph is not None:
                         # Pipelined path: handler-end stamps in the task's
                         # done-callback; encode/flush when the FIFO head

@@ -128,6 +128,11 @@ def server_gauges(server: Any) -> dict[str, float]:
         # Autoscale controller state (rio.autoscale.*): pressure EMA,
         # band counters, decision totals, cooldown remaining.
         gauges.update(autoscale.gauges())
+    qos = getattr(server, "qos", None)
+    if qos is not None:
+        # Request-QoS scheduler state (rio.qos.*): running/queued depth,
+        # admission + shed counters, deadline drops, interactive split.
+        gauges.update(qos.gauges())
     storage = getattr(server, "storage_health", None)
     if storage is not None:
         # Rendezvous-storage outage ledger (rio.storage.*): error/degraded

@@ -40,6 +40,16 @@ class RequestEnvelope:
     # old decoders (which reject extra fields) never see it. The C++ codec
     # (native/rio_native.cc) mirrors both arities.
     trace_ctx: tuple[str, str, bool] | None = None
+    # QoS classification (ISSUE 20) — same appended-field evolution rule.
+    # All three are omitted from the wire when default, so an unclassified
+    # frame stays byte-identical to the legacy 4/5-element layouts; when any
+    # is set, the trace slot is emitted (``None`` for untraced) to hold its
+    # position. ``deadline_ms`` is the REMAINING budget in milliseconds
+    # (relative, not a wall-clock deadline — clocks across hosts don't
+    # agree); 0 means "no deadline". Internal hops decrement it.
+    tenant: str = ""
+    priority: int = 0
+    deadline_ms: int = 0
     # In-process only — NEVER serialized (`to_bytes` below doesn't emit it,
     # and the positional decode leaves it at the default). The affinity
     # source identity of an internal server-to-self send ("{type}.{id}" of
@@ -49,19 +59,36 @@ class RequestEnvelope:
 
     def to_bytes(self) -> bytes:
         tc = self.trace_ctx
-        if tc is None:
+        if not (self.tenant or self.priority or self.deadline_ms):
+            if tc is None:
+                return codec.serialize(
+                    [self.handler_type, self.handler_id, self.message_type, self.payload]
+                )
             return codec.serialize(
-                [self.handler_type, self.handler_id, self.message_type, self.payload]
+                [
+                    self.handler_type,
+                    self.handler_id,
+                    self.message_type,
+                    self.payload,
+                    [tc[0], tc[1], tc[2]],
+                ]
             )
-        return codec.serialize(
-            [
-                self.handler_type,
-                self.handler_id,
-                self.message_type,
-                self.payload,
-                [tc[0], tc[1], tc[2]],
-            ]
-        )
+        # QoS-classified frame: the trace slot is emitted (None when
+        # untraced) to hold position 4; trailing default QoS fields are
+        # truncated so e.g. tenant-only frames stay 6 elements.
+        wire: list = [
+            self.handler_type,
+            self.handler_id,
+            self.message_type,
+            self.payload,
+            None if tc is None else [tc[0], tc[1], tc[2]],
+            self.tenant,
+            self.priority,
+            self.deadline_ms,
+        ]
+        while wire[-1] in ("", 0) and len(wire) > 6:
+            wire.pop()
+        return codec.serialize(wire)
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "RequestEnvelope":
@@ -116,6 +143,11 @@ class ErrorKind(IntEnum):
     # (native/rio_native.cc) treats the kind as a generic uint, so this
     # needs no structural wire change; tests/test_native.py pins parity.
     SERVER_BUSY = 8
+    # QoS deadline shed (rio_tpu/qos): retryable — the caller's remaining
+    # budget expired before (or while) the request was queued, so the server
+    # refused to burn handler time on a doomed request. Like SERVER_BUSY the
+    # kind rides the generic uint slot in the C++ codec unchanged.
+    DEADLINE_EXCEEDED = 9
 
 
 @dataclass
@@ -158,6 +190,10 @@ class ResponseError:
     @classmethod
     def server_busy(cls, detail: str = "") -> "ResponseError":
         return cls(ErrorKind.SERVER_BUSY, detail=detail)
+
+    @classmethod
+    def deadline_exceeded(cls, detail: str = "") -> "ResponseError":
+        return cls(ErrorKind.DEADLINE_EXCEEDED, detail=detail)
 
 
 @dataclass

@@ -326,6 +326,23 @@ class ReadScaleManager:
             or not await self.members_storage.is_active(primary)
         ):
             return None  # normal path resolves (promote / self-assign)
+        if req.deadline_ms > 0:
+            # Proxy hop propagation: forward the REMAINING budget (strictly
+            # decremented by our queue + handler time so far), or refuse a
+            # spent one here instead of burning the primary's time on it.
+            from ..qos import scope_budget_ms
+
+            budget = scope_budget_ms()
+            if budget < 0:
+                return ResponseEnvelope.err(
+                    ResponseError.deadline_exceeded(
+                        "qos: budget spent before proxy hop to primary"
+                    )
+                )
+            if budget > 0:
+                from dataclasses import replace
+
+                req = replace(req, deadline_ms=budget)
         try:
             pool = self._pools.get(primary)
             if pool is None:

@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import logging
+import time
 
 from .app_data import AppData
 from .cluster.membership_protocol import ClusterProvider
@@ -120,6 +121,7 @@ class Server:
         affinity_stride: int = 8,
         affinity_top_k: int = 512,
         autoscale_config=None,
+        qos_config=None,
     ) -> None:
         if transport not in ("asyncio", "native", "auto"):
             raise ValueError(f"unknown transport {transport!r}")
@@ -290,6 +292,23 @@ class Server:
                 stride=affinity_stride, top_k=affinity_top_k
             )
             self.app_data.set(self.affinity)
+        # Request QoS (rio_tpu/qos): opt-in via a QosConfig — tenants,
+        # priorities, deadline budgets, weighted-fair dispatch. Disabled is
+        # FREE: both transports resolve None and dispatch exactly as before
+        # (no admit call, no wrapper). ``qos_config=True`` means defaults.
+        self.qos = None
+        if qos_config is not None:
+            from .qos import QosConfig, QosScheduler
+
+            self.qos = QosScheduler(
+                qos_config if isinstance(qos_config, QosConfig) else None
+            )
+            self.app_data.set(self.qos)
+            if self.load_monitor is not None:
+                # Interactive-class shed/drop counters ride the heartbeat
+                # vector (LoadVector.qos_interactive) so the autoscale
+                # policy's opt-in interactive term sees the whole cluster.
+                self.load_monitor.qos = self.qos
         self.timeseries = None
         self.health_watch = None
         if timeseries and self.load_monitor is not None:
@@ -569,12 +588,47 @@ class Server:
 
             async def dispatch(c: SendCommand) -> None:
                 try:
+                    tenant, priority, deadline_at = c.qos_scope
+                    deadline_ms = 0
+                    if deadline_at > 0.0:
+                        # Decrement the sender's remaining budget across the
+                        # queue hop; a spent budget is refused here, before
+                        # the handler runs (doomed-work shedding applies to
+                        # internal sends too).
+                        left_s = deadline_at - time.monotonic()
+                        if left_s <= 0.0:
+                            from .protocol import ResponseEnvelope, ResponseError
+
+                            if not c.response.done():
+                                c.response.set_result(
+                                    ResponseEnvelope.err(
+                                        ResponseError.deadline_exceeded(
+                                            "qos: budget spent before internal dispatch"
+                                        )
+                                    ).to_bytes()
+                                )
+                            return
+                        deadline_ms = max(1, int(left_s * 1000.0))
                     env = RequestEnvelope(
                         c.handler_type, c.handler_id, c.message_type, c.payload,
                         c.trace_ctx,
+                        tenant=tenant,
+                        priority=priority,
+                        deadline_ms=deadline_ms,
                         source=c.source,
                     )
-                    resp = await self._service().call(env)
+                    if deadline_at > 0.0 or tenant or priority:
+                        # Re-install the sender's scope so hops the nested
+                        # handler performs keep decrementing the same budget
+                        # (internal dispatch bypasses QosScheduler.run — a
+                        # parked internal send behind a full concurrency gate
+                        # could deadlock a handler awaiting its own send).
+                        from .qos import request_scope
+
+                        with request_scope(tenant, priority, deadline_at):
+                            resp = await self._service().call(env)
+                    else:
+                        resp = await self._service().call(env)
                     if not c.response.done():
                         c.response.set_result(resp.to_bytes())
                 except Exception as e:  # noqa: BLE001 — must never hang the sender

@@ -114,6 +114,13 @@ class Service:
         # connection; the transports own all phase stamping — the service
         # request path is untouched (null fast path byte-identical).
         self.spans = app_data.try_get(SpanRing)
+        from .qos import QosScheduler
+
+        # Request QoS scheduler (None when the server was built without a
+        # qos_config): both transports read it off the service and run
+        # admission + handler-start grants between decode and dispatch —
+        # the service request path itself is untouched.
+        self.qos = app_data.try_get(QosScheduler)
         # Shard map of a multi-process sharded node (None on plain servers):
         # consulted only when seating an UNPLACED object — see the seam in
         # get_or_create_placement.

@@ -624,3 +624,50 @@ def test_autoscale_banks_to_cpu_sidecar_and_never_carries(tmp_path):
     _write_detail({"solve_tier": {"platform": "tpu"}}, here=str(tmp_path))
     tpu = _read(tmp_path, "BENCH_DETAIL.tpu.json")
     assert "autoscale" not in tpu and "autoscale_carried" not in tpu
+
+
+def test_qos_banks_to_cpu_sidecar_and_never_carries(tmp_path):
+    """The QoS uniform-overhead + flood-protection A/B is a host stage:
+    banked beside its own session's host provenance, never carried into a
+    later tpu bank (absolute rates and latencies drift with box weather;
+    only the paired off/on ratios under that run's conditions mean
+    anything)."""
+    stage = {
+        "uniform": {
+            "msgs_per_sec": {"off": 11509.3, "on": 13790.3},
+            "qos_overhead_pct": -1.11,
+            "admitted_on": 25477,
+        },
+        "flood": {
+            "off": {"interactive_p99_ms": 79.7},
+            "on": {"interactive_p99_ms": 17.9},
+            "interactive_p99_improvement": 4.45,
+            "interactive_sheds_on": 0,
+        },
+        "host": {"cpu_count": 1, "sched_affinity": [0], "loadavg": [0, 0, 0]},
+    }
+    _write_detail(
+        {"solve_tier": {"platform": "cpu"}, "qos": stage},
+        here=str(tmp_path),
+    )
+    banked = _read(tmp_path, "BENCH_DETAIL.cpu.json")
+    assert banked["qos"] == stage
+    # A later tpu run must not inherit it.
+    _write_detail({"solve_tier": {"platform": "tpu"}}, here=str(tmp_path))
+    tpu = _read(tmp_path, "BENCH_DETAIL.tpu.json")
+    assert "qos" not in tpu and "qos_carried" not in tpu
+
+
+def test_committed_cpu_capture_banks_qos_with_provenance():
+    """The repo's banked cpu sidecar carries the measured QoS A/B: both
+    ISSUE 20 bars are evidence on disk — uniform unclassified traffic
+    pays <= ~2% for the scheduler, the interactive tenant's p99 under a
+    bulk flood is >= 3x better with QoS on, and the flood never caused a
+    single interactive shed — stamped with the host conditions."""
+    committed = Path(__file__).resolve().parent.parent / "BENCH_DETAIL.cpu.json"
+    qos = json.loads(committed.read_text())["qos"]
+    assert qos["uniform"]["qos_overhead_pct"] <= 2.0
+    assert qos["uniform"]["admitted_on"] > 0
+    assert qos["flood"]["interactive_p99_improvement"] >= 3.0
+    assert qos["flood"]["interactive_sheds_on"] == 0
+    assert set(qos["host"]) == {"cpu_count", "sched_affinity", "loadavg"}

@@ -616,3 +616,138 @@ def test_dump_edges_frame_golden():
 
     merged = merge_edges([back.rows, [r + ["extra"] for r in back.rows]])
     assert merged[0][2] == 2 * 16384.0
+
+def test_qos_request_frames_golden():
+    """Pin the QoS-classified request-frame arities byte for byte.
+
+    The QoS fields (tenant, priority, deadline_ms) are APPENDED wire-safe
+    fields on RequestEnvelope (ISSUE 20), exactly like trace_ctx before
+    them: a default-valued frame must stay byte-identical to the legacy
+    4/5-element layouts (old decoders reject extra fields), and each set
+    field extends the array by one trailing slot — with the trace slot
+    emitted as nil to hold its position when QoS is set but the request
+    is untraced. The C++ codec (native/rio_native.cc) mirrors every
+    arity; tests/test_native.py pins the parity, this golden pins the
+    bytes themselves.
+    """
+    from rio_tpu.protocol import RequestEnvelope, encode_request_frame
+
+    cases = [
+        ("legacy_4field", RequestEnvelope("Svc", "g1", "Get", b"\x01")),
+        (
+            "traced_5field",
+            RequestEnvelope(
+                "Svc", "g1", "Get", b"\x01", ("ab" * 16, "cd" * 8, True)
+            ),
+        ),
+        (
+            "tenant_6field",
+            RequestEnvelope("Svc", "g1", "Get", b"\x01", tenant="bulk"),
+        ),
+        (
+            "priority_7field",
+            RequestEnvelope(
+                "Svc", "g1", "Get", b"\x01", tenant="frontend", priority=2
+            ),
+        ),
+        (
+            "deadline_8field",
+            RequestEnvelope(
+                "Svc", "g1", "Get", b"\x01",
+                tenant="frontend", priority=2, deadline_ms=1500,
+            ),
+        ),
+        (
+            "deadline_only_8field",
+            RequestEnvelope("Svc", "g1", "Get", b"\x01", deadline_ms=250),
+        ),
+        (
+            "traced_qos_8field",
+            RequestEnvelope(
+                "Svc", "g1", "Get", b"\x01", ("ab" * 16, "cd" * 8, True),
+                tenant="frontend", priority=2, deadline_ms=1500,
+            ),
+        ),
+    ]
+    lines: list[str] = []
+    for label, env in cases:
+        frame = encode_request_frame(env)
+        lines.append(f"== request.{label} ({len(frame)} bytes)")
+        for off in range(0, len(frame), 16):
+            lines.append(f"{off:04x}  {frame[off : off + 16].hex(' ')}")
+    _assert_golden("qos_request_frames.txt", "\n".join(lines) + "\n")
+
+    # The compat invariant the golden exists for: a default-QoS frame is
+    # byte-identical to the pre-QoS encoding — the fields simply are not
+    # on the wire.
+    legacy = encode_request_frame(RequestEnvelope("Svc", "g1", "Get", b"\x01"))
+    default_qos = encode_request_frame(
+        RequestEnvelope(
+            "Svc", "g1", "Get", b"\x01", tenant="", priority=0, deadline_ms=0
+        )
+    )
+    assert legacy == default_qos
+
+
+def test_dump_qos_frame_golden():
+    """Pin the rio.Admin QoS-scrape frames byte for byte.
+
+    DUMP_QOS is the QoS plane's operator scrape (the ``qos`` CLI speaks it
+    to arbitrary-version nodes); the request envelope and the QosSnapshot
+    response — including the positional per-(tenant, class) RED row shape
+    [tenant, class, requests, errors, avg_ms, avg_queue_ms, sheds,
+    deadline_drops] — are a compatibility contract: rows may only ever
+    GROW by appending trailing fields.
+    """
+    from rio_tpu import codec
+    from rio_tpu.admin import ADMIN_TYPE, DumpQos, QosSnapshot
+    from rio_tpu.protocol import (
+        RequestEnvelope,
+        ResponseEnvelope,
+        encode_request_frame,
+        encode_response_frame,
+    )
+
+    request = encode_request_frame(
+        RequestEnvelope(
+            handler_type=ADMIN_TYPE,
+            handler_id="10.0.0.1:5000",
+            message_type="rio.DumpQos",
+            payload=codec.serialize(DumpQos(limit=32)),
+        )
+    )
+    snapshot = QosSnapshot(
+        address="10.0.0.1:5000",
+        enabled=True,
+        running=3,
+        queued=17,
+        admitted=1200,
+        sheds=45,
+        deadline_drops=7,
+        interactive_admitted=300,
+        interactive_sheds=0,
+        queue_depths={"fair": 15, "p2": 2},
+        tenants=[
+            ["bulk", "fair", 900, 12, 4.25, 18.5, 45, 3],
+            ["frontend", "p2", 300, 0, 1.75, 0.4, 0, 4],
+        ],
+    )
+    response = encode_response_frame(
+        ResponseEnvelope(body=codec.serialize(snapshot))
+    )
+
+    def hexdump(label: str, frame: bytes) -> list[str]:
+        lines = [f"== {label} ({len(frame)} bytes)"]
+        for off in range(0, len(frame), 16):
+            chunk = frame[off : off + 16]
+            lines.append(f"{off:04x}  {chunk.hex(' ')}")
+        return lines
+
+    text = "\n".join(hexdump("dump_qos.request", request)
+                     + hexdump("dump_qos.response", response)) + "\n"
+    _assert_golden("dump_qos_frames.txt", text)
+
+    back = codec.deserialize(codec.serialize(snapshot), QosSnapshot)
+    assert back.enabled is True and back.queued == 17
+    assert back.queue_depths == {"fair": 15, "p2": 2}
+    assert back.tenants[0][0] == "bulk" and back.tenants[0][6] == 45

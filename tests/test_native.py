@@ -95,10 +95,19 @@ def test_response_frame_parity():
     assert codec.frame(busy.to_bytes()) == lib.encode_response_err_frame(
         int(protocol.ErrorKind.SERVER_BUSY), b"inflight>256", b""
     )
+    # DEADLINE_EXCEEDED (kind 9): the QoS doomed-work shed rides the same
+    # opaque-uint arm, again with no native change.
+    late = protocol.ResponseEnvelope.err(
+        protocol.ResponseError.deadline_exceeded("budget spent in queue")
+    )
+    assert codec.frame(late.to_bytes()) == lib.encode_response_err_frame(
+        int(protocol.ErrorKind.DEADLINE_EXCEEDED), b"budget spent in queue", b""
+    )
     # Decoders agree with the Python ones.
     assert lib.decode_response(ok.to_bytes()) == (True, b"hello")
     assert lib.decode_response(err.to_bytes()) == (False, 5, b"MyErr", b"errbytes")
     assert lib.decode_response(busy.to_bytes()) == (False, 8, b"inflight>256", b"")
+    assert lib.decode_response(late.to_bytes()) == (False, 9, b"budget spent in queue", b"")
     assert lib.decode_response(b"\x00garbage") is None
 
 
@@ -161,6 +170,82 @@ def test_command_frame_parity():
     # Python typed decode agrees with both.
     back = protocol.decode_inbound(tframed[4:])
     assert type(back) is protocol.CommandEnvelope and back == traced
+
+
+def test_qos_request_frame_parity():
+    """The appended QoS fields (tenant/priority/deadline_ms, ISSUE 20) keep
+    byte parity at every arity: default-field envelopes stay on the
+    legacy/traced encoders byte-identical, classified ones match the new
+    entry point with trailing-default truncation."""
+    if not lib.has_qos:
+        pytest.skip("prebuilt native lib predates QoS frames")
+    tid, sid = "a7" * 16, "b8" * 8
+    cases = [
+        # (env, (tid, sid, sampled, tenant, priority, deadline_ms))
+        (protocol.RequestEnvelope("S", "i", "M", b"p", tenant="bulk"),
+         (b"", b"", -1, b"bulk", 0, 0)),
+        (protocol.RequestEnvelope("S", "i", "M", b"p", priority=2),
+         (b"", b"", -1, b"", 2, 0)),
+        (protocol.RequestEnvelope("S", "i", "M", b"p", deadline_ms=1500),
+         (b"", b"", -1, b"", 0, 1500)),
+        (protocol.RequestEnvelope("S", "i", "M", b"p", tenant="t", priority=1,
+                                  deadline_ms=99999),
+         (b"", b"", -1, b"t", 1, 99999)),
+        (protocol.RequestEnvelope("S", "i", "M", b"p", (tid, sid, True),
+                                  tenant="iact", priority=3, deadline_ms=250),
+         (tid.encode(), sid.encode(), 1, b"iact", 3, 250)),
+        (protocol.RequestEnvelope("S", "i", "M", b"p", (tid, sid, False),
+                                  tenant="iact"),
+         (tid.encode(), sid.encode(), 0, b"iact", 0, 0)),
+    ]
+    for env, (t, s, sampled, tenant, prio, dl) in cases:
+        assert protocol.encode_request_frame(env) == lib.encode_request_frame_qos(
+            b"S", b"i", b"M", b"p", t, s, sampled, tenant, prio, dl
+        ), env
+    # All-default QoS fields: byte-identical to the pre-QoS layouts.
+    env = protocol.RequestEnvelope("S", "i", "M", b"p", tenant="", priority=0,
+                                   deadline_ms=0)
+    assert protocol.encode_request_frame(env) == lib.encode_request_frame(
+        b"S", b"i", b"M", b"p"
+    )
+    traced = protocol.RequestEnvelope("S", "i", "M", b"p", (tid, sid, True))
+    assert protocol.encode_request_frame(traced) == lib.encode_request_frame_traced(
+        b"S", b"i", b"M", b"p", tid.encode(), sid.encode(), True
+    )
+
+
+def test_qos_decode_inbound_parity():
+    if not lib.has_qos:
+        pytest.skip("prebuilt native lib predates QoS frames")
+    tid, sid = "c9" * 16, "d0" * 8
+    env = protocol.RequestEnvelope(
+        "S", "i", "M", b"xyz", (tid, sid, True), tenant="bulk", priority=2,
+        deadline_ms=750,
+    )
+    framed = protocol.encode_request_frame(env)
+    assert lib.decode_inbound_qos(framed[4:]) == (
+        0, b"S", b"i", b"M", b"xyz", tid.encode(), sid.encode(), True,
+        b"bulk", 2, 750,
+    )
+    # Untraced-but-classified: the wire carries a nil trace slot; the
+    # decoder reports sampled=None and empty trace spans.
+    untr = protocol.RequestEnvelope("S", "i", "M", b"x", tenant="t", deadline_ms=9)
+    assert lib.decode_inbound_qos(protocol.encode_request_frame(untr)[4:]) == (
+        0, b"S", b"i", b"M", b"x", b"", b"", None, b"t", 0, 9,
+    )
+    # Legacy arities decode through the QoS entry point with defaults.
+    legacy = protocol.encode_request_frame(protocol.RequestEnvelope("S", "i", "M", b"x"))
+    assert lib.decode_inbound_qos(legacy[4:]) == (
+        0, b"S", b"i", b"M", b"x", b"", b"", None, b"", 0, 0,
+    )
+    # Subscribe/command frames delegate to the legacy decoder unchanged.
+    sub = protocol.encode_subscribe_frame(protocol.SubscriptionRequest("S", "j"))
+    assert lib.decode_inbound_qos(sub[4:]) == (1, b"S", b"j")
+    # Python typed decode agrees on every QoS field.
+    back = protocol.decode_inbound(framed[4:])
+    assert back == env and (back.tenant, back.priority, back.deadline_ms) == (
+        "bulk", 2, 750,
+    )
 
 
 def test_native_frame_reader_parity():

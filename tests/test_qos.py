@@ -1,0 +1,865 @@
+"""Request QoS: scheduler semantics, deadline propagation, live dispatch.
+
+Three layers under test:
+
+* **Scheduler units (fake clock)** — token-bucket admission sheds, bounded
+  queue sheds, strict priority tiers over the weighted-fair ring, stride
+  fairness ratios, deadline drops while parked (handler never runs), and
+  the uniform-traffic fast path.
+* **Scope helpers** — the contextvar request scope internal hops read to
+  decrement-and-forward the remaining budget.
+* **Live clusters** — a budgeted request crossing the redirect-follow path,
+  an actor→actor internal hop, and the readscale stale-standby proxy hop
+  arrives with a strictly smaller budget each time; an already-expired
+  inbound is answered DEADLINE_EXCEEDED *without the handler running*.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from rio_tpu import (
+    AppData,
+    Registry,
+    ServiceObject,
+    handler,
+    message,
+    readonly,
+)
+from rio_tpu.commands import ServerInfo
+from rio_tpu.errors import DeadlineExceeded
+from rio_tpu.protocol import ErrorKind, RequestEnvelope
+from rio_tpu.qos import (
+    FAIR_CLASS,
+    QosConfig,
+    QosScheduler,
+    class_of,
+    current_scope,
+    detach_scope,
+    remaining_budget_ms,
+    request_scope,
+    scope_budget_ms,
+)
+from rio_tpu.registry import ObjectId, type_id
+from rio_tpu.replication import ReplicationConfig
+
+from .server_utils import Cluster, run_integration_test
+
+# ---------------------------------------------------------------------------
+# Fake clock
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _env(tenant: str = "", priority: int = 0, deadline_ms: int = 0) -> RequestEnvelope:
+    return RequestEnvelope(
+        "Svc", "o1", "Msg", b"", tenant=tenant, priority=priority,
+        deadline_ms=deadline_ms,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Pure helpers
+# ---------------------------------------------------------------------------
+
+
+def test_class_of():
+    assert class_of(0) == FAIR_CLASS
+    assert class_of(1) == "p1"
+    assert class_of(7) == "p7"
+
+
+def test_remaining_budget_ms_decrements_and_never_invents():
+    assert remaining_budget_ms(0, 10.0) == 0  # no deadline stays no deadline
+    assert remaining_budget_ms(1000, 0.25) == 750
+    assert remaining_budget_ms(1000, 1.0) == 0  # exactly spent
+    assert remaining_budget_ms(1000, 5.0) == 0  # long spent — never negative
+    assert remaining_budget_ms(1000, 0.0) == 1000
+
+
+def test_scope_helpers_default_install_and_detach():
+    assert current_scope() == ("", 0, 0.0)
+    assert scope_budget_ms() == 0  # no deadline in scope
+    now = time.monotonic()
+    with request_scope("bulk", 2, now + 1.5):
+        assert current_scope() == ("bulk", 2, now + 1.5)
+        b = scope_budget_ms(now=now)
+        assert b == 1500
+        assert scope_budget_ms(now=now + 2.0) == -1  # spent, not 0
+        # Nested scopes restore on exit.
+        with request_scope("", 0, 0.0):
+            assert scope_budget_ms() == 0
+        assert current_scope()[0] == "bulk"
+    assert current_scope() == ("", 0, 0.0)
+
+
+def test_scope_budget_floors_at_one_ms_while_unexpired():
+    now = time.monotonic()
+    with request_scope("t", 0, now + 0.0004):
+        # 0.4 ms left: genuinely unexpired must forward >= 1, never 0
+        # (0 would mean "no deadline" downstream).
+        assert scope_budget_ms(now=now) == 1
+
+
+def test_detach_scope_clears_inherited_scope():
+    async def body():
+        with request_scope("bulk", 1, time.monotonic() + 5.0):
+
+            async def background():
+                detach_scope()
+                return current_scope()
+
+            # Tasks copy the context at creation: without detach they would
+            # carry this one request's deadline forever.
+            return await asyncio.create_task(background())
+
+    assert asyncio.run(body()) == ("", 0, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Admission: token bucket + bounded queues (fake clock, no loop needed)
+# ---------------------------------------------------------------------------
+
+
+def test_token_bucket_sheds_and_refills():
+    clk = FakeClock()
+    sched = QosScheduler(
+        QosConfig(tenant_rates={"bulk": (10.0, 3.0)}), clock=clk
+    )
+    # Burst of 3 admitted, 4th shed.
+    for _ in range(3):
+        assert sched.admit(_env(tenant="bulk")) is None
+    err = sched.admit(_env(tenant="bulk"))
+    assert err is not None and err.kind == ErrorKind.SERVER_BUSY
+    assert "qos:" in err.detail
+    assert sched.stats.sheds == 1 and sched.stats.admitted == 3
+    # Other tenants are unaffected (no default rate configured).
+    assert sched.admit(_env(tenant="frontend")) is None
+    # 10 tokens/s: 0.1 s buys exactly one more admit.
+    clk.advance(0.1)
+    assert sched.admit(_env(tenant="bulk")) is None
+    assert sched.admit(_env(tenant="bulk")) is not None
+
+
+def test_interactive_shed_counted_separately():
+    clk = FakeClock()
+    sched = QosScheduler(
+        QosConfig(tenant_rates={"vip": (1.0, 1.0)}), clock=clk
+    )
+    assert sched.admit(_env(tenant="vip", priority=2)) is None
+    assert sched.admit(_env(tenant="vip", priority=2)) is not None
+    assert sched.stats.interactive_sheds == 1
+    assert sched.stats.interactive_admitted == 1
+    # RED row keyed (tenant, class) recorded the shed.
+    rows = {(r[0], r[1]): r for r in sched.tenant_rows()}
+    assert rows[("vip", "p2")][6] == 1
+
+
+def test_admit_stamps_monotonic_deadline():
+    clk = FakeClock(2000.0)
+    sched = QosScheduler(clock=clk)
+    env = _env(deadline_ms=500)
+    assert sched.admit(env) is None
+    assert env._qos_deadline == pytest.approx(2000.5)
+    env2 = _env()
+    assert sched.admit(env2) is None
+    # Unclassified requests ride the fast path: no stamp at all.
+    assert getattr(env2, "_qos_deadline", 0.0) == 0.0
+
+
+def test_queue_full_sheds_server_busy():
+    clk = FakeClock()
+    sched = QosScheduler(QosConfig(max_concurrent=1, max_queue=2), clock=clk)
+
+    async def body():
+        release = asyncio.Event()
+
+        async def blocker(env):
+            await release.wait()
+            from rio_tpu.protocol import ResponseEnvelope
+
+            return ResponseEnvelope.ok(b"")
+
+        holder = _env(tenant="t")
+        assert sched.admit(holder) is None
+        hold_task = asyncio.create_task(sched.run(blocker, holder))
+        await asyncio.sleep(0)
+        assert sched.running == 1
+        # Two park in tenant t's fair queue (max_queue=2)...
+        parked = []
+        for _ in range(2):
+            e = _env(tenant="t")
+            assert sched.admit(e) is None
+            parked.append(asyncio.create_task(sched.run(blocker, e)))
+        await asyncio.sleep(0)
+        assert sched.queued == 2
+        assert sched.queue_depths() == {FAIR_CLASS: 2}
+        # ...the third is shed at the door.
+        err = sched.admit(_env(tenant="t"))
+        assert err is not None and err.kind == ErrorKind.SERVER_BUSY
+        assert "queue full" in err.detail
+        release.set()
+        await asyncio.gather(hold_task, *parked)
+        assert sched.running == 0 and sched.queued == 0
+
+    asyncio.run(body())
+
+
+# ---------------------------------------------------------------------------
+# Dispatch order: strict tiers, weighted fairness, deadline drops
+# ---------------------------------------------------------------------------
+
+
+async def _drain_order(sched: QosScheduler, envs: list[RequestEnvelope]):
+    """Park ``envs`` behind a held slot, release, return handler-start
+    order as (tenant, priority) pairs."""
+    from rio_tpu.protocol import ResponseEnvelope
+
+    order: list[tuple[str, int]] = []
+    release = asyncio.Event()
+
+    async def blocker(env):
+        await release.wait()
+        return ResponseEnvelope.ok(b"")
+
+    async def record(env):
+        order.append((env.tenant, env.priority))
+        return ResponseEnvelope.ok(b"")
+
+    holder = _env(tenant="holder")
+    assert sched.admit(holder) is None
+    hold_task = asyncio.create_task(sched.run(blocker, holder))
+    await asyncio.sleep(0)
+    tasks = []
+    for e in envs:
+        assert sched.admit(e) is None
+        tasks.append(asyncio.create_task(sched.run(record, e)))
+        await asyncio.sleep(0)  # deterministic enqueue order
+    release.set()
+    results = await asyncio.gather(hold_task, *tasks)
+    return order, results[1:]
+
+
+def test_strict_priority_tiers_dispatch_before_fair_ring():
+    sched = QosScheduler(QosConfig(max_concurrent=1))
+
+    async def body():
+        envs = [
+            _env(tenant="bulk"),
+            _env(tenant="vip", priority=1),
+            _env(tenant="bulk"),
+            _env(tenant="vip", priority=3),
+            _env(tenant="vip", priority=2),
+        ]
+        order, _ = await _drain_order(sched, envs)
+        # Tiers drain highest-first regardless of arrival; fair ring last.
+        assert [p for _, p in order] == [3, 2, 1, 0, 0]
+
+    asyncio.run(body())
+
+
+def test_weighted_fair_ring_respects_tenant_weights():
+    sched = QosScheduler(
+        QosConfig(max_concurrent=1, tenant_weights={"a": 3.0, "b": 1.0})
+    )
+
+    async def body():
+        envs = []
+        for _ in range(9):
+            envs.append(_env(tenant="a"))
+        for _ in range(3):
+            envs.append(_env(tenant="b"))
+        order, _ = await _drain_order(sched, envs)
+        # Stride scheduling: in any first-8 window tenant a gets ~3x the
+        # starts of b, and b is never starved out of the window entirely.
+        first8 = [t for t, _ in order[:8]]
+        assert first8.count("a") >= 5
+        assert first8.count("b") >= 1
+        # Everyone eventually runs.
+        assert len(order) == 12
+        assert [t for t, _ in order].count("b") == 3
+
+    asyncio.run(body())
+
+
+def test_idle_tenant_rearrival_does_not_bank_vtime():
+    sched = QosScheduler(QosConfig(max_concurrent=1))
+
+    async def body():
+        # Round 1: tenants x and y trade grants, advancing the ring clock.
+        envs = [_env(tenant="x"), _env(tenant="y")] * 3
+        await _drain_order(sched, envs)
+        # Round 2: z arrives for the first time (vtime 0). The re-arrival
+        # clamp seats it at the CURRENT ring clock, so it cannot monopolize
+        # grants against x's banked backlog.
+        envs2 = [_env(tenant="z") for _ in range(4)] + [
+            _env(tenant="x") for _ in range(4)
+        ]
+        order, _ = await _drain_order(sched, envs2)
+        first4 = [t for t, _ in order[:4]]
+        assert "x" in first4  # z did not run 4-in-a-row off banked credit
+
+    asyncio.run(body())
+
+
+def test_deadline_expires_while_parked_handler_never_runs():
+    clk = FakeClock()
+    sched = QosScheduler(QosConfig(max_concurrent=1), clock=clk)
+
+    async def body():
+        from rio_tpu.protocol import ResponseEnvelope
+
+        release = asyncio.Event()
+        ran: list[str] = []
+
+        async def blocker(env):
+            await release.wait()
+            return ResponseEnvelope.ok(b"")
+
+        async def never(env):
+            ran.append(env.tenant)
+            return ResponseEnvelope.ok(b"")
+
+        # Classified holder: unclassified requests ride the zero-wrapper
+        # fast path and never occupy a slot.
+        holder = _env(tenant="h")
+        assert sched.admit(holder) is None
+        hold = asyncio.create_task(sched.run(blocker, holder))
+        await asyncio.sleep(0)
+        doomed = _env(tenant="t", deadline_ms=100)
+        assert sched.admit(doomed) is None
+        doomed_task = asyncio.create_task(sched.run(never, doomed))
+        await asyncio.sleep(0)
+        assert sched.queued == 1
+        # Budget expires while parked; the grant resolves to the error.
+        clk.advance(0.2)
+        release.set()
+        resp = (await asyncio.gather(hold, doomed_task))[1]
+        assert resp.error is not None
+        assert resp.error.kind == ErrorKind.DEADLINE_EXCEEDED
+        assert ran == []  # the doomed handler never started
+        assert sched.stats.deadline_drops == 1
+        rows = {(r[0], r[1]): r for r in sched.tenant_rows()}
+        assert rows[("t", FAIR_CLASS)][7] == 1
+
+    asyncio.run(body())
+
+
+def test_already_expired_inbound_dropped_before_queuing():
+    clk = FakeClock()
+    sched = QosScheduler(clock=clk)
+
+    async def body():
+        ran: list[int] = []
+
+        async def never(env):
+            ran.append(1)
+
+        env = _env(deadline_ms=50)
+        assert sched.admit(env) is None
+        clk.advance(0.1)  # budget spent between decode and dispatch
+        resp = await sched.run(never, env)
+        assert resp.error is not None
+        assert resp.error.kind == ErrorKind.DEADLINE_EXCEEDED
+        assert ran == []
+        assert sched.stats.deadline_drops == 1
+
+    asyncio.run(body())
+
+
+def test_fast_path_grants_without_queuing_and_installs_scope():
+    clk = FakeClock(500.0)
+    sched = QosScheduler(clock=clk)
+
+    async def body():
+        from rio_tpu.protocol import ResponseEnvelope
+
+        seen: list[tuple] = []
+
+        async def probe(env):
+            seen.append(current_scope())
+            return ResponseEnvelope.ok(b"x")
+
+        env = _env(tenant="frontend", priority=2, deadline_ms=1000)
+        assert sched.admit(env) is None
+        resp = await sched.run(probe, env)
+        assert resp.is_ok
+        # Scope carried tenant/priority and the stamped monotonic expiry.
+        assert seen == [("frontend", 2, pytest.approx(501.0))]
+        # Scope is reset after the handler returns.
+        assert current_scope() == ("", 0, 0.0)
+        assert sched.running == 0 and sched.queued == 0
+        rows = {(r[0], r[1]): r for r in sched.tenant_rows()}
+        assert rows[("frontend", "p2")][2] == 1
+
+    asyncio.run(body())
+
+
+def test_handler_error_counts_in_red_row():
+    sched = QosScheduler()
+
+    async def body():
+        from rio_tpu.protocol import ResponseEnvelope, ResponseError
+
+        async def fails(env):
+            return ResponseEnvelope.err(ResponseError.server_busy("boom"))
+
+        env = _env(tenant="t")
+        assert sched.admit(env) is None
+        await sched.run(fails, env)
+        rows = {(r[0], r[1]): r for r in sched.tenant_rows()}
+        assert rows[("t", FAIR_CLASS)][3] == 1  # errors
+
+    asyncio.run(body())
+
+
+def test_gauges_shape():
+    sched = QosScheduler()
+    g = sched.gauges()
+    for key in (
+        "rio.qos.running",
+        "rio.qos.queued",
+        "rio.qos.admitted",
+        "rio.qos.sheds",
+        "rio.qos.deadline_drops",
+        "rio.qos.interactive_admitted",
+        "rio.qos.interactive_sheds",
+    ):
+        assert g[key] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Live cluster: end-to-end classification + deadline propagation
+# ---------------------------------------------------------------------------
+
+
+@message
+class Probe:
+    sleep_s: float = 0.0
+
+
+@message
+class ProbeOut:
+    tenant: str = ""
+    priority: int = 0
+    budget_ms: int = 0
+    address: str = ""
+
+
+@message
+class HopProbe:
+    target_id: str = ""
+    sleep_s: float = 0.0
+
+
+class ScopeReporter(ServiceObject):
+    """Handlers cannot see envelopes — but the QoS request scope IS
+    visible, which is exactly the propagation contract under test."""
+
+    @handler
+    async def probe(self, msg: Probe, ctx: AppData) -> ProbeOut:
+        # Budget at handler START — the scheduler's grant-time contract.
+        # (Read before the sleep: a handler's own execution may legally
+        # outlive the deadline; only starting already-spent is a bug.)
+        budget = scope_budget_ms()
+        if msg.sleep_s:
+            await asyncio.sleep(msg.sleep_s)
+        tenant, priority, _ = current_scope()
+        return ProbeOut(
+            tenant=tenant,
+            priority=priority,
+            budget_ms=budget,
+            address=ctx.get(ServerInfo).address,
+        )
+
+    @handler
+    async def hop(self, msg: HopProbe, ctx: AppData) -> ProbeOut:
+        # Burn measurable budget, then hop: the next actor must observe a
+        # STRICTLY smaller remaining budget than this request arrived with.
+        # A spent budget is refused AT the hop (the target never runs) —
+        # surfaced here as a HandlerError, reported via a marker ProbeOut.
+        if msg.sleep_s:
+            await asyncio.sleep(msg.sleep_s)
+        from rio_tpu.errors import HandlerError
+
+        try:
+            return await ServiceObject.send(
+                ctx, ScopeReporter, msg.target_id, Probe(), returns=ProbeOut
+            )
+        except HandlerError as e:
+            return ProbeOut(tenant="refused", budget_ms=-1, address=str(e))
+
+
+def build_qos_registry() -> Registry:
+    return Registry().add_type(ScopeReporter)
+
+
+def test_client_to_server_classification_and_budget_arrival():
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        try:
+            out = await client.send(
+                ScopeReporter, "s1", Probe(), returns=ProbeOut,
+                tenant="frontend", priority=2, deadline_ms=5000,
+            )
+            assert out.tenant == "frontend" and out.priority == 2
+            # The handler sees remaining budget: positive, never more than
+            # the client sent (time only ever drains it).
+            assert 0 < out.budget_ms <= 5000
+            # Unclassified request: empty scope, no deadline.
+            out = await client.send(
+                ScopeReporter, "s1", Probe(), returns=ProbeOut
+            )
+            assert (out.tenant, out.priority, out.budget_ms) == ("", 0, 0)
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_qos_registry,
+            num_servers=2,
+            server_kwargs={"qos_config": QosConfig()},
+        )
+    )
+
+
+def test_internal_hop_arrives_with_strictly_smaller_budget():
+    # One server: ServiceObject.send does not follow redirects (remote
+    # owners surface as errors by design) — the hop under test is the
+    # internal-queue one, not cross-node routing.
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        try:
+            # Seat both actors first so the hop measures propagation, not
+            # placement latency.
+            await client.send(ScopeReporter, "a", Probe(), returns=ProbeOut)
+            await client.send(ScopeReporter, "b", Probe(), returns=ProbeOut)
+            out = await client.send(
+                ScopeReporter, "a", HopProbe(target_id="b", sleep_s=0.05),
+                returns=ProbeOut, tenant="frontend", deadline_ms=5000,
+            )
+            # The 50 ms burned before the hop is visible downstream.
+            assert 0 < out.budget_ms <= 5000 - 50
+            assert out.tenant == "frontend"  # classification propagated
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_qos_registry,
+            num_servers=1,
+            server_kwargs={"qos_config": QosConfig()},
+        )
+    )
+
+
+def test_internal_hop_refuses_spent_budget_before_handler():
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        try:
+            await client.send(ScopeReporter, "a", Probe(), returns=ProbeOut)
+            await client.send(ScopeReporter, "b", Probe(), returns=ProbeOut)
+            # 80 ms budget, 200 ms burned before the hop: the hop is
+            # refused at the internal dispatch point — actor b's handler
+            # never runs, actor a sees the DEADLINE_EXCEEDED refusal.
+            out = await client.send(
+                ScopeReporter, "a", HopProbe(target_id="b", sleep_s=0.2),
+                returns=ProbeOut, deadline_ms=80,
+            )
+            assert out.tenant == "refused" and out.budget_ms == -1
+            assert "DEADLINE_EXCEEDED" in out.address
+            assert "budget spent before internal dispatch" in out.address
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_qos_registry,
+            num_servers=1,
+            server_kwargs={"qos_config": QosConfig()},
+        )
+    )
+
+
+def test_redirect_follow_decrements_budget():
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        try:
+            seated = await client.send(
+                ScopeReporter, "r1", Probe(), returns=ProbeOut
+            )
+            wrong = next(
+                a for a in cluster.addresses if a != seated.address
+            )
+            # Poison the placement cache: the next attempt dials the wrong
+            # node, eats a Redirect, and the retry RE-ENCODES the envelope
+            # with the remaining budget (protocol.py re-encode contract).
+            client._placement.put((type_id(ScopeReporter), "r1"), wrong)
+            rd0 = client.stats.redirects
+            out = await client.send(
+                ScopeReporter, "r1", Probe(), returns=ProbeOut,
+                deadline_ms=5000,
+            )
+            assert client.stats.redirects == rd0 + 1  # the hop happened
+            assert out.address == seated.address
+            assert 0 < out.budget_ms < 5000  # drained, never invented
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_qos_registry,
+            num_servers=2,
+            server_kwargs={"qos_config": QosConfig()},
+        )
+    )
+
+
+def test_expired_inbound_is_dropped_without_running_handler():
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        try:
+            # Hold the single slot with a slow request, then send a
+            # short-deadline one: it parks, expires, and is answered
+            # DEADLINE_EXCEEDED without the handler observing it.
+            seated = await client.send(
+                ScopeReporter, "d1", Probe(), returns=ProbeOut
+            )
+            server = next(
+                s for s in cluster.servers
+                if s.local_address == seated.address
+            )
+            # The holder is classified (tenant set) so it occupies the
+            # single slot — unclassified traffic bypasses slot accounting.
+            slow = asyncio.create_task(
+                client.send(
+                    ScopeReporter, "d1", Probe(sleep_s=0.6), returns=ProbeOut,
+                    tenant="holder",
+                )
+            )
+            await asyncio.sleep(0.1)
+            with pytest.raises(DeadlineExceeded):
+                await client.send(
+                    ScopeReporter, "d1", Probe(), returns=ProbeOut,
+                    deadline_ms=120,
+                )
+            # The server DROPPED the parked request (deadline_drops moved):
+            # its handler never ran — the only handler execution was the
+            # slow holder's.
+            assert server.qos.stats.deadline_drops >= 1
+            assert client.stats.deadline_exceeded >= 1
+            await slow
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_qos_registry,
+            num_servers=1,
+            server_kwargs={"qos_config": QosConfig(max_concurrent=1)},
+        )
+    )
+
+
+def test_token_bucket_shed_surfaces_as_retryable_busy_and_counts():
+    from rio_tpu import codec
+    from rio_tpu.client import _ServerConns
+    from rio_tpu.errors import RetryExhausted
+    from rio_tpu.protocol import decode_response, encode_request_frame
+    from rio_tpu.utils.backoff import ExponentialBackoff
+
+    async def raw_probe(address: str, tenant: str):
+        """One framed request with no client retry middleware: the shed
+        response itself is the thing under test."""
+        pool = _ServerConns(address, 1, 2.0)
+        try:
+            req = RequestEnvelope(
+                type_id(ScopeReporter), "t1", type_id(Probe),
+                codec.serialize(Probe()), tenant=tenant,
+            )
+            conn = await pool.acquire()
+            try:
+                raw = await conn.roundtrip(encode_request_frame(req))
+            finally:
+                pool.release(conn, reuse=True)
+            return decode_response(raw)
+        finally:
+            pool.close()
+
+    async def body(cluster: Cluster):
+        address = cluster.addresses[0]
+        server = cluster.servers[0]
+        # Burst of 2 admitted, then the bucket is dry: retryable
+        # SERVER_BUSY with the "qos:" marker the client stats key on.
+        shed = None
+        for _ in range(4):
+            resp = await raw_probe(address, "bulk")
+            if resp.error is not None:
+                shed = resp.error
+        assert shed is not None
+        assert shed.kind == ErrorKind.SERVER_BUSY
+        assert shed.detail.startswith("qos:")
+        assert server.qos.stats.sheds >= 1
+        # And through the real client: the shed is counted in
+        # ClientStats.qos_sheds (distinct from generic busy_retries).
+        client = cluster.client(
+            backoff=ExponentialBackoff(initial=1e-3, max_retries=2)
+        )
+        try:
+            for _ in range(4):
+                try:
+                    await client.send(
+                        ScopeReporter, "t1", Probe(), returns=ProbeOut,
+                        tenant="bulk",
+                    )
+                except RetryExhausted:
+                    pass
+            assert client.stats.qos_sheds >= 1
+            assert client.stats.busy_retries >= client.stats.qos_sheds
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_qos_registry,
+            num_servers=1,
+            server_kwargs={
+                "qos_config": QosConfig(tenant_rates={"bulk": (1.0, 2.0)})
+            },
+        )
+    )
+
+
+# ---------------------------------------------------------------------------
+# Readscale proxy hop: stale standby forwards with a decremented budget
+# ---------------------------------------------------------------------------
+
+
+@message
+class RBump:
+    amount: int = 1
+
+
+@message
+class RProbe:
+    pass
+
+
+class ReplicatedReporter(ServiceObject):
+    __replicated__ = True
+
+    def __init__(self):
+        self.version = 0
+
+    def __migrate_state__(self):
+        return {"version": self.version}
+
+    def __restore_state__(self, value):
+        self.version = int(value["version"])
+
+    @handler
+    async def bump(self, msg: RBump, ctx: AppData) -> ProbeOut:
+        self.version += msg.amount
+        return ProbeOut(address=ctx.get(ServerInfo).address)
+
+    @readonly
+    @handler
+    async def read(self, msg: RProbe, ctx: AppData) -> ProbeOut:
+        tenant, priority, _ = current_scope()
+        return ProbeOut(
+            tenant=tenant,
+            priority=priority,
+            budget_ms=scope_budget_ms(),
+            address=ctx.get(ServerInfo).address,
+        )
+
+
+RTNAME = type_id(ReplicatedReporter)
+
+
+def build_replicated_registry() -> Registry:
+    return Registry().add_type(ReplicatedReporter)
+
+
+def test_readscale_proxy_hop_forwards_decremented_budget():
+    from rio_tpu import ReadScaleConfig
+    from rio_tpu.client import _ServerConns
+    from rio_tpu import codec
+    from rio_tpu.protocol import decode_response, encode_request_frame
+
+    async def raw_read(address: str, deadline_ms: int) -> ProbeOut:
+        pool = _ServerConns(address, 1, 2.0)
+        try:
+            req = RequestEnvelope(
+                RTNAME, "p1", type_id(RProbe), codec.serialize(RProbe()),
+                tenant="reader", deadline_ms=deadline_ms,
+            )
+            conn = await pool.acquire()
+            try:
+                raw = await conn.roundtrip(encode_request_frame(req))
+            finally:
+                pool.release(conn, reuse=True)
+            resp = decode_response(raw)
+            assert resp.is_ok, resp.error
+            return codec.deserialize(resp.body, ProbeOut)
+        finally:
+            pool.close()
+
+    async def body(cluster: Cluster):
+        client = cluster.client()
+        try:
+            out = await client.send(
+                ReplicatedReporter, "p1", RBump(amount=1), returns=ProbeOut
+            )
+            primary_addr = out.address
+            held, _ = await cluster.placement.standbys(ObjectId(RTNAME, "p1"))
+            assert held and primary_addr not in held
+            standby = next(
+                s for s in cluster.servers if s.local_address == held[0]
+            )
+            key = (RTNAME, "p1")
+            assert standby.replication_manager.replica_entry(key) is not None
+            # Age the replica past the staleness bound: the readonly read
+            # now PROXIES to the primary. The forward must carry tenant and
+            # a strictly smaller remaining budget (the standby burned some).
+            meta = standby.replication_manager._replica_meta[key]
+            meta.recv_mono -= 60.0
+            out = await raw_read(standby.local_address, 5000)
+            assert out.address == primary_addr  # the proxy hop happened
+            assert standby.read_scale_manager.stats.standby_forwards == 1
+            assert out.tenant == "reader"
+            assert 0 < out.budget_ms < 5000
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_replicated_registry,
+            num_servers=3,
+            server_kwargs={
+                "replication_config": ReplicationConfig(
+                    k=1, anti_entropy_interval=0.2, seat_ttl=0.2
+                ),
+                "read_scale_config": ReadScaleConfig(max_staleness_s=5.0),
+                "qos_config": QosConfig(),
+            },
+        )
+    )

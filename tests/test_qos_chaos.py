@@ -1,0 +1,180 @@
+"""QoS deadline propagation under injected transport latency (chaos).
+
+The deterministic tests in ``tests/test_qos.py`` pin the mechanism; this
+lane pins the INVARIANTS when real latency eats the budget at every hop
+(seeded :class:`~rio_tpu.faults.TransportFaults` frame delays, so a red
+run reproduces with the same seed):
+
+* a handler never observes MORE budget than the client sent — latency
+  only ever drains it, nothing along the path invents time;
+* a deadline-carrying request never arrives with its deadline stripped
+  (``scope_budget_ms() == 0`` would mean a hop dropped the field);
+* tight budgets under fat links surface as ``DeadlineExceeded`` at the
+  client — and every server-side drop happened *before* a handler ran
+  (``deadline_drops`` moves, handler-run count does not);
+* internal hops keep decrementing under latency: the downstream actor
+  sees strictly less budget than the upstream request carried.
+
+``RIO_TPU_CHAOS_SECS`` stretches the soak in the nightly matrix; the
+default keeps the tier-1 lane fast.
+"""
+
+import asyncio
+import os
+import time
+
+from rio_tpu import AppData, Registry, ServiceObject, handler
+from rio_tpu.errors import DeadlineExceeded, RetryExhausted
+from rio_tpu.faults import LinkRule, TransportFaults
+from rio_tpu.qos import QosConfig
+
+from .server_utils import Cluster, run_integration_test
+from .test_qos import (
+    HopProbe,
+    Probe,
+    ProbeOut,
+    ScopeReporter,
+    build_qos_registry,
+)
+
+CHAOS_SECS = float(os.environ.get("RIO_TPU_CHAOS_SECS", "3"))
+
+
+def _delayed_faults(seed: int, delay: float) -> TransportFaults:
+    tf = TransportFaults(seed=seed)
+    tf.add_rule(LinkRule(delay=delay))
+    return tf
+
+
+def test_budget_never_inflates_under_injected_latency():
+    async def body(cluster: Cluster):
+        client = cluster.client(
+            transport_faults=_delayed_faults(seed=7, delay=0.015)
+        )
+        try:
+            deadline = time.monotonic() + CHAOS_SECS
+            sent_budget = 2000
+            ok = expired = 0
+            i = 0
+            while time.monotonic() < deadline:
+                i += 1
+                try:
+                    out = await client.send(
+                        ScopeReporter, f"c{i % 8}", Probe(),
+                        returns=ProbeOut, tenant="chaos",
+                        deadline_ms=sent_budget,
+                    )
+                except (DeadlineExceeded, RetryExhausted):
+                    expired += 1
+                    continue
+                ok += 1
+                # Latency drained the budget but never inflated or
+                # stripped it.
+                assert 0 < out.budget_ms <= sent_budget
+                assert out.tenant == "chaos"
+            # 15 ms/frame against a 2 s budget: the flood mostly lands.
+            assert ok > 0
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_qos_registry,
+            num_servers=2,
+            server_kwargs={"qos_config": QosConfig()},
+        )
+    )
+
+
+def test_tight_budgets_expire_cleanly_under_latency_and_contention():
+    async def body(cluster: Cluster):
+        # One handler slot + concurrent slow requests + frame delay: most
+        # budgets die parked in the class queue. The contract is they die
+        # as DEADLINE verdicts before their handler starts — never as a
+        # handler running on spent time.
+        client = cluster.client(
+            transport_faults=_delayed_faults(seed=11, delay=0.01)
+        )
+        server = cluster.servers[0]
+        spent_seen = ok = expired = 0
+
+        async def one(i: int):
+            nonlocal spent_seen, ok, expired
+            try:
+                out = await client.send(
+                    ScopeReporter, f"t{i % 6}", Probe(sleep_s=0.05),
+                    returns=ProbeOut, deadline_ms=80,
+                )
+            except (DeadlineExceeded, RetryExhausted):
+                expired += 1
+                return
+            ok += 1
+            if out.budget_ms < 0:
+                spent_seen += 1
+
+        try:
+            deadline = time.monotonic() + CHAOS_SECS
+            i = 0
+            while time.monotonic() < deadline:
+                await asyncio.gather(*(one(i + k) for k in range(6)))
+                i += 6
+            assert expired > 0  # contention really ate budgets
+            # Every server-side death was a pre-handler drop...
+            assert server.qos.stats.deadline_drops > 0
+            # ...and no handler ever observed an already-spent scope —
+            # that would mean the admission layer ran doomed work.
+            assert spent_seen == 0
+            assert client.stats.deadline_exceeded >= expired
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_qos_registry,
+            num_servers=1,
+            server_kwargs={"qos_config": QosConfig(max_concurrent=1)},
+        )
+    )
+
+
+def test_internal_hop_keeps_decrementing_under_latency():
+    async def body(cluster: Cluster):
+        client = cluster.client(
+            transport_faults=_delayed_faults(seed=23, delay=0.01)
+        )
+        try:
+            await client.send(ScopeReporter, "a", Probe(), returns=ProbeOut)
+            await client.send(ScopeReporter, "b", Probe(), returns=ProbeOut)
+            deadline = time.monotonic() + CHAOS_SECS
+            hops = refused = 0
+            while time.monotonic() < deadline:
+                try:
+                    out = await client.send(
+                        ScopeReporter, "a",
+                        HopProbe(target_id="b", sleep_s=0.02),
+                        returns=ProbeOut, tenant="hopper", deadline_ms=1000,
+                    )
+                except (DeadlineExceeded, RetryExhausted):
+                    continue
+                if out.tenant == "refused":
+                    refused += 1  # budget died exactly at the hop — legal
+                    continue
+                hops += 1
+                # The 20 ms burned upstream (plus link latency) is always
+                # visible downstream; classification survives the hop.
+                assert 0 < out.budget_ms <= 1000 - 20
+                assert out.tenant == "hopper"
+            assert hops > 0
+        finally:
+            client.close()
+
+    asyncio.run(
+        run_integration_test(
+            body,
+            registry_builder=build_qos_registry,
+            num_servers=1,
+            server_kwargs={"qos_config": QosConfig()},
+        )
+    )

@@ -361,10 +361,13 @@ def test_default_rules_cover_the_stock_alarm_set():
         "p99_rising", "loop_lag_rising", "journal_dropped", "shed_rate",
         "residual_diverging", "storage_errors", "solve_ms_drift",
         "cluster_load_falling", "cross_node_bytes_rising",
+        "qos_shed_rising", "deadline_exceeded_rising",
     }
     kinds = {r.name: r.kind for r in default_rules()}
     assert kinds["journal_dropped"] == "delta"
     assert kinds["storage_errors"] == "delta"
+    assert kinds["qos_shed_rising"] == "delta"
+    assert kinds["deadline_exceeded_rising"] == "delta"
     assert kinds["solve_ms_drift"] == "drift"
     assert kinds["cross_node_bytes_rising"] == "rising"
     assert kinds["cluster_load_falling"] == "falling"
